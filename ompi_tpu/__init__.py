@@ -1,0 +1,44 @@
+"""ompi_tpu — a TPU-native communication framework with the capabilities
+of Open MPI (reference: ICLDisco/ompi @ v5.0.0a1, see SURVEY.md).
+
+Layering (top to bottom, mirroring the reference's README architecture):
+
+- public API (this module): init/finalize, COMM_WORLD, datatypes, ops —
+  the "MPI layer" (reference: ompi/).
+- frameworks: coll (collectives), pml (p2p messaging), osc (one-sided),
+  io, topo, pgas — pluggable components selected by priority
+  (reference: ompi/mca/*).
+- core substrate: config vars, component registry, progress engine,
+  requests, counters (reference: opal/).
+- device substrate: JAX/XLA over TPU meshes — ICI collectives via
+  shard_map/ppermute/Pallas instead of BTL byte transports; DCN for
+  multi-slice (reference: opal/mca/btl).
+"""
+
+from ._version import __version__
+from . import core, ops
+from .group import Group
+
+__all__ = ["__version__", "core", "ops", "Group"]
+
+
+def __getattr__(name):
+    # Lazy-load the heavier API surface (pulls in jax) on first use.
+    import importlib
+
+    lazy = {
+        "init", "finalize", "initialized", "COMM_WORLD", "COMM_SELF",
+        "world", "abort",
+    }
+    try:
+        if name in lazy:
+            api = importlib.import_module(".api", __name__)
+            return getattr(api, name)
+        if name in ("coll", "datatype", "pml", "runtime", "osc", "topo",
+                    "parallel", "pgas", "io", "monitoring"):
+            return importlib.import_module(f".{name}", __name__)
+    except ImportError as exc:
+        raise AttributeError(
+            f"module {__name__!r} attribute {name!r} unavailable: {exc}"
+        ) from exc
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,5 @@
+"""Collective operations framework (reference: ompi/mca/coll)."""
+
+from . import spmd
+
+__all__ = ["spmd"]

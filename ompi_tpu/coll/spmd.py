@@ -1,0 +1,601 @@
+"""SPMD collective algorithm library — usable inside shard_map/pjit.
+
+TPU-native re-design of ompi/mca/coll/base's algorithm library
+(reference: coll_base_allreduce.c — nonoverlapping:53,
+recursivedoubling:130, ring:341, ring_segmented:618, redscat_allgather
+(Rabenseifner):970; coll_base_{bcast,allgather,alltoall,...}.c; tree
+builders in coll_base_topo.c).
+
+Where the reference expresses each algorithm as a loop of PML send/recv
+with CPU reduction per segment, here each algorithm is a *traced* program
+over a named mesh axis: neighbor exchange is `lax.ppermute` (compiled to
+ICI DMA), the reduction is the Op's combine executed on the VPU/MXU
+against HBM-resident values, and XLA overlaps the DMA with the combine —
+the overlap the reference gets from segmented pipelining falls out of the
+compiler schedule.
+
+Every function takes ``axis_name`` (the mesh axis the collective runs
+over) and is valid inside `jax.shard_map`. The number of ranks is static
+at trace time (`lax.axis_size`), so all schedules (ring permutations,
+binomial trees, butterfly exchanges) are unrolled into the XLA graph —
+the analog of libnbc's precompiled round schedules (nbc_internal.h:149).
+
+The XLA-native entries (`allreduce_native` etc.) lower to XLA's own
+all-reduce, which the runtime maps to the ICI fabric's optimal schedule;
+the explicit variants exist for (a) the tuned decision space, (b) ops XLA
+cannot reduce natively, (c) segment-size control for overlap tuning.
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..core.errors import ArgumentError
+from ..ops import Op
+from ..ops import op as _op_mod
+
+
+def _size(axis_name: str) -> int:
+    return lax.axis_size(axis_name)
+
+
+def _rank(axis_name: str):
+    return lax.axis_index(axis_name)
+
+
+def _ring_perm(n: int, shift: int = 1) -> list[tuple[int, int]]:
+    return [(i, (i + shift) % n) for i in range(n)]
+
+
+def _flatten_pad(x: jax.Array, n: int) -> tuple[jax.Array, int]:
+    """Ravel and zero-pad so the element count divides n."""
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    padded = ((total + n - 1) // n) * n
+    if padded != total:
+        flat = jnp.pad(flat, (0, padded - total))
+    return flat, total
+
+
+# ---------------------------------------------------------------------------
+# allreduce family
+# ---------------------------------------------------------------------------
+
+def allreduce_native(x: Any, axis_name: str, op: Op) -> Any:
+    """XLA-native allreduce: lax.psum/pmax/pmin where the op maps directly
+    (SUM/MAX/MIN); otherwise allgather + on-device tree reduction.
+
+    This is the default data-parallel gradient path (SURVEY §2.6 DP row).
+    """
+    if op.xla_reduce is not None:
+        fn = getattr(lax, op.xla_reduce)
+        return fn(x, axis_name)
+    return _allreduce_gather_reduce(x, axis_name, op)
+
+
+def _allreduce_gather_reduce(x: Any, axis_name: str, op: Op) -> Any:
+    """Allgather then local tree-reduce — handles arbitrary (including
+    non-commutative and joint MAXLOC/MINLOC) ops in rank order."""
+    n = _size(axis_name)
+    gathered = jax.tree.map(
+        lambda leaf: lax.all_gather(leaf, axis_name, axis=0), x
+    )
+    return _tree_reduce_ranks(gathered, n, op)
+
+
+def _tree_reduce_ranks(gathered: Any, n: int, op: Op) -> Any:
+    """Reduce a leading rank axis with a balanced tree that preserves rank
+    order (valid for non-commutative ops)."""
+    parts = [jax.tree.map(lambda g, i=i: g[i], gathered) for i in range(n)]
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            nxt.append(op.combine(parts[i], parts[i + 1]))
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]
+
+
+def allreduce_recursive_doubling(
+    x: jax.Array, axis_name: str, op: Op
+) -> jax.Array:
+    """Butterfly exchange, log2(n) rounds of full-buffer exchanges.
+
+    Reference algorithm: coll_base_allreduce.c:130
+    (ompi_coll_base_allreduce_intra_recursivedoubling); the tuned layer
+    picks it for small messages (<10 KB cutoff,
+    coll_tuned_decision_fixed.c:53,66).
+
+    Non-power-of-two rank counts use the standard fold/unfold pre/post
+    phase. Requires a commutative op: the butterfly combines in
+    partner-order, so non-commutative (and joint) ops are routed to the
+    ordered gather+reduce path, as the reference's tuned layer falls back.
+    """
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    if not op.commutative or _op_mod._is_joint(op):
+        return _allreduce_gather_reduce(x, axis_name, op)
+    pof2 = 1 << (n.bit_length() - 1)
+    rem = n - pof2
+    rank = _rank(axis_name)
+
+    if rem > 0:
+        # Fold: even ranks among the first 2*rem send to rank+1, which
+        # combines. Ranks >= 2*rem are unaffected.
+        perm = [(2 * i, 2 * i + 1) for i in range(rem)]
+        recv = lax.ppermute(x, axis_name, perm)
+        is_odd_low = (rank < 2 * rem) & (rank % 2 == 1)
+        x = jnp.where(is_odd_low, op.combine(recv, x), x)
+        # Active ranks: odd ranks < 2*rem (relabeled i//2) and ranks
+        # >= 2*rem (relabeled rank - rem).
+        active = ((rank < 2 * rem) & (rank % 2 == 1)) | (rank >= 2 * rem)
+
+        def phys(newrank: int) -> int:
+            return 2 * newrank + 1 if newrank < rem else newrank + rem
+
+        for k in range(int(math.log2(pof2))):
+            dist = 1 << k
+            perm = []
+            for nr in range(pof2):
+                partner = nr ^ dist
+                perm.append((phys(nr), phys(partner)))
+            recv = lax.ppermute(x, axis_name, perm)
+            x = jnp.where(active, op.combine(x, recv), x)
+
+        # Unfold: odd low ranks send the result back to rank-1.
+        perm = [(2 * i + 1, 2 * i) for i in range(rem)]
+        recv = lax.ppermute(x, axis_name, perm)
+        is_even_low = (rank < 2 * rem) & (rank % 2 == 0)
+        x = jnp.where(is_even_low, recv, x)
+        return x
+
+    for k in range(int(math.log2(n))):
+        dist = 1 << k
+        perm = [(i, i ^ dist) for i in range(n)]
+        recv = lax.ppermute(x, axis_name, perm)
+        x = op.combine(x, recv)
+    return x
+
+
+def allreduce_ring(x: jax.Array, axis_name: str, op: Op) -> jax.Array:
+    """Bandwidth-optimal ring: n-1 reduce-scatter steps + n-1 allgather
+    steps, each moving size/n bytes over single-hop ICI links.
+
+    Reference algorithm: coll_base_allreduce.c:341
+    (ompi_coll_base_allreduce_intra_ring); tuned picks it for commutative
+    ops ≤1 MB/rank (coll_tuned_decision_fixed.c:69-72).
+    """
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    rank = _rank(axis_name)
+    flat, total = _flatten_pad(x, n)
+    blocks = flat.reshape(n, -1)
+    right = _ring_perm(n, 1)
+
+    # Reduce-scatter phase: after n-1 hops rank i holds the full reduction
+    # of block (i+1) mod n.
+    carry = jnp.take(blocks, rank, axis=0)
+    for k in range(n - 1):
+        recvd = lax.ppermute(carry, axis_name, right)
+        idx = (rank - k - 1) % n
+        carry = op.combine(recvd, jnp.take(blocks, idx, axis=0))
+
+    # Allgather phase: circulate the completed blocks.
+    out = jnp.zeros_like(blocks)
+    out = out.at[(rank + 1) % n].set(carry)
+    cur = carry
+    for k in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, right)
+        out = out.at[(rank - k) % n].set(cur)
+
+    return out.reshape(-1)[:total].reshape(x.shape)
+
+
+def allreduce_ring_segmented(
+    x: jax.Array, axis_name: str, op: Op, segment_elems: int = 0
+) -> jax.Array:
+    """Segmented ring: the buffer is cut into segments that move through
+    the ring independently, bounding per-step working-set size.
+
+    Reference: coll_base_allreduce.c:618 (..._intra_ring_segmented), with
+    the tuned 1 MB segment default (coll_tuned_decision_fixed.c:73). Under
+    XLA the segments' ppermutes are independent program slices the
+    scheduler can overlap with the combines.
+    """
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    flat = x.reshape(-1)
+    total = flat.shape[0]
+    if segment_elems <= 0 or total <= segment_elems:
+        return allreduce_ring(x, axis_name, op)
+    pieces = []
+    for start in range(0, total, segment_elems):
+        seg = flat[start : start + segment_elems]
+        pieces.append(allreduce_ring(seg, axis_name, op))
+    return jnp.concatenate(pieces).reshape(x.shape)
+
+
+def allreduce_reduce_scatter_allgather(
+    x: jax.Array, axis_name: str, op: Op
+) -> jax.Array:
+    """Rabenseifner: recursive-halving reduce-scatter + recursive-doubling
+    allgather — latency log2(n), bandwidth-optimal for large buffers.
+
+    Reference: coll_base_allreduce.c:970
+    (ompi_coll_base_allreduce_intra_redscat_allgather). Power-of-two rank
+    counts; callers (tuned) fall back to ring otherwise, as the reference
+    does for the non-pof2 remainder handling.
+    """
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        return allreduce_ring(x, axis_name, op)
+    rank = _rank(axis_name)
+    flat, total = _flatten_pad(x, n)
+    blocks = flat.reshape(n, -1)
+
+    # Recursive halving reduce-scatter: each round the block range is
+    # halved; a rank keeps the half containing its own block index and
+    # trades partials for the other half with partner = rank ^ half.
+    steps = int(math.log2(n))
+    cur = blocks  # my partials for the current block range
+    cnt = n
+    for k in range(steps):
+        half = cnt // 2
+        mask_upper = (rank & half) != 0  # am I in the upper half-range?
+        perm = [(i, i ^ half) for i in range(n)]
+        lower, upper = cur[:half], cur[half:]
+        # Give away the half I am not keeping; receive exactly the half
+        # I keep (the partner gives away its mirror half).
+        send = jnp.where(mask_upper, lower, upper)
+        recv = lax.ppermute(send, axis_name, perm)
+        keep = jnp.where(mask_upper, upper, lower)
+        cur = op.combine(keep, recv)
+        cnt = half
+
+    # cur is (1, m): the fully reduced block whose index == rank.
+    have = cur
+
+    # Recursive doubling allgather: ranges merge back; an upper partner's
+    # range is prepended, a lower partner's appended. After all rounds the
+    # rows sit in block order (the owned range start telescopes to 0).
+    for k in range(steps):
+        dist = 1 << k
+        perm = [(i, i ^ dist) for i in range(n)]
+        recv = lax.ppermute(have, axis_name, perm)
+        mask_upper = (rank & dist) != 0
+        have = jnp.where(
+            mask_upper,
+            jnp.concatenate([recv, have], axis=0),
+            jnp.concatenate([have, recv], axis=0),
+        )
+
+    return have.reshape(-1)[:total].reshape(x.shape)
+
+
+def allreduce_nonoverlapping(
+    x: jax.Array, axis_name: str, op: Op, root: int = 0
+) -> jax.Array:
+    """Reduce-to-root then broadcast — the non-commutative-safe fallback.
+
+    Reference: coll_base_allreduce.c:53 (..._intra_nonoverlapping), chosen
+    by tuned for non-commutative ops (coll_tuned_decision_fixed.c:85-86).
+    """
+    red = reduce_binomial(x, axis_name, op, root=root)
+    return bcast_native(red, axis_name, root=root)
+
+
+# ---------------------------------------------------------------------------
+# bcast / reduce
+# ---------------------------------------------------------------------------
+
+def bcast_native(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Broadcast root's value: mask + psum (a single fabric all-reduce,
+    which XLA lowers to the ICI-optimal broadcast schedule)."""
+    rank = _rank(axis_name)
+    contrib = jax.tree.map(
+        lambda leaf: jnp.where(rank == root, leaf, jnp.zeros_like(leaf)), x
+    )
+    return jax.tree.map(lambda leaf: lax.psum(leaf, axis_name), contrib)
+
+
+def bcast_binomial(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Binomial-tree broadcast: log2(n) rounds, round k has the first 2^k
+    (root-relative) ranks send to rank+2^k.
+
+    Reference: coll_base_bcast.c (ompi_coll_base_bcast_intra_binomial) via
+    the tree builders in coll_base_topo.c.
+    """
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n  # root-relative rank
+    rounds = (n - 1).bit_length()
+    for k in range(rounds):
+        dist = 1 << k
+        perm = []
+        for v in range(min(dist, n - dist)):
+            src = (v + root) % n
+            dst = (v + dist + root) % n
+            perm.append((src, dst))
+        recv = lax.ppermute(x, axis_name, perm)
+        takes = (vrank >= dist) & (vrank < 2 * dist)
+        x = jax.tree.map(
+            lambda leaf, r: jnp.where(takes, r, leaf), x, recv
+        )
+    return x
+
+
+def reduce_binomial(
+    x: jax.Array, axis_name: str, op: Op, root: int = 0
+) -> jax.Array:
+    """Binomial-tree reduction to root (others return op-identity or their
+    partial; only root's value is defined, per MPI semantics).
+
+    Reference: coll_base_reduce.c (ompi_coll_base_reduce_intra_binomial).
+    Requires a commutative op for the tree pairing; non-commutative ops go
+    through the ordered gather+reduce path.
+    """
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    if not op.commutative or _op_mod._is_joint(op):
+        return _allreduce_gather_reduce(x, axis_name, op)
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+    rounds = (n - 1).bit_length()
+    for k in range(rounds):
+        mask = 1 << k
+        # One sender per pair: vranks that are odd multiples of `mask`
+        # send their accumulated subtree to vrank-mask and go idle.
+        perm = []
+        for vr in range(0, n, 2 * mask):
+            if vr + mask < n:
+                perm.append(((vr + mask + root) % n, (vr + root) % n))
+        recv = lax.ppermute(x, axis_name, perm)
+        receives = (vrank % (2 * mask) == 0) & (vrank + mask < n)
+        x = jax.tree.map(
+            lambda leaf, r: jnp.where(receives, op.combine(leaf, r), leaf),
+            x,
+            recv,
+        )
+    return x
+
+
+def reduce_native(
+    x: jax.Array, axis_name: str, op: Op, root: int = 0
+) -> jax.Array:
+    """Reduce via the fabric allreduce (every rank computes; root reads)."""
+    del root
+    return allreduce_native(x, axis_name, op)
+
+
+# ---------------------------------------------------------------------------
+# allgather / reduce_scatter
+# ---------------------------------------------------------------------------
+
+def allgather_native(x: jax.Array, axis_name: str) -> jax.Array:
+    """XLA-native all-gather; result has a new leading rank axis."""
+    return lax.all_gather(x, axis_name, axis=0)
+
+
+def allgather_ring(x: jax.Array, axis_name: str) -> jax.Array:
+    """Ring allgather: n-1 single-hop forwards.
+
+    Reference: coll_base_allgather.c (..._intra_ring)."""
+    n = _size(axis_name)
+    rank = _rank(axis_name)
+    out = jnp.zeros((n,) + x.shape, x.dtype)
+    out = out.at[rank].set(x)
+    cur = x
+    right = _ring_perm(n, 1)
+    for k in range(n - 1):
+        cur = lax.ppermute(cur, axis_name, right)
+        out = out.at[(rank - k - 1) % n].set(cur)
+    return out
+
+
+def allgather_bruck(x: jax.Array, axis_name: str) -> jax.Array:
+    """Bruck allgather: ceil(log2 n) rounds of doubling-size exchanges.
+
+    Reference: coll_base_allgather.c (..._intra_bruck)."""
+    n = _size(axis_name)
+    rank = _rank(axis_name)
+    have = x[None]  # rows: blocks (rank, rank+1, ...) in circular order
+    k = 1
+    while k < n:
+        perm = [(i, (i - k) % n) for i in range(n)]  # send to rank-k
+        recv = lax.ppermute(have[: min(k, n - k)], axis_name, perm)
+        have = jnp.concatenate([have, recv], axis=0)[:n]
+        k *= 2
+    # Row j of `have` is block (rank + j) mod n; rotate into rank order.
+    idx = (jnp.arange(n) - rank) % n
+    return jnp.take(have, idx, axis=0)
+
+
+def reduce_scatter_native(x: jax.Array, axis_name: str, op: Op) -> jax.Array:
+    """XLA-native reduce-scatter over leading axis (psum_scatter) for SUM;
+    generic ops reduce then slice."""
+    n = _size(axis_name)
+    if x.shape[0] != n:
+        raise ArgumentError(
+            f"reduce_scatter input leading dim {x.shape[0]} != ranks {n}"
+        )
+    if op.xla_reduce == "psum":
+        # tiled=False removes the scattered leading axis, matching the
+        # (block_shape,) result of the ring variant and the generic path.
+        return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=False)
+    red = allreduce_native(x, axis_name, op)
+    return jnp.take(red, _rank(axis_name), axis=0)
+
+
+def reduce_scatter_ring(x: jax.Array, axis_name: str, op: Op) -> jax.Array:
+    """Ring reduce-scatter (the first phase of the ring allreduce).
+
+    Reference: coll_base_reduce_scatter.c (..._intra_ring)."""
+    n = _size(axis_name)
+    rank = _rank(axis_name)
+    if x.shape[0] != n:
+        raise ArgumentError(
+            f"reduce_scatter input leading dim {x.shape[0]} != ranks {n}"
+        )
+    if n == 1:
+        return x[0]
+    right = _ring_perm(n, 1)
+    # The partial for block b starts at rank b+1 and travels n-1 hops
+    # rightward, accumulating each rank's contribution, to finish at rank
+    # b. So rank i injects block (i-1) first and absorbs block i last.
+    carry = jnp.take(x, (rank - 1) % n, axis=0)
+    for k in range(n - 1):
+        recvd = lax.ppermute(carry, axis_name, right)
+        idx = (rank - k - 2) % n
+        carry = op.combine(recvd, jnp.take(x, idx, axis=0))
+    return carry
+
+
+# ---------------------------------------------------------------------------
+# alltoall / gather / scatter / scan / barrier
+# ---------------------------------------------------------------------------
+
+def alltoall_native(x: jax.Array, axis_name: str) -> jax.Array:
+    """XLA-native all-to-all over the leading (per-destination) axis."""
+    n = _size(axis_name)
+    if x.shape[0] != n:
+        raise ArgumentError(
+            f"alltoall input leading dim {x.shape[0]} != ranks {n}"
+        )
+    return lax.all_to_all(x, axis_name, split_axis=0, concat_axis=0, tiled=False)
+
+
+def alltoall_pairwise(x: jax.Array, axis_name: str) -> jax.Array:
+    """Pairwise-exchange alltoall: n-1 rounds, round k exchanges with
+    rank±k — the large-message algorithm.
+
+    Reference: coll_base_alltoall.c (..._intra_pairwise), selected by
+    tuned for large messages (coll_tuned_decision_fixed.c:130-141)."""
+    n = _size(axis_name)
+    rank = _rank(axis_name)
+    if x.shape[0] != n:
+        raise ArgumentError(
+            f"alltoall input leading dim {x.shape[0]} != ranks {n}"
+        )
+    out = jnp.zeros_like(x)
+    out = out.at[rank].set(jnp.take(x, rank, axis=0))
+    for k in range(1, n):
+        send_to = [(i, (i + k) % n) for i in range(n)]
+        # Block destined for rank+k travels directly there.
+        payload = jnp.take(x, (rank + k) % n, axis=0)
+        recvd = lax.ppermute(payload, axis_name, send_to)
+        out = out.at[(rank - k) % n].set(recvd)
+    return out
+
+
+def alltoall_bruck(x: jax.Array, axis_name: str) -> jax.Array:
+    """Bruck alltoall: log2(n) rounds of bit-indexed block exchanges —
+    the small-message, latency-optimal algorithm.
+
+    Reference: coll_base_alltoall.c (..._intra_bruck)."""
+    n = _size(axis_name)
+    rank = _rank(axis_name)
+    if x.shape[0] != n:
+        raise ArgumentError(
+            f"alltoall input leading dim {x.shape[0]} != ranks {n}"
+        )
+    # Phase 1: local rotation so block j holds data for rank (rank+j).
+    idx = (jnp.arange(n) + rank) % n
+    cur = jnp.take(x, idx, axis=0)
+    # Phase 2: for each bit k, send blocks whose index has bit k set to
+    # rank+2^k.
+    k = 1
+    while k < n:
+        perm = [(i, (i + k) % n) for i in range(n)]
+        mask = (jnp.arange(n) & k) != 0
+        recvd = lax.ppermute(cur, axis_name, perm)
+        cur = jnp.where(mask[(...,) + (None,) * (cur.ndim - 1)], recvd, cur)
+        k *= 2
+    # Phase 3: inverse rotation + reversal to restore source order.
+    idx = (rank - jnp.arange(n)) % n
+    return jnp.take(cur, idx, axis=0)
+
+
+def gather_native(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Gather to root (SPMD form: every rank materializes the gather; the
+    driver layer slices root's copy — on TPU the allgather IS the
+    binomial gather's fabric cost)."""
+    del root
+    return lax.all_gather(x, axis_name, axis=0)
+
+
+def scatter_native(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
+    """Scatter root's (n, ...) buffer: broadcast-free implementation —
+    each rank takes its row after a root-masked psum."""
+    rank = _rank(axis_name)
+    rooted = bcast_native(x, axis_name, root=root)
+    return jnp.take(rooted, rank, axis=0)
+
+
+def scan_native(x: jax.Array, axis_name: str, op: Op) -> jax.Array:
+    """Inclusive prefix reduction over ranks.
+
+    Reference: coll_base_scan.c — linear recursion; here: allgather +
+    on-device associative scan + row select (log-depth on the VPU)."""
+    rank = _rank(axis_name)
+    gathered = lax.all_gather(x, axis_name, axis=0)
+    scanned = lax.associative_scan(
+        lambda a, b: op.combine(a, b), gathered, axis=0
+    )
+    return jnp.take(scanned, rank, axis=0)
+
+
+def exscan_native(x: jax.Array, axis_name: str, op: Op) -> jax.Array:
+    """Exclusive prefix reduction; rank 0's result is the op identity
+    (MPI leaves it undefined — identity is the useful choice)."""
+    rank = _rank(axis_name)
+    gathered = lax.all_gather(x, axis_name, axis=0)
+    scanned = lax.associative_scan(
+        lambda a, b: op.combine(a, b), gathered, axis=0
+    )
+    prev = jnp.take(scanned, jnp.maximum(rank - 1, 0), axis=0)
+    if op.has_identity:
+        ident = op.identity_like(x)
+    else:
+        ident = jnp.zeros_like(x)
+    return jnp.where(rank == 0, ident, prev)
+
+
+def barrier(axis_name: str):
+    """Fabric barrier: a 1-element allreduce (the reference's
+    recursive-doubling barrier collapses to the same fabric round-trip)."""
+    return lax.psum(jnp.ones((), jnp.int32), axis_name)
+
+
+# ---------------------------------------------------------------------------
+# sendrecv / ring-shift building blocks (SP/PP substrate, SURVEY §2.6)
+# ---------------------------------------------------------------------------
+
+def ring_shift(x: Any, axis_name: str, shift: int = 1) -> Any:
+    """Shift values around the ring by `shift` (the ring-attention /
+    pipeline-edge primitive; reference analog: the ring pass inside
+    allreduce_intra_ring, coll_base_allreduce.c:341)."""
+    n = _size(axis_name)
+    perm = _ring_perm(n, shift % n)
+    return jax.tree.map(lambda leaf: lax.ppermute(leaf, axis_name, perm), x)
+
+
+def sendrecv(x: Any, axis_name: str, perm: Sequence[tuple[int, int]]) -> Any:
+    """Explicit (src, dst) permutation exchange — typed edge channels."""
+    return jax.tree.map(
+        lambda leaf: lax.ppermute(leaf, axis_name, list(perm)), x
+    )

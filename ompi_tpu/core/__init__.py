@@ -1,0 +1,63 @@
+"""Core substrate: config vars, components, logging, counters, requests,
+progress — the OPAL-equivalent layer (reference: opal/)."""
+
+from . import attributes, component, config, counters, errors, info, logging
+from . import progress, request
+from .component import MCA, Component, Framework, framework
+from .config import VARS, VarFlag, VarSource
+from .counters import SPC, PvarSession
+from .errors import OmpiTpuError
+from .info import INFO_NULL, Info
+from .logging import get_logger, show_help
+from .progress import ENGINE as PROGRESS_ENGINE
+from .request import (
+    ANY_SOURCE,
+    ANY_TAG,
+    CompletedRequest,
+    GeneralizedRequest,
+    Request,
+    Status,
+    test_all,
+    test_any,
+    wait_all,
+    wait_any,
+    wait_some,
+)
+
+__all__ = [
+    "attributes",
+    "component",
+    "config",
+    "counters",
+    "errors",
+    "info",
+    "logging",
+    "progress",
+    "request",
+    "MCA",
+    "Component",
+    "Framework",
+    "framework",
+    "VARS",
+    "VarFlag",
+    "VarSource",
+    "SPC",
+    "PvarSession",
+    "OmpiTpuError",
+    "INFO_NULL",
+    "Info",
+    "get_logger",
+    "show_help",
+    "PROGRESS_ENGINE",
+    "ANY_SOURCE",
+    "ANY_TAG",
+    "CompletedRequest",
+    "GeneralizedRequest",
+    "Request",
+    "Status",
+    "test_all",
+    "test_any",
+    "wait_all",
+    "wait_any",
+    "wait_some",
+]

@@ -1,0 +1,119 @@
+"""Software performance counters (SPC) + performance-variable registry.
+
+TPU-native equivalent of Open MPI's SPC counters (reference:
+ompi/runtime/ompi_spc.h:55- enum of per-op counters, SPC_RECORD at each API
+entry e.g. ompi/mpi/c/allreduce.c:51) exported through an MPI_T-pvar-like
+registry (reference: opal/mca/base/mca_base_pvar.c, ompi/mpi/tool/).
+
+Counters are cheap process-local accumulators; a session can snapshot and
+diff them (the MPI_T pvar handle start/stop/read model). Timer-class
+counters accumulate seconds.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from contextlib import contextmanager
+from typing import Iterator
+
+
+class Counter:
+    __slots__ = ("name", "description", "unit", "value", "_lock")
+
+    def __init__(self, name: str, description: str = "", unit: str = "count"):
+        self.name = name
+        self.description = description
+        self.unit = unit
+        self.value: float = 0
+        self._lock = threading.Lock()
+
+    def add(self, amount: float = 1) -> None:
+        with self._lock:
+            self.value += amount
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self.value = value
+
+    def read(self) -> float:
+        return self.value
+
+
+class CounterRegistry:
+    def __init__(self) -> None:
+        self._counters: dict[str, Counter] = {}
+        self._lock = threading.Lock()
+        self.enabled = True
+
+    def counter(
+        self, name: str, description: str = "", unit: str = "count"
+    ) -> Counter:
+        with self._lock:
+            c = self._counters.get(name)
+            if c is None:
+                c = Counter(name, description, unit)
+                self._counters[name] = c
+            return c
+
+    def record(self, name: str, amount: float = 1) -> None:
+        if self.enabled:
+            self.counter(name).add(amount)
+
+    @contextmanager
+    def timer(self, name: str) -> Iterator[None]:
+        """Accumulate wall seconds into `<name>_seconds` — timer-class
+        counters are distinct from event counters of the same base name
+        (the reference's SPC keeps separate timer-variant counters too)."""
+        if not self.enabled:
+            yield
+            return
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.counter(f"{name}_seconds", unit="seconds").add(
+                time.perf_counter() - t0
+            )
+
+    def snapshot(self) -> dict[str, float]:
+        with self._lock:
+            return {n: c.value for n, c in self._counters.items()}
+
+    def dump(self) -> list[dict]:
+        with self._lock:
+            return [
+                {
+                    "name": c.name,
+                    "value": c.value,
+                    "unit": c.unit,
+                    "description": c.description,
+                }
+                for c in sorted(self._counters.values(), key=lambda c: c.name)
+            ]
+
+    def reset_for_testing(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+SPC = CounterRegistry()
+
+
+class PvarSession:
+    """MPI_T-style session: snapshot at start, diff on read."""
+
+    def __init__(self, registry: CounterRegistry = SPC) -> None:
+        self._registry = registry
+        self._base = registry.snapshot()
+
+    def read(self) -> dict[str, float]:
+        now = self._registry.snapshot()
+        return {
+            k: v - self._base.get(k, 0)
+            for k, v in now.items()
+            if v != self._base.get(k, 0)
+        }
+
+    def reset(self) -> None:
+        self._base = self._registry.snapshot()

@@ -1,0 +1,129 @@
+"""Error classes and error-handler plumbing.
+
+TPU-native equivalent of ompi/errhandler (reference:
+ompi/errhandler/errhandler.h; MPI error classes in mpi.h) — Pythonic
+exceptions instead of integer error codes, but the same classification
+surface and the per-object errhandler model (ERRORS_ARE_FATAL /
+ERRORS_RETURN / user callback).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+
+class OmpiTpuError(Exception):
+    """Base class for all framework errors (MPI_ERR_* family)."""
+
+    errclass = "ERR_OTHER"
+
+
+class ComponentError(OmpiTpuError):
+    errclass = "ERR_INTERN"
+
+
+class ArgumentError(OmpiTpuError, ValueError):
+    errclass = "ERR_ARG"
+
+
+class DatatypeError(OmpiTpuError):
+    errclass = "ERR_TYPE"
+
+
+class TruncationError(OmpiTpuError):
+    """Receive buffer too small (MPI_ERR_TRUNCATE)."""
+
+    errclass = "ERR_TRUNCATE"
+
+
+class CommError(OmpiTpuError):
+    errclass = "ERR_COMM"
+
+
+class GroupError(OmpiTpuError):
+    errclass = "ERR_GROUP"
+
+
+class RankError(OmpiTpuError):
+    errclass = "ERR_RANK"
+
+
+class TagError(OmpiTpuError):
+    errclass = "ERR_TAG"
+
+
+class OpError(OmpiTpuError):
+    errclass = "ERR_OP"
+
+
+class RequestError(OmpiTpuError):
+    errclass = "ERR_REQUEST"
+
+
+class WinError(OmpiTpuError):
+    errclass = "ERR_WIN"
+
+
+class RMASyncError(OmpiTpuError):
+    errclass = "ERR_RMA_SYNC"
+
+
+class IOError_(OmpiTpuError):
+    errclass = "ERR_IO"
+
+
+class TopologyError(OmpiTpuError):
+    errclass = "ERR_TOPOLOGY"
+
+
+class NotInitializedError(OmpiTpuError):
+    errclass = "ERR_OTHER"
+
+
+class AbortError(OmpiTpuError):
+    """Raised by comm.abort()."""
+
+    errclass = "ERR_OTHER"
+
+
+# -- errhandlers ---------------------------------------------------------
+
+ErrhandlerFn = Callable[[object, BaseException], None]
+
+
+def errors_are_fatal(obj: object, exc: BaseException) -> None:
+    """Default handler: abort the process (MPI_ERRORS_ARE_FATAL)."""
+    raise SystemExit(f"[ompi_tpu] fatal error on {obj!r}: {exc}")
+
+
+def errors_return(obj: object, exc: BaseException) -> None:
+    """MPI_ERRORS_RETURN: propagate to caller as exception (Pythonic)."""
+    raise exc
+
+
+class Errhandler:
+    def __init__(self, fn: ErrhandlerFn, name: str = "user") -> None:
+        self.fn = fn
+        self.name = name
+
+    def __call__(self, obj: object, exc: BaseException) -> None:
+        self.fn(obj, exc)
+
+
+ERRORS_ARE_FATAL = Errhandler(errors_are_fatal, "ERRORS_ARE_FATAL")
+ERRORS_RETURN = Errhandler(errors_return, "ERRORS_RETURN")
+
+
+class HasErrhandler:
+    """Mixin giving objects a settable errhandler (comm/win/file)."""
+
+    _errhandler: Optional[Errhandler] = None
+
+    def get_errhandler(self) -> Errhandler:
+        return self._errhandler or ERRORS_RETURN
+
+    def set_errhandler(self, handler: Errhandler) -> None:
+        self._errhandler = handler
+
+    def _invoke_errhandler(self, exc: BaseException) -> None:
+        self.get_errhandler()(self, exc)

@@ -1,0 +1,87 @@
+"""Output streams with per-component verbosity + show_help messages.
+
+TPU-native equivalent of opal_output (reference: opal/util/output.h,
+opal_output_verbose used throughout e.g. coll_base_comm_select.c:151) and
+opal_show_help (reference: opal/util/show_help.h:35-132 — user-facing,
+deduplicated error text).
+
+Built on the stdlib logging module (idiomatic Python) with a config-var
+controlled verbosity per logical stream: ``<name>_verbose`` config vars map
+to log levels, like the reference's ``--mca coll_base_verbose 30``.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+
+_LOCK = threading.Lock()
+_CONFIGURED = False
+_HELP_SEEN: set[tuple] = set()
+
+
+def _ensure_root() -> None:
+    global _CONFIGURED
+    with _LOCK:
+        if _CONFIGURED:
+            return
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("[ompi_tpu:%(name)s] %(levelname)s %(message)s")
+        )
+        root = logging.getLogger("ompi_tpu")
+        root.addHandler(handler)
+        root.setLevel(logging.WARNING)
+        root.propagate = False
+        _CONFIGURED = True
+
+
+def get_logger(name: str) -> logging.Logger:
+    """Get the logger for a logical stream, e.g. 'coll', 'pml.ob1'."""
+    _ensure_root()
+    return logging.getLogger(f"ompi_tpu.{name}")
+
+
+def set_verbosity(name: str, level: int) -> None:
+    """Set verbosity for a stream. Levels follow the reference convention:
+    0 = errors only, 10 = component selection info, 30+ = debug trace."""
+    _ensure_root()
+    if level >= 30:
+        pylevel = logging.DEBUG
+    elif level >= 10:
+        pylevel = logging.INFO
+    else:
+        pylevel = logging.WARNING
+    logging.getLogger(f"ompi_tpu.{name}").setLevel(pylevel)
+
+
+def register_verbose_var(framework: str) -> None:
+    """Register a `<framework>_base_verbose` config var wired to the stream."""
+    from . import config
+
+    var = config.register(
+        framework,
+        "base",
+        "verbose",
+        type=int,
+        default=0,
+        description=f"Verbosity for the {framework} framework (0/10/30)",
+    )
+    set_verbosity(framework, var.value or 0)
+
+
+def show_help(topic: str, message: str, *args, once: bool = True) -> None:
+    """Emit a user-facing help/error message, deduplicated by (topic,args)
+    like the reference's aggregated show_help."""
+    key = (topic, message, args)
+    with _LOCK:
+        if once and key in _HELP_SEEN:
+            return
+        _HELP_SEEN.add(key)
+    text = message % args if args else message
+    banner = "-" * 70
+    print(
+        f"{banner}\n[ompi_tpu] {topic}:\n{text}\n{banner}",
+        file=sys.stderr,
+    )

@@ -1,0 +1,93 @@
+"""The progress engine: central polling loop for async completion.
+
+TPU-native equivalent of opal_progress (reference:
+opal/runtime/opal_progress.c:223-259 — an array of registered callbacks,
+low-priority callbacks run every 8th call, yield when idle; components
+register on demand, e.g. pml ob1 at pml_ob1_progress.c:63).
+
+On TPU, most asynchrony is owned by JAX's async dispatch: a collective plan
+is enqueued and the returned jax.Array completes on its own. The progress
+engine therefore pumps *host-side* state machines only: p2p matching, DCN
+transport sockets, nonblocking-schedule (libnbc-style) round advancement,
+and user generalized requests.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+# Reference constant: low-priority callbacks every 8th call
+# (opal_progress.c:240-245).
+LOW_PRIORITY_PERIOD = 8
+
+ProgressFn = Callable[[], int]  # returns number of "events" progressed
+
+
+class ProgressEngine:
+    def __init__(self) -> None:
+        self._callbacks: list[ProgressFn] = []
+        self._low_priority: list[ProgressFn] = []
+        self._lock = threading.RLock()
+        self._call_count = 0
+
+    def register(self, fn: ProgressFn, low_priority: bool = False) -> None:
+        with self._lock:
+            target = self._low_priority if low_priority else self._callbacks
+            if fn not in target:
+                target.append(fn)
+
+    def unregister(self, fn: ProgressFn) -> None:
+        with self._lock:
+            if fn in self._callbacks:
+                self._callbacks.remove(fn)
+            if fn in self._low_priority:
+                self._low_priority.remove(fn)
+
+    def progress(self) -> int:
+        """One sweep over registered callbacks; returns events completed."""
+        with self._lock:
+            cbs = list(self._callbacks)
+            self._call_count += 1
+            run_low = (self._call_count % LOW_PRIORITY_PERIOD) == 0
+            lows = list(self._low_priority) if run_low else []
+        events = 0
+        for fn in cbs:
+            events += fn()
+        for fn in lows:
+            events += fn()
+        return events
+
+    def progress_until(
+        self,
+        predicate: Callable[[], bool],
+        timeout: float | None = None,
+    ) -> bool:
+        """Spin the engine until predicate() or timeout. Yields when idle
+        (the reference sched_yield()s, opal_progress.c flow)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while not predicate():
+            events = self.progress()
+            if predicate():
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            if events == 0:
+                time.sleep(0)  # yield the GIL / scheduler
+        return True
+
+
+ENGINE = ProgressEngine()
+
+
+def progress() -> int:
+    return ENGINE.progress()
+
+
+def register(fn: ProgressFn, low_priority: bool = False) -> None:
+    ENGINE.register(fn, low_priority)
+
+
+def unregister(fn: ProgressFn) -> None:
+    ENGINE.unregister(fn)

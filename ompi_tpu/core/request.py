@@ -1,0 +1,221 @@
+"""Request lifecycle: nonblocking-operation handles with wait/test.
+
+TPU-native equivalent of ompi_request_t (reference: ompi/request/request.h,
+req_wait.c:92-141 — completion published via a CAS'd wait_sync object;
+test/wait{any,some,all} in req_test.c/req_wait.c; generalized requests in
+grequest.c; persistent requests via `start`, pml.h:292).
+
+Here a request completes either (a) synchronously at creation (JAX async
+dispatch already enqueued the device work — the result array's readiness is
+the device-side completion), or (b) via the progress engine pumping a
+host-side state machine (`_poll`). `wait()` drains the progress engine; for
+device-backed requests it also blocks on the result array when asked to
+fully materialize.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Sequence
+
+from . import progress as _progress
+from .errors import RequestError
+
+ANY_SOURCE = -1
+ANY_TAG = -1
+
+
+@dataclass
+class Status:
+    """MPI_Status equivalent."""
+
+    source: int = ANY_SOURCE
+    tag: int = ANY_TAG
+    error: Optional[BaseException] = None
+    count: int = 0  # elements transferred
+    cancelled: bool = False
+    extra: dict = field(default_factory=dict)
+
+
+class RequestState(enum.Enum):
+    INACTIVE = "inactive"  # persistent request not started
+    ACTIVE = "active"
+    COMPLETE = "complete"
+    CANCELLED = "cancelled"
+
+
+class Request:
+    """Base nonblocking-operation handle."""
+
+    def __init__(self, *, persistent: bool = False) -> None:
+        self.state = (
+            RequestState.INACTIVE if persistent else RequestState.ACTIVE
+        )
+        self.persistent = persistent
+        self.status = Status()
+        self._result: Any = None
+        self._callbacks: list[Callable[["Request"], None]] = []
+
+    # -- completion -------------------------------------------------------
+
+    def _poll(self) -> bool:
+        """Advance host-side state; return True when complete. Subclasses
+        driving host state machines override this."""
+        return self.state == RequestState.COMPLETE
+
+    def _complete(self, result: Any = None, status: Status | None = None):
+        if self.state == RequestState.COMPLETE:
+            return
+        self._result = result
+        if status is not None:
+            self.status = status
+        self.state = RequestState.COMPLETE
+        for cb in self._callbacks:
+            cb(self)
+
+    def on_complete(self, cb: Callable[["Request"], None]) -> None:
+        if self.state == RequestState.COMPLETE:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    # -- public API -------------------------------------------------------
+
+    @property
+    def done(self) -> bool:
+        return self.state in (RequestState.COMPLETE, RequestState.CANCELLED)
+
+    def test(self) -> tuple[bool, Optional[Status]]:
+        if self.state == RequestState.ACTIVE:
+            _progress.progress()
+            self._poll()
+        if self.done:
+            return True, self.status
+        return False, None
+
+    def wait(self, timeout: float | None = None) -> Status:
+        if self.state == RequestState.INACTIVE:
+            raise RequestError("wait on inactive persistent request")
+        ok = _progress.ENGINE.progress_until(
+            lambda: self._poll() or self.done, timeout
+        )
+        if not ok:
+            raise TimeoutError("request wait timed out")
+        if self.status.error is not None:
+            raise self.status.error
+        return self.status
+
+    def result(self, timeout: float | None = None) -> Any:
+        self.wait(timeout)
+        return self._result
+
+    def cancel(self) -> None:
+        if self.state == RequestState.ACTIVE:
+            self.state = RequestState.CANCELLED
+            self.status.cancelled = True
+
+    def start(self) -> "Request":
+        """(Re)activate a persistent request (MPI_Start)."""
+        if not self.persistent:
+            raise RequestError("start() on non-persistent request")
+        if self.state == RequestState.ACTIVE:
+            raise RequestError("start() on already-active request")
+        self.state = RequestState.ACTIVE
+        self.status = Status()
+        self._start()
+        return self
+
+    def _start(self) -> None:
+        """Subclass hook for persistent re-activation."""
+
+    def free(self) -> None:
+        self._callbacks.clear()
+
+
+class CompletedRequest(Request):
+    """A request born complete (JAX already enqueued the device work)."""
+
+    def __init__(self, result: Any = None, status: Status | None = None):
+        super().__init__()
+        self._complete(result, status)
+
+
+class GeneralizedRequest(Request):
+    """MPI_Grequest equivalent: user supplies a poll function."""
+
+    def __init__(self, poll_fn: Callable[[], tuple[bool, Any]]) -> None:
+        super().__init__()
+        self._poll_fn = poll_fn
+
+    def _poll(self) -> bool:
+        if self.done:
+            return True
+        finished, result = self._poll_fn()
+        if finished:
+            self._complete(result)
+        return self.done
+
+
+# -- collections ----------------------------------------------------------
+
+def wait_all(
+    requests: Sequence[Request], timeout: float | None = None
+) -> list[Status]:
+    def all_done() -> bool:
+        return all(r._poll() or r.done for r in requests)
+
+    if not _progress.ENGINE.progress_until(all_done, timeout):
+        raise TimeoutError("wait_all timed out")
+    out = []
+    for r in requests:
+        if r.status.error is not None:
+            raise r.status.error
+        out.append(r.status)
+    return out
+
+
+def wait_any(
+    requests: Sequence[Request], timeout: float | None = None
+) -> tuple[int, Status]:
+    def any_done() -> bool:
+        return any(r._poll() or r.done for r in requests)
+
+    if not requests:
+        raise RequestError("wait_any on empty request list")
+    if not _progress.ENGINE.progress_until(any_done, timeout):
+        raise TimeoutError("wait_any timed out")
+    for i, r in enumerate(requests):
+        if r.done:
+            if r.status.error is not None:
+                raise r.status.error
+            return i, r.status
+    raise RequestError("unreachable")
+
+
+def wait_some(
+    requests: Sequence[Request], timeout: float | None = None
+) -> list[tuple[int, Status]]:
+    idx, st = wait_any(requests, timeout)
+    out = [(idx, st)]
+    for i, r in enumerate(requests):
+        if i != idx and (r._poll() or r.done):
+            out.append((i, r.status))
+    return out
+
+
+def test_all(requests: Sequence[Request]) -> tuple[bool, list[Status] | None]:
+    _progress.progress()
+    if all(r._poll() or r.done for r in requests):
+        return True, [r.status for r in requests]
+    return False, None
+
+
+def test_any(
+    requests: Sequence[Request],
+) -> tuple[bool, int | None, Status | None]:
+    _progress.progress()
+    for i, r in enumerate(requests):
+        if r._poll() or r.done:
+            return True, i, r.status
+    return False, None, None

@@ -1,0 +1,28 @@
+"""Reduction operators (reference: ompi/op + ompi/mca/op)."""
+
+from .op import (
+    BAND,
+    BOR,
+    BXOR,
+    LAND,
+    LOR,
+    LXOR,
+    MAX,
+    MAXLOC,
+    MIN,
+    MINLOC,
+    NO_OP,
+    PREDEFINED,
+    PROD,
+    REPLACE,
+    SUM,
+    Op,
+    create_op,
+    lookup,
+)
+
+__all__ = [
+    "BAND", "BOR", "BXOR", "LAND", "LOR", "LXOR", "MAX", "MAXLOC",
+    "MIN", "MINLOC", "NO_OP", "PREDEFINED", "PROD", "REPLACE", "SUM",
+    "Op", "create_op", "lookup",
+]

@@ -1,0 +1,244 @@
+"""Reduction operators executed on TPU.
+
+TPU-native equivalent of ompi/op + ompi/mca/op (reference: ompi/op/op.c —
+3-tier dispatch table; ompi/mca/op/avx/op_avx_functions.c:28-66 — SSE/AVX2/
+AVX512 variants per (op × dtype) with runtime CPU-flag dispatch). That
+whole SIMD machinery exists because the reference reduces on the *CPU*;
+here every operator is a jax-traceable combine function executed on the
+MXU/VPU against HBM-resident buffers — the per-dtype specialization is
+XLA's job, and "runtime dispatch" is the plan cache keying on dtype.
+
+Operators work on pytrees (``combine``), so MAXLOC/MINLOC — which reduce
+(value, index) pairs jointly — are ordinary ops over a 2-leaf pytree
+instead of the reference's special struct datatypes (ompi/op/op.h
+MPI_2INT etc.).
+
+User-defined ops (MPI_Op_create) are any jax-traceable binary combine with
+a declared commutativity flag — the tuned decision layer (coll/tuned) uses
+that flag exactly as the reference does (coll_tuned_decision_fixed.c:85-86:
+non-commutative ops take different algorithms).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..core.errors import OpError
+
+Combine = Callable[[Any, Any], Any]
+
+
+class Op:
+    """A reduction operator."""
+
+    def __init__(
+        self,
+        name: str,
+        combine: Combine,
+        *,
+        commutative: bool = True,
+        identity: Optional[Callable[[Any], Any]] = None,
+        xla_reduce: Optional[str] = None,
+        np_combine: Optional[Callable[[Any, Any], Any]] = None,
+        predefined: bool = False,
+    ) -> None:
+        self.name = name
+        self._combine = combine
+        self.commutative = commutative
+        self._identity = identity
+        # Name of the XLA-native all-reduce primitive ('psum'/'pmax'/'pmin')
+        # that computes this op directly over a mesh axis, if any.
+        self.xla_reduce = xla_reduce
+        self._np_combine = np_combine
+        self.predefined = predefined
+
+    def combine(self, a: Any, b: Any) -> Any:
+        """Elementwise combine of two same-structure pytrees (traceable)."""
+        if _is_joint(self):
+            return self._combine(a, b)
+        return jax.tree.map(self._combine, a, b)
+
+    def identity_like(self, x: Any) -> Any:
+        """Identity element matching x's structure (for padding ranks in
+        non-power-of-two recursive algorithms)."""
+        if self._identity is None:
+            raise OpError(f"op {self.name} has no identity element")
+        return jax.tree.map(self._identity, x)
+
+    @property
+    def has_identity(self) -> bool:
+        return self._identity is not None
+
+    def np_reduce(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
+        """Host-side (numpy) combine — used by the datatype engine's
+        reduce_local host path and by tests as the reference oracle."""
+        if self._np_combine is not None:
+            return self._np_combine(a, b)
+        return np.asarray(self._combine(a, b))
+
+    def __call__(self, a: Any, b: Any) -> Any:
+        return self.combine(a, b)
+
+    def __repr__(self) -> str:
+        return f"Op({self.name}, commutative={self.commutative})"
+
+
+_JOINT_OPS: set[int] = set()
+
+
+def _is_joint(op: Op) -> bool:
+    """Joint ops combine the whole pytree at once (MAXLOC/MINLOC)."""
+    return id(op) in _JOINT_OPS
+
+
+def _logical(fn):
+    def wrapped(a, b):
+        out = fn(a != 0, b != 0)
+        return out.astype(a.dtype) if hasattr(a, "dtype") else out
+
+    return wrapped
+
+
+def _int_only(name, fn):
+    def wrapped(a, b):
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating):
+            raise OpError(f"op {name} is undefined on floating types")
+        return fn(a, b)
+
+    return wrapped
+
+
+SUM = Op(
+    "sum", lambda a, b: a + b, identity=jnp.zeros_like, xla_reduce="psum",
+    np_combine=lambda a, b: a + b, predefined=True,
+)
+PROD = Op(
+    "prod", lambda a, b: a * b, identity=jnp.ones_like,
+    np_combine=lambda a, b: a * b, predefined=True,
+)
+MAX = Op(
+    "max", jnp.maximum,
+    identity=lambda x: jnp.full_like(x, _dtype_min(x)),
+    xla_reduce="pmax", np_combine=np.maximum, predefined=True,
+)
+MIN = Op(
+    "min", jnp.minimum,
+    identity=lambda x: jnp.full_like(x, _dtype_max(x)),
+    xla_reduce="pmin", np_combine=np.minimum, predefined=True,
+)
+
+
+def _dtype_min(x):
+    dt = jnp.asarray(x).dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        return -jnp.inf
+    if jnp.issubdtype(dt, jnp.bool_):
+        return False
+    return jnp.iinfo(dt).min
+
+
+def _dtype_max(x):
+    dt = jnp.asarray(x).dtype
+    if jnp.issubdtype(dt, jnp.floating):
+        return jnp.inf
+    if jnp.issubdtype(dt, jnp.bool_):
+        return True
+    return jnp.iinfo(dt).max
+
+
+LAND = Op(
+    "land", _logical(jnp.logical_and),
+    identity=jnp.ones_like,
+    np_combine=lambda a, b: ((a != 0) & (b != 0)).astype(a.dtype),
+    predefined=True,
+)
+LOR = Op(
+    "lor", _logical(jnp.logical_or),
+    identity=jnp.zeros_like,
+    np_combine=lambda a, b: ((a != 0) | (b != 0)).astype(a.dtype),
+    predefined=True,
+)
+LXOR = Op(
+    "lxor", _logical(jnp.logical_xor),
+    identity=jnp.zeros_like,
+    np_combine=lambda a, b: ((a != 0) ^ (b != 0)).astype(a.dtype),
+    predefined=True,
+)
+BAND = Op(
+    "band", _int_only("band", lambda a, b: a & b),
+    identity=lambda x: jnp.full_like(x, -1),
+    np_combine=lambda a, b: a & b, predefined=True,
+)
+BOR = Op(
+    "bor", _int_only("bor", lambda a, b: a | b),
+    identity=jnp.zeros_like,
+    np_combine=lambda a, b: a | b, predefined=True,
+)
+BXOR = Op(
+    "bxor", _int_only("bxor", lambda a, b: a ^ b),
+    identity=jnp.zeros_like,
+    np_combine=lambda a, b: a ^ b, predefined=True,
+)
+
+
+def _maxloc_combine(a, b):
+    av, ai = a
+    bv, bi = b
+    # MPI MAXLOC: larger value wins; ties take the smaller index.
+    take_a = (av > bv) | ((av == bv) & (ai <= bi))
+    return (
+        jnp.where(take_a, av, bv),
+        jnp.where(take_a, ai, bi),
+    )
+
+
+def _minloc_combine(a, b):
+    av, ai = a
+    bv, bi = b
+    take_a = (av < bv) | ((av == bv) & (ai <= bi))
+    return (
+        jnp.where(take_a, av, bv),
+        jnp.where(take_a, ai, bi),
+    )
+
+
+MAXLOC = Op("maxloc", _maxloc_combine, predefined=True)
+MINLOC = Op("minloc", _minloc_combine, predefined=True)
+_JOINT_OPS.add(id(MAXLOC))
+_JOINT_OPS.add(id(MINLOC))
+
+# RMA accumulate ops (osc): REPLACE overwrites, NO_OP reads.
+REPLACE = Op("replace", lambda a, b: b, commutative=False, predefined=True)
+NO_OP = Op("no_op", lambda a, b: a, commutative=False, predefined=True)
+
+PREDEFINED: dict[str, Op] = {
+    op.name: op
+    for op in (
+        SUM, PROD, MAX, MIN, LAND, LOR, LXOR, BAND, BOR, BXOR,
+        MAXLOC, MINLOC, REPLACE, NO_OP,
+    )
+}
+
+
+def create_op(
+    fn: Combine,
+    *,
+    commutative: bool,
+    name: str = "user",
+    identity: Optional[Callable[[Any], Any]] = None,
+) -> Op:
+    """MPI_Op_create equivalent: wrap a jax-traceable binary combine."""
+    return Op(name, fn, commutative=commutative, identity=identity)
+
+
+def lookup(op: "Op | str") -> Op:
+    if isinstance(op, Op):
+        return op
+    got = PREDEFINED.get(str(op).lower())
+    if got is None:
+        raise OpError(f"unknown op {op!r}; known: {sorted(PREDEFINED)}")
+    return got

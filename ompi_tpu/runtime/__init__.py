@@ -1,0 +1,7 @@
+"""Runtime: device discovery, mesh topology, init/finalize
+(reference: ompi/runtime + the PMIx/PRRTE substrate)."""
+
+from . import mesh, proc
+from .proc import Proc
+
+__all__ = ["mesh", "proc", "Proc"]

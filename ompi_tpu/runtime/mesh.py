@@ -1,0 +1,95 @@
+"""Device mesh discovery and topology mapping.
+
+TPU-native replacement for the PMIx modex + hwloc topology discovery
+(reference: ompi/runtime/ompi_mpi_init.c:642-686 modex fence publishing
+transport addresses; opal/mca/hwloc). On TPU the fabric coordinates come
+straight from the runtime: each jax.Device exposes `coords` (its position
+in the physical ICI torus), `process_index` (owning host) and
+`slice_index` — everything the reference's modex round-trips through the
+PMIx server.
+
+Topology-aware grouping (the reference's hierarchical coll/sm + tuned
+split and treematch reordering, SURVEY §2.6) maps here to: ranks sharing a
+`process_index` are host-local; ranks sharing `slice_index` share ICI;
+cross-slice traffic rides DCN.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .proc import Proc, proc_from_device
+
+
+def discover(devices: Optional[Sequence] = None) -> list[Proc]:
+    """Enumerate devices into world-ranked Procs (rank = device order)."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    return [proc_from_device(i, d) for i, d in enumerate(devices)]
+
+
+def comm_mesh(devices: Sequence, axis_name: str = "ranks"):
+    """A 1-D jax Mesh over a communicator's devices (the compiled-collective
+    substrate; rank i == mesh position i)."""
+    import jax
+
+    return jax.sharding.Mesh(np.asarray(devices, dtype=object), (axis_name,))
+
+
+def hosts_of(procs: Sequence[Proc]) -> dict[int, list[Proc]]:
+    """Group procs by owning host process (intra-host = ICI/fast domain)."""
+    out: dict[int, list[Proc]] = {}
+    for p in procs:
+        out.setdefault(p.process_index, []).append(p)
+    return out
+
+
+def slices_of(procs: Sequence[Proc]) -> dict[int, list[Proc]]:
+    """Group procs by TPU slice (intra-slice = ICI; inter-slice = DCN)."""
+    out: dict[int, list[Proc]] = {}
+    for p in procs:
+        out.setdefault(p.slice_index, []).append(p)
+    return out
+
+
+def ici_distance(a: Proc, b: Proc) -> Optional[int]:
+    """Manhattan distance in the ICI torus, if coords are known.
+
+    Used for topology-aware ordering (the treematch analog): ring schedules
+    laid out in coordinate order ride single-hop ICI links.
+    """
+    if a.coords is None or b.coords is None:
+        return None
+    if a.slice_index != b.slice_index:
+        return None
+    return int(sum(abs(x - y) for x, y in zip(a.coords, b.coords)))
+
+
+def ring_order(procs: Sequence[Proc]) -> list[int]:
+    """Order world ranks so consecutive ring neighbors are ICI-close.
+
+    Greedy nearest-neighbor chain over ICI coords; identity order when
+    coords are unavailable (CPU meshes). Reference analog: treematch rank
+    reordering (ompi/mca/topo/treematch) matching comm graph to hardware.
+    """
+    if not procs or procs[0].coords is None:
+        return [p.rank for p in procs]
+    remaining = list(procs)
+    chain = [remaining.pop(0)]
+    while remaining:
+        last = chain[-1]
+        best = min(
+            remaining,
+            key=lambda p: (
+                ici_distance(last, p)
+                if ici_distance(last, p) is not None
+                else 1 << 30
+            ),
+        )
+        remaining.remove(best)
+        chain.append(best)
+    return [p.rank for p in chain]

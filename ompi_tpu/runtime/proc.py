@@ -1,0 +1,52 @@
+"""Per-peer process descriptors.
+
+TPU-native equivalent of ompi_proc_t (reference: ompi/proc/proc.c). In the
+driver model a "proc" (rank) is one TPU device; its descriptor carries the
+modex payload the reference exchanges over PMIx (transport addresses →
+here: device id, platform, ICI coords, host process index, memory stats).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+
+@dataclass(frozen=True)
+class Proc:
+    rank: int  # world rank
+    device: Any  # jax.Device
+    process_index: int  # owning host process (jax.Device.process_index)
+    platform: str  # 'tpu' | 'cpu' | 'gpu'
+    coords: Optional[tuple[int, ...]] = None  # ICI mesh coordinates
+    core_on_chip: Optional[int] = None
+    slice_index: int = 0
+    modex: dict = field(default_factory=dict, hash=False, compare=False)
+
+    @property
+    def is_local(self) -> bool:
+        import jax
+
+        return self.process_index == jax.process_index()
+
+    def __repr__(self) -> str:
+        return (
+            f"Proc(rank={self.rank}, dev={self.device}, "
+            f"host={self.process_index}, coords={self.coords})"
+        )
+
+
+def proc_from_device(rank: int, device) -> Proc:
+    """Build a Proc from a jax.Device — the per-device 'modex' read."""
+    coords = getattr(device, "coords", None)
+    if coords is not None:
+        coords = tuple(coords)
+    return Proc(
+        rank=rank,
+        device=device,
+        process_index=device.process_index,
+        platform=device.platform,
+        coords=coords,
+        core_on_chip=getattr(device, "core_on_chip", None),
+        slice_index=getattr(device, "slice_index", 0) or 0,
+    )

@@ -1,0 +1,269 @@
+"""Core substrate tests: config vars, component selection, counters,
+requests/progress, group ops — mirroring the reference's test/class +
+test/util serial unit suites (SURVEY §4)."""
+
+import os
+
+import pytest
+
+from ompi_tpu.core import config as cfg
+from ompi_tpu.core import component as mca
+from ompi_tpu.core import counters, progress, request
+from ompi_tpu.core.errors import ComponentError
+from ompi_tpu import group as grp
+
+
+@pytest.fixture
+def registry():
+    r = cfg.VarRegistry()
+    r._files_loaded = True  # no file sources in tests
+    return r
+
+
+class TestConfigVars:
+    def test_default(self, registry):
+        v = registry.register("coll", "tuned", "segsize", type=int, default=1 << 20)
+        assert v.value == 1 << 20
+        assert v.source == cfg.VarSource.DEFAULT
+        assert v.full_name == "coll_tuned_segsize"
+
+    def test_env_overrides_default(self, registry):
+        os.environ["OMPITPU_MCA_coll_tuned_x1"] = "42"
+        try:
+            v = registry.register("coll", "tuned", "x1", type=int, default=7)
+            assert v.value == 42
+            assert v.source == cfg.VarSource.ENV
+        finally:
+            del os.environ["OMPITPU_MCA_coll_tuned_x1"]
+
+    def test_file_below_env(self, registry, tmp_path):
+        p = tmp_path / "params.conf"
+        p.write_text("# comment\npml_ob1_eager = 1024\ncoll_tuned_x2 = 5\n")
+        registry.load_param_file(str(p))
+        os.environ["OMPITPU_MCA_coll_tuned_x2"] = "9"
+        try:
+            v = registry.register("coll", "tuned", "x2", type=int, default=1)
+            assert v.value == 9  # ENV beats FILE
+            v2 = registry.register("pml", "ob1", "eager", type=int, default=64)
+            assert v2.value == 1024  # FILE beats DEFAULT
+            assert v2.source == cfg.VarSource.FILE
+        finally:
+            del os.environ["OMPITPU_MCA_coll_tuned_x2"]
+
+    def test_api_set_beats_all(self, registry):
+        v = registry.register("a", "b", "c", type=int, default=1)
+        registry.set("a_b_c", 3)
+        assert v.value == 3
+        assert v.source == cfg.VarSource.API
+
+    def test_bool_parsing(self, registry):
+        v = registry.register("x", "", "flag", type=bool, default=False)
+        registry.set("x_flag", "yes")
+        assert v.value is True
+        registry.set("x_flag", "0")
+        assert v.value is False
+
+    def test_list_parsing(self, registry):
+        v = registry.register("x", "", "lst", type=list, default="a,b")
+        assert v.value == ["a", "b"]
+
+    def test_choices_validation(self, registry):
+        registry.register("x", "", "mode", type=str, default="fast",
+                          choices=("fast", "safe"))
+        with pytest.raises(ValueError):
+            registry.set("x_mode", "bogus")
+
+    def test_readonly(self, registry):
+        registry.register("x", "", "ro", type=int, default=1,
+                          flags=cfg.VarFlag.READONLY)
+        with pytest.raises(PermissionError):
+            registry.set("x_ro", 2)
+
+    def test_dump(self, registry):
+        registry.register("x", "", "d1", type=int, default=1)
+        d = registry.dump()
+        assert any(e["name"] == "x_d1" for e in d)
+
+
+class TestComponents:
+    def _fresh_framework(self, name="testfw"):
+        return mca.Framework(name)
+
+    def test_priority_selection(self):
+        fw = self._fresh_framework("fw1")
+
+        @fw.register
+        class A(mca.Component):
+            NAME = "alpha"
+            PRIORITY = 10
+
+        @fw.register
+        class B(mca.Component):
+            NAME = "beta"
+            PRIORITY = 50
+
+        assert fw.select_one().NAME == "beta"
+        names = [c.NAME for c in fw.select_all()]
+        assert names == ["beta", "alpha"]
+
+    def test_availability_filter(self):
+        fw = self._fresh_framework("fw2")
+
+        @fw.register
+        class A(mca.Component):
+            NAME = "a"
+            PRIORITY = 100
+
+            def available(self, **ctx):
+                return False
+
+        @fw.register
+        class B(mca.Component):
+            NAME = "b"
+            PRIORITY = 1
+
+        assert fw.select_one().NAME == "b"
+
+    def test_user_filter_include_and_negate(self):
+        fw = self._fresh_framework("fw3")
+
+        @fw.register
+        class A(mca.Component):
+            NAME = "a"
+            PRIORITY = 100
+
+        @fw.register
+        class B(mca.Component):
+            NAME = "b"
+            PRIORITY = 1
+
+        cfg.VARS.set("fw3_select", "b")
+        try:
+            assert fw.select_one().NAME == "b"
+        finally:
+            cfg.VARS.set("fw3_select", "")
+        cfg.VARS.set("fw3_select", "^a")
+        try:
+            assert [c.NAME for c in fw.select_all()] == ["b"]
+        finally:
+            cfg.VARS.set("fw3_select", "")
+
+    def test_priority_var_override(self):
+        fw = self._fresh_framework("fw4")
+
+        @fw.register
+        class A(mca.Component):
+            NAME = "a"
+            PRIORITY = 10
+
+        @fw.register
+        class B(mca.Component):
+            NAME = "b"
+            PRIORITY = 20
+
+        cfg.VARS.register("fw4", "a", "priority", type=int, default=10)
+        cfg.VARS.set("fw4_a_priority", 99)
+        assert fw.select_one().NAME == "a"
+
+    def test_no_component_raises(self):
+        fw = self._fresh_framework("fw5")
+        with pytest.raises(ComponentError):
+            fw.select_one()
+
+
+class TestCounters:
+    def test_record_and_session(self):
+        reg = counters.CounterRegistry()
+        reg.record("allreduce_calls")
+        reg.record("allreduce_bytes", 1024)
+        sess = counters.PvarSession(reg)
+        reg.record("allreduce_calls")
+        assert sess.read() == {"allreduce_calls": 1}
+
+    def test_timer(self):
+        reg = counters.CounterRegistry()
+        with reg.timer("t"):
+            pass
+        c = reg.counter("t_seconds")
+        assert c.value >= 0 and c.unit == "seconds"
+
+
+class TestRequests:
+    def test_generalized_request_progress(self):
+        state = {"n": 0}
+
+        def poll():
+            state["n"] += 1
+            return (state["n"] >= 3, "done")
+
+        r = request.GeneralizedRequest(poll)
+        ok, _ = r.test()
+        assert not ok or state["n"] >= 3
+        st = r.wait(timeout=5)
+        assert r.result() == "done"
+        assert st is not None
+
+    def test_wait_all_any(self):
+        reqs = [request.CompletedRequest(i) for i in range(3)]
+        sts = request.wait_all(reqs, timeout=1)
+        assert len(sts) == 3
+        idx, _ = request.wait_any(reqs, timeout=1)
+        assert idx == 0
+
+    def test_persistent_lifecycle(self):
+        r = request.Request(persistent=True)
+        assert r.state == request.RequestState.INACTIVE
+        r.start()
+        r._complete("x")
+        assert r.result() == "x"
+        r.start()  # restart allowed after completion
+        assert r.state == request.RequestState.ACTIVE
+
+    def test_progress_low_priority_period(self):
+        eng = progress.ProgressEngine()
+        hits = {"hi": 0, "lo": 0}
+        eng.register(lambda: hits.__setitem__("hi", hits["hi"] + 1) or 0)
+        eng.register(
+            lambda: hits.__setitem__("lo", hits["lo"] + 1) or 0,
+            low_priority=True,
+        )
+        for _ in range(16):
+            eng.progress()
+        assert hits["hi"] == 16
+        assert hits["lo"] == 2  # every 8th sweep
+
+
+class TestGroup:
+    def test_basic_ops(self):
+        g = grp.Group(range(8))
+        sub = g.incl([1, 3, 5])
+        assert sub.world_ranks == (1, 3, 5)
+        assert sub.rank_of_world(3) == 1
+        assert sub.rank_of_world(0) == grp.UNDEFINED
+        exc = g.excl([0, 7])
+        assert exc.world_ranks == tuple(range(1, 7))
+
+    def test_set_ops(self):
+        a = grp.Group([0, 1, 2, 3])
+        b = grp.Group([2, 3, 4, 5])
+        assert a.union(b).world_ranks == (0, 1, 2, 3, 4, 5)
+        assert a.intersection(b).world_ranks == (2, 3)
+        assert a.difference(b).world_ranks == (0, 1)
+
+    def test_compare(self):
+        a = grp.Group([0, 1, 2])
+        assert a.compare(grp.Group([0, 1, 2])) == grp.IDENT
+        assert a.compare(grp.Group([2, 1, 0])) == grp.SIMILAR
+        assert a.compare(grp.Group([0, 1])) == grp.UNEQUAL
+
+    def test_ranges(self):
+        g = grp.Group(range(16))
+        r = g.range_incl([(0, 6, 2)])
+        assert r.world_ranks == (0, 2, 4, 6)
+        r2 = g.range_excl([(0, 15, 2)])
+        assert r2.world_ranks == tuple(range(1, 16, 2))
+
+    def test_translate(self):
+        a = grp.Group([4, 5, 6, 7])
+        b = grp.Group([6, 7, 8])
+        assert a.translate_ranks([0, 2, 3], b) == [grp.UNDEFINED, 0, 1]
