@@ -1,0 +1,151 @@
+"""Top-level runtime API: init / finalize / world communicators.
+
+TPU-native equivalent of MPI_Init / MPI_Finalize (reference:
+ompi/runtime/ompi_mpi_init.c:384 — the init sequence in SURVEY §3.1).
+The reference's sequence maps as:
+
+- opal_init_util           → core registries import (config/components)
+- ompi_rte_init (PMIx)     → jax backend init (+ jax.distributed when
+                              multi-host; the coordinator is the PMIx
+                              server analog)
+- modex publish/fence      → runtime.mesh.discover(): device coords,
+                              host indices, slice ids straight from the
+                              runtime — no wire exchange needed
+- add_procs                → Proc list construction
+- coll comm select         → Communicator.__init__ vtable merge
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import Optional, Sequence
+
+from .communicator import Communicator
+from .core import config
+from .core.counters import SPC
+from .core.errors import NotInitializedError
+from .core.logging import get_logger
+from .group import Group
+from .runtime import mesh as mesh_mod
+
+logger = get_logger("runtime")
+
+_lock = threading.Lock()
+_state: Optional["_World"] = None
+
+
+class _World:
+    def __init__(self, procs, comm_world, comm_self):
+        self.procs = procs
+        self.comm_world = comm_world
+        self.comm_self = comm_self
+
+
+def init(
+    devices: Optional[Sequence] = None,
+    *,
+    distributed: bool = False,
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Communicator:
+    """Initialize the runtime and return COMM_WORLD.
+
+    `distributed=True` runs jax.distributed.initialize first (multi-host:
+    the coordinator plays the PMIx-server role; all hosts then see the
+    global device set and execute this same driver program).
+    Idempotent: re-init returns the existing world.
+    """
+    global _state
+    with _lock:
+        if _state is not None:
+            return _state.comm_world
+        if distributed:
+            import jax
+
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        procs = mesh_mod.discover(devices)
+        if not procs:
+            raise NotInitializedError("no devices discovered")
+        world_group = Group(range(len(procs)))
+        comm_world = Communicator(world_group, procs, name="WORLD")
+        comm_self = Communicator(Group([0]), procs, name="SELF")
+        _state = _World(procs, comm_world, comm_self)
+        SPC.record("init_calls")
+        logger.info(
+            "initialized: %d ranks over %s",
+            len(procs),
+            {p.platform for p in procs},
+        )
+        return comm_world
+
+
+def initialized() -> bool:
+    return _state is not None
+
+
+def finalize() -> None:
+    """Tear down communicators (MPI_Finalize). Safe to call twice."""
+    global _state
+    with _lock:
+        if _state is None:
+            return
+        from .communicator import live_comms
+
+        for comm in list(live_comms):
+            if not comm._freed:
+                comm.free()
+        _state = None
+
+
+def _world() -> _World:
+    if _state is None:
+        raise NotInitializedError(
+            "ompi_tpu.init() has not been called (or finalize() already was)"
+        )
+    return _state
+
+
+def world() -> Communicator:
+    return _world().comm_world
+
+
+def abort(error_code: int = 1) -> None:
+    """MPI_Abort: kill the job. In the driver model there is one
+    controller process per host; exiting it tears down the device work."""
+    import os
+    import sys
+
+    logger.error("abort(%d) called", error_code)
+    sys.stderr.flush()
+    os._exit(error_code)
+
+
+class _CommProxy:
+    """Module-level COMM_WORLD / COMM_SELF handles that resolve lazily
+    (usable before init; raise cleanly if the runtime is down)."""
+
+    def __init__(self, attr: str) -> None:
+        self._attr = attr
+
+    def _resolve(self) -> Communicator:
+        return getattr(_world(), self._attr)
+
+    def __getattr__(self, name):
+        return getattr(self._resolve(), name)
+
+    def __repr__(self) -> str:
+        if _state is None:
+            return f"<{self._attr} (uninitialized)>"
+        return repr(self._resolve())
+
+
+COMM_WORLD = _CommProxy("comm_world")
+COMM_SELF = _CommProxy("comm_self")
+
+atexit.register(finalize)

@@ -1,0 +1,5 @@
+"""Inter-device transfer layer (reference: opal/mca/btl)."""
+
+from .framework import BTL, Bml, BtlComponent
+
+__all__ = ["BTL", "Bml", "BtlComponent"]

@@ -1,0 +1,134 @@
+"""coll/basic — host-staged linear algorithms (correctness fallback).
+
+TPU-native equivalent of ompi/mca/coll/basic (reference: naive
+linear/log algorithms as the always-available fallback) — and,
+deliberately, of the coll/cuda staging pattern (reference:
+coll_cuda_allreduce.c:44-69 — stage device buffers to host, run the host
+algorithm, copy back). That staging is the anti-pattern the TPU build
+eliminates on the fast path; it is kept here ONLY as the lowest-priority
+oracle: it handles every op/dtype (via the ops' numpy combines), runs
+without compiling a plan, and gives tests an independent reference for
+the fabric components.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+
+from ..core.errors import ArgumentError
+from ..ops import lookup as op_lookup
+from .framework import COLL, CollComponent
+
+
+def _to_host(x):
+    return jax.tree.map(lambda l: np.asarray(l), x)
+
+
+@COLL.register
+class BasicColl(CollComponent):
+    NAME = "basic"
+    PRIORITY = 10
+    DESCRIPTION = "host-staged linear fallbacks (reference: coll/basic)"
+
+    def _put_back(self, comm, arr):
+        return comm.put_rank_major(arr)
+
+    def allreduce(self, comm, x, op):
+        op = op_lookup(op)
+        host = _to_host(x)
+        leaves = jax.tree.leaves(host)
+        n = comm.size
+        for leaf in leaves:
+            if leaf.shape[0] != n:
+                raise ArgumentError(
+                    f"expected rank-major leading dim {n}, got {leaf.shape}"
+                )
+        acc = jax.tree.map(lambda l: l[0], host)
+        for i in range(1, n):
+            ith = jax.tree.map(lambda l, i=i: l[i], host)
+            from ..ops.op import _is_joint
+
+            if _is_joint(op):
+                acc = op._combine(acc, ith)
+            else:
+                acc = jax.tree.map(op.np_reduce, acc, ith)
+        stacked = jax.tree.map(
+            lambda a: np.broadcast_to(np.asarray(a), (n,) + np.shape(a)), acc
+        )
+        return jax.tree.map(lambda s: self._put_back(comm, s), stacked)
+
+    def bcast(self, comm, x, root):
+        host = _to_host(x)
+        out = jax.tree.map(
+            lambda l: np.broadcast_to(l[root], l.shape), host
+        )
+        return jax.tree.map(lambda s: self._put_back(comm, s), out)
+
+    def reduce(self, comm, x, op, root):
+        red = self.allreduce(comm, x, op)
+        return jax.tree.map(lambda l: l[root], red)
+
+    def allgather(self, comm, x):
+        host = np.asarray(_to_host(x))
+        n = comm.size
+        out = np.broadcast_to(host, (n,) + host.shape)
+        return self._put_back(comm, np.ascontiguousarray(out))
+
+    def reduce_scatter_block(self, comm, x, op):
+        op = op_lookup(op)
+        host = np.asarray(_to_host(x))
+        n = comm.size
+        if host.ndim < 2 or host.shape[0] != n or host.shape[1] != n:
+            raise ArgumentError(
+                f"reduce_scatter_block needs (size, size, ...), got "
+                f"{host.shape}"
+            )
+        acc = host[0]
+        for i in range(1, n):
+            acc = op.np_reduce(acc, host[i])
+        # acc[j] is rank j's block.
+        return self._put_back(comm, acc)
+
+    def alltoall(self, comm, x):
+        host = np.asarray(_to_host(x))
+        n = comm.size
+        if host.ndim < 2 or host.shape[0] != n or host.shape[1] != n:
+            raise ArgumentError(
+                f"alltoall needs (size, size, ...), got {host.shape}"
+            )
+        return self._put_back(comm, np.ascontiguousarray(host.swapaxes(0, 1)))
+
+    def gather(self, comm, x, root):
+        host = np.asarray(_to_host(x))
+        return jax.device_put(host, comm.devices[root])
+
+    def scatter(self, comm, x, root):
+        host = np.asarray(_to_host(x))
+        if host.shape[0] != comm.size:
+            raise ArgumentError(
+                f"scatter needs (size, ...), got {host.shape}"
+            )
+        return self._put_back(comm, host)
+
+    def scan(self, comm, x, op):
+        op = op_lookup(op)
+        host = np.asarray(_to_host(x))
+        out = host.copy()
+        for i in range(1, comm.size):
+            out[i] = op.np_reduce(out[i - 1], host[i])
+        return self._put_back(comm, out)
+
+    def exscan(self, comm, x, op):
+        op = op_lookup(op)
+        host = np.asarray(_to_host(x))
+        out = np.zeros_like(host)
+        acc = host[0]
+        for i in range(1, comm.size):
+            out[i] = acc
+            if i < comm.size - 1:
+                acc = op.np_reduce(acc, host[i])
+        return self._put_back(comm, out)
+
+    def barrier(self, comm):
+        return None

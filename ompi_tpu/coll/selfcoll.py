@@ -1,0 +1,60 @@
+"""coll/self — trivial collectives for size-1 communicators.
+
+Reference: ompi/mca/coll/self (1,167 LoC of identity operations). In the
+driver model COMM_SELF-style comms skip plan compilation entirely.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..ops import lookup as op_lookup
+from .framework import COLL, CollComponent
+
+
+@COLL.register
+class SelfColl(CollComponent):
+    NAME = "self"
+    PRIORITY = 100
+    DESCRIPTION = "size-1 communicator fast paths (reference: coll/self)"
+
+    def available(self, comm=None, **_):
+        return comm is not None and comm.size == 1
+
+    def allreduce(self, comm, x, op):
+        return x
+
+    def bcast(self, comm, x, root):
+        return x
+
+    def reduce(self, comm, x, op, root):
+        return jax.tree.map(lambda l: l[0], x)
+
+    def allgather(self, comm, x):
+        return jnp.asarray(x)[:, None]
+
+    def reduce_scatter_block(self, comm, x, op):
+        return jnp.asarray(x)[:, 0]
+
+    def alltoall(self, comm, x):
+        return x
+
+    def gather(self, comm, x, root):
+        return jnp.asarray(x)
+
+    def scatter(self, comm, x, root):
+        return comm.put_rank_major(x)
+
+    def scan(self, comm, x, op):
+        return x
+
+    def exscan(self, comm, x, op):
+        op = op_lookup(op)
+        arr = jnp.asarray(x)
+        if op.has_identity:
+            return op.identity_like(arr)
+        return jnp.zeros_like(arr)
+
+    def barrier(self, comm):
+        return None

@@ -1,0 +1,290 @@
+"""coll/tuned — algorithm decision layer.
+
+TPU-native equivalent of ompi/mca/coll/tuned (reference:
+coll_tuned_decision_fixed.c — fixed rules keyed on communicator size,
+message size and op commutativity; coll_tuned_dynamic_file.c — rules
+loadable from a file; per-op forced-algorithm MCA vars in
+coll_tuned_*_decision.c).
+
+The decision picks among the explicit algorithm space in coll/spmd plus
+the XLA-native lowering. Defaults mirror the reference's fixed rules
+(recursive doubling < 10 KB; ring ≤ 1 MB/rank; segmented ring above, 1 MB
+segments — coll_tuned_decision_fixed.c:45-87) with one TPU-first change:
+when the op maps onto the fabric's native reduction (`prefer_native`,
+default on), XLA's own collective is used — it compiles to the ICI
+schedule the explicit algorithms approximate.
+"""
+
+from __future__ import annotations
+
+import json
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import config
+from ..core.errors import ArgumentError
+from ..core.logging import get_logger
+from ..ops import Op, lookup as op_lookup
+from ..ops.op import _is_joint
+from . import spmd
+from .framework import COLL, CollComponent, compile_plan, rank_major_check
+from .xla import XlaColl, _dtype_key, _leaf_check
+
+logger = get_logger("coll.tuned")
+
+# Reference cutoffs (BASELINE.md): 10,000 B small-message cutoff, 1 MiB
+# ring→segmented switch, 1 MiB segments.
+_V = partial(config.register, "coll", "tuned")
+_small = _V("allreduce_small_cutoff", type=int, default=10_000,
+            description="Allreduce: bytes/rank below which recursive "
+                        "doubling is used (reference: 10000B)")
+_ring_limit = _V("allreduce_ring_limit", type=int, default=1 << 20,
+                 description="Allreduce: max bytes/rank for plain ring "
+                             "before switching to segmented ring")
+_seg_bytes = _V("segment_bytes", type=int, default=1 << 20,
+                description="Segment size for segmented algorithms "
+                            "(reference: 1MiB)")
+_prefer_native = _V("prefer_native", type=bool, default=True,
+                    description="Use XLA-native fabric collectives when "
+                                "the op supports them")
+_rules_file = _V("rules_file", type=str, default="",
+                 description="JSON dynamic-rules file (reference: "
+                             "coll_tuned_dynamic_file.c)")
+_force_allreduce = _V("allreduce_algorithm", type=str, default="",
+                      description="Force an allreduce algorithm by name")
+_force_alltoall = _V("alltoall_algorithm", type=str, default="",
+                     description="Force an alltoall algorithm by name")
+_force_allgather = _V("allgather_algorithm", type=str, default="",
+                      description="Force an allgather algorithm by name")
+_force_bcast = _V("bcast_algorithm", type=str, default="",
+                  description="Force a bcast algorithm by name")
+_alltoall_small = _V("alltoall_small_msg", type=int, default=256,
+                     description="Alltoall: bytes/dest below which bruck "
+                                 "is used")
+_alltoall_large = _V("alltoall_large_msg", type=int, default=32 << 10,
+                     description="Alltoall: bytes/dest above which "
+                                 "pairwise exchange is used")
+
+ALLREDUCE_ALGOS: dict[str, Callable] = {
+    "native": spmd.allreduce_native,
+    "recursive_doubling": spmd.allreduce_recursive_doubling,
+    "ring": spmd.allreduce_ring,
+    "ring_segmented": spmd.allreduce_ring_segmented,
+    "rabenseifner": spmd.allreduce_reduce_scatter_allgather,
+    "nonoverlapping": spmd.allreduce_nonoverlapping,
+    "gather_reduce": spmd._allreduce_gather_reduce,
+}
+
+ALLGATHER_ALGOS: dict[str, Callable] = {
+    "native": spmd.allgather_native,
+    "ring": spmd.allgather_ring,
+    "bruck": spmd.allgather_bruck,
+}
+
+ALLTOALL_ALGOS: dict[str, Callable] = {
+    "native": spmd.alltoall_native,
+    "pairwise": spmd.alltoall_pairwise,
+    "bruck": spmd.alltoall_bruck,
+}
+
+BCAST_ALGOS: dict[str, Callable] = {
+    "native": spmd.bcast_native,
+    "binomial": spmd.bcast_binomial,
+}
+
+
+class Rules:
+    """Dynamic decision rules loaded from a JSON file:
+    {"allreduce": [{"max_bytes": N, "min_ranks": M, "algorithm": "ring"},
+     ...], ...} — first matching entry wins."""
+
+    def __init__(self, path: str) -> None:
+        with open(path, "r", encoding="utf-8") as f:
+            self._rules = json.load(f)
+
+    def decide(self, opname: str, nbytes: int, nranks: int) -> Optional[str]:
+        for rule in self._rules.get(opname, ()):
+            if nbytes > rule.get("max_bytes", float("inf")):
+                continue
+            if nbytes < rule.get("min_bytes", 0):
+                continue
+            if nranks < rule.get("min_ranks", 0):
+                continue
+            if nranks > rule.get("max_ranks", float("inf")):
+                continue
+            return rule["algorithm"]
+        return None
+
+
+_rules_cache: dict[str, Rules] = {}
+
+
+def _rules() -> Optional[Rules]:
+    path = _rules_file.value
+    if not path:
+        return None
+    r = _rules_cache.get(path)
+    if r is None:
+        try:
+            r = Rules(path)
+        except (OSError, ValueError, KeyError) as exc:
+            logger.warning("cannot load rules file %s: %s", path, exc)
+            r = Rules.__new__(Rules)
+            r._rules = {}
+        _rules_cache[path] = r
+    return r
+
+
+def _nbytes(x) -> int:
+    """Bytes per rank of a rank-major pytree (block size, not total)."""
+    total = 0
+    for leaf in jax.tree.leaves(x):
+        arr = jnp.asarray(leaf)
+        total += (arr.size // max(arr.shape[0], 1)) * arr.dtype.itemsize
+    return total
+
+
+def decide_allreduce(op: Op, nbytes: int, nranks: int) -> str:
+    forced = _force_allreduce.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("allreduce", nbytes, nranks)
+        if got:
+            return got
+    if not op.commutative or _is_joint(op):
+        return "gather_reduce"
+    if _prefer_native.value and op.xla_reduce is not None:
+        return "native"
+    if nbytes < _small.value:
+        return "recursive_doubling"
+    if nbytes <= _ring_limit.value:
+        return "ring"
+    return "ring_segmented"
+
+
+def decide_alltoall(nbytes_per_dest: int, nranks: int) -> str:
+    forced = _force_alltoall.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("alltoall", nbytes_per_dest, nranks)
+        if got:
+            return got
+    if nbytes_per_dest <= _alltoall_small.value and nranks >= 8:
+        return "bruck"
+    if nbytes_per_dest >= _alltoall_large.value:
+        return "pairwise"
+    return "native"
+
+
+def decide_allgather(nbytes: int, nranks: int) -> str:
+    forced = _force_allgather.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("allgather", nbytes, nranks)
+        if got:
+            return got
+    return "native"
+
+
+def decide_bcast(nbytes: int, nranks: int) -> str:
+    forced = _force_bcast.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("bcast", nbytes, nranks)
+        if got:
+            return got
+    return "native"
+
+
+@COLL.register
+class TunedColl(XlaColl):
+    """Decision layer over the full algorithm space. Inherits the
+    XLA-native lowering for operations whose decision says 'native'."""
+
+    NAME = "tuned"
+    PRIORITY = 80
+    DESCRIPTION = "algorithm decision layer (reference: coll/tuned)"
+
+    def allreduce(self, comm, x, op):
+        op = op_lookup(op)
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return x
+        algo = decide_allreduce(op, _nbytes(x), comm.size)
+        fn = ALLREDUCE_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown allreduce algorithm {algo!r}; known: "
+                f"{sorted(ALLREDUCE_ALGOS)}"
+            )
+        leaves = jax.tree.leaves(x)
+        multi_leaf = len(leaves) > 1
+        if algo not in ("native", "gather_reduce") and multi_leaf:
+            fn = ALLREDUCE_ALGOS["gather_reduce"]
+            algo = "gather_reduce"
+        key = ("allreduce", algo, op.cache_key, _dtype_key(x))
+        if algo == "ring_segmented":
+            seg_elems = max(
+                1, _seg_bytes.value // jnp.asarray(leaves[0]).dtype.itemsize
+            )
+            per_rank = lambda b: fn(b, "ranks", op, segment_elems=seg_elems)
+            key = key + (seg_elems,)
+        else:
+            per_rank = lambda b: fn(b, "ranks", op)
+        from ..core.counters import SPC
+
+        SPC.record(f"coll_allreduce_algo_{algo}")
+        plan = compile_plan(comm, key, per_rank)
+        return plan(x)
+
+    def alltoall(self, comm, x):
+        x = rank_major_check(comm, x, min_ndim=2)
+        if x.shape[1] != comm.size:
+            raise ArgumentError(
+                f"alltoall needs (size, size, ...) buffer, got {x.shape}"
+            )
+        if comm.size == 1:
+            return x
+        per_dest = (x.size // (comm.size * comm.size)) * x.dtype.itemsize
+        algo = decide_alltoall(per_dest, comm.size)
+        fn = ALLTOALL_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(f"unknown alltoall algorithm {algo!r}")
+        key = ("alltoall", algo, x.shape, str(x.dtype))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks"))
+        return plan(x)
+
+    def allgather(self, comm, x):
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x[:, None]
+        algo = decide_allgather(_nbytes(x), comm.size)
+        fn = ALLGATHER_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(f"unknown allgather algorithm {algo!r}")
+        key = ("allgather", algo, x.shape, str(x.dtype))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks"))
+        return plan(x)
+
+    def bcast(self, comm, x, root):
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return x
+        algo = decide_bcast(_nbytes(x), comm.size)
+        fn = BCAST_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(f"unknown bcast algorithm {algo!r}")
+        key = ("bcast", algo, root, _dtype_key(x))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks", root=root))
+        return plan(x)

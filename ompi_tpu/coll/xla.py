@@ -1,0 +1,171 @@
+"""coll/xla — XLA-native collective component.
+
+The TPU analog of letting the fabric do the work: every operation lowers
+to XLA's own collective primitives (psum / all_gather / psum_scatter /
+all_to_all), which the TPU runtime maps to its ICI-optimal schedules.
+This is the baseline high-performance component; coll/tuned sits above
+it with the explicit algorithm space (reference analog: coll/basic vs
+coll/tuned layering, but here the *basic* fabric path is already
+device-optimal — the inversion SURVEY §2.3 coll/cuda calls out).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import ArgumentError
+from ..ops import lookup as op_lookup
+from . import spmd
+from .framework import COLL, CollComponent, compile_plan, rank_major_check
+
+
+def _leaf_check(comm, x):
+    """Validate every pytree leaf is rank-major; return the pytree."""
+    leaves = jax.tree.leaves(x)
+    if not leaves:
+        raise ArgumentError("empty buffer")
+    for leaf in leaves:
+        if jnp.ndim(leaf) < 1 or jnp.shape(leaf)[0] != comm.size:
+            raise ArgumentError(
+                f"expected rank-major leading dim {comm.size}, got shape "
+                f"{jnp.shape(leaf)}"
+            )
+    return x
+
+
+def _dtype_key(x) -> tuple:
+    return tuple(
+        (jnp.shape(l), str(jnp.asarray(l).dtype)) for l in jax.tree.leaves(x)
+    )
+
+
+@COLL.register
+class XlaColl(CollComponent):
+    NAME = "xla"
+    PRIORITY = 40
+    DESCRIPTION = "XLA-native fabric collectives (psum/all_gather/...)"
+
+    def allreduce(self, comm, x, op):
+        op = op_lookup(op)
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return x
+        key = ("allreduce", "native", op.cache_key, _dtype_key(x))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.allreduce_native(b, "ranks", op)
+        )
+        return plan(x)
+
+    def bcast(self, comm, x, root):
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return x
+        key = ("bcast", "native", root, _dtype_key(x))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.bcast_native(b, "ranks", root=root)
+        )
+        return plan(x)
+
+    def reduce(self, comm, x, op, root):
+        op = op_lookup(op)
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return jax.tree.map(lambda l: l[0], x)
+        key = ("reduce", "native", op.cache_key, _dtype_key(x))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.allreduce_native(b, "ranks", op)
+        )
+        out = plan(x)
+        # Only root's block is the defined result (MPI semantics); slice it.
+        return jax.tree.map(lambda l: l[root], out)
+
+    def allgather(self, comm, x):
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x[:, None]
+        key = ("allgather", "native", x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.allgather_native(b, "ranks")
+        )
+        return plan(x)
+
+    def reduce_scatter_block(self, comm, x, op):
+        op = op_lookup(op)
+        x = rank_major_check(comm, x, min_ndim=2)
+        if x.shape[1] != comm.size:
+            raise ArgumentError(
+                f"reduce_scatter_block needs (size, size, ...) buffer, got "
+                f"{x.shape}"
+            )
+        if comm.size == 1:
+            return x[:, 0]
+        key = ("reduce_scatter_block", "native", op.cache_key, x.shape,
+               str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.reduce_scatter_native(b, "ranks", op)
+        )
+        return plan(x)
+
+    def alltoall(self, comm, x):
+        x = rank_major_check(comm, x, min_ndim=2)
+        if x.shape[1] != comm.size:
+            raise ArgumentError(
+                f"alltoall needs (size, size, ...) buffer, got {x.shape}"
+            )
+        if comm.size == 1:
+            return x
+        key = ("alltoall", "native", x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.alltoall_native(b, "ranks")
+        )
+        return plan(x)
+
+    def gather(self, comm, x, root):
+        out = self.allgather(comm, x)
+        return out[root]
+
+    def scatter(self, comm, x, root):
+        # Scatter is pure data movement: reshard root's (size, ...) buffer
+        # one block per rank. XLA/ICI does the fan-out in the device_put.
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(x)
+        if arr.shape[0] != comm.size:
+            raise ArgumentError(
+                f"scatter needs (size, ...) buffer, got {arr.shape}"
+            )
+        return comm.put_rank_major(arr)
+
+    def scan(self, comm, x, op):
+        op = op_lookup(op)
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x
+        key = ("scan", "native", op.cache_key, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.scan_native(b, "ranks", op)
+        )
+        return plan(x)
+
+    def exscan(self, comm, x, op):
+        op = op_lookup(op)
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return jnp.zeros_like(x)
+        key = ("exscan", "native", op.cache_key, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: spmd.exscan_native(b, "ranks", op)
+        )
+        return plan(x)
+
+    def barrier(self, comm):
+        if comm.size == 1:
+            return
+        key = ("barrier",)
+        plan = compile_plan(
+            comm, key,
+            lambda b: spmd.barrier("ranks") + 0 * b,
+        )
+        token = comm.put_rank_major(jnp.zeros((comm.size,), jnp.int32))
+        jax.block_until_ready(plan(token))

@@ -1,0 +1,423 @@
+"""Communicators: groups of ranks with collective/p2p capability.
+
+TPU-native equivalent of ompi/communicator (reference: comm.c, comm_init.c,
+comm_cid.c). Design mapping:
+
+- A rank is a TPU device; a communicator owns an ordered device list (its
+  group's world ranks index the world device list).
+- The per-communicator collective function table (`reference: c_coll`,
+  ompi/mca/coll/coll.h:629-702) is `self._coll`: per-operation
+  (component, fn) pairs merged by priority at creation
+  (reference: coll_base_comm_select.c:110-152).
+- Context id (CID) allocation: the reference runs a distributed agreement
+  (comm_cid.c:53-147) because each process allocates independently; in
+  the single-controller driver model every host executes the same
+  deterministic program, so a replicated monotonic counter yields
+  identical CIDs on all hosts by construction.
+- Compiled collective plans are cached per (op, algorithm, shape, dtype)
+  — the TPU answer to ob1's latency tricks (SURVEY §7 hard parts:
+  "persistent, pre-compiled collective plans").
+
+Driver-mode buffer convention ("rank-major"): a collective argument is a
+jax.Array whose leading axis is the rank index, sharded one block per
+rank-device over the comm's 1-D mesh. `comm.put_rank_major` builds one.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .core import config
+from .core.attributes import HasAttributes
+from .core.errors import ArgumentError, CommError, RankError
+from .core.info import Info
+from .core.logging import get_logger
+from .group import Group
+
+logger = get_logger("comm")
+
+_cid_counter = itertools.count(0)
+_cid_lock = threading.Lock()
+
+# Every live communicator, for finalize-time teardown (weak: a dropped
+# comm needs no explicit free, matching Python object semantics).
+import weakref
+
+live_comms: "weakref.WeakSet[Communicator]" = weakref.WeakSet()
+
+
+def _next_cid() -> int:
+    with _cid_lock:
+        return next(_cid_counter)
+
+
+class Communicator(HasAttributes):
+    """A communication context over an ordered set of rank-devices."""
+
+    def __init__(
+        self,
+        group: Group,
+        world_procs: Sequence,
+        *,
+        name: str = "",
+        info: Optional[Info] = None,
+        parent_cid: Optional[int] = None,
+    ) -> None:
+        self.group = group
+        self.cid = _next_cid()
+        self.name = name or f"comm{self.cid}"
+        self.info = info or Info()
+        self.parent_cid = parent_cid
+        self._freed = False
+        self._world_procs = world_procs
+        self.procs = [world_procs[r] for r in group.world_ranks]
+        self.devices = [p.device for p in self.procs]
+        self._mesh = None
+        self._plan_cache: dict[tuple, Any] = {}
+        self._coll: dict[str, tuple[Any, Any]] = {}
+        self._pml = None
+        self.topo = None  # attached by topo framework (cart/graph)
+        self._select_frameworks()
+        live_comms.add(self)
+
+    # -- framework selection ---------------------------------------------
+
+    def _select_frameworks(self) -> None:
+        from .coll.framework import select_for_comm as coll_select
+
+        self._coll = coll_select(self)
+
+    # -- basic accessors --------------------------------------------------
+
+    @property
+    def size(self) -> int:
+        return self.group.size
+
+    @property
+    def mesh(self):
+        """1-D jax Mesh over this comm's devices (lazily built)."""
+        if self._mesh is None:
+            from .runtime import mesh as mesh_mod
+
+            if len(set(self.devices)) != len(self.devices):
+                raise CommError(
+                    f"{self.name}: duplicate devices; no mesh available"
+                )
+            self._mesh = mesh_mod.comm_mesh(self.devices)
+        return self._mesh
+
+    def rank_sharding(self):
+        """NamedSharding placing leading-axis block i on rank i's device."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P("ranks"))
+
+    def replicated_sharding(self):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P())
+
+    def put_rank_major(self, value) -> Any:
+        """Place a (size, ...) array so block i lives on rank i's device."""
+        import jax
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(value)
+        if arr.shape[0] != self.size:
+            raise ArgumentError(
+                f"rank-major leading dim {arr.shape[0]} != comm size "
+                f"{self.size}"
+            )
+        if self.size == 1:
+            return jax.device_put(arr, self.devices[0])
+        return jax.device_put(arr, self.rank_sharding())
+
+    def from_rank_values(self, values: Sequence) -> Any:
+        """Stack one array per rank into a rank-major buffer."""
+        import jax.numpy as jnp
+
+        if len(values) != self.size:
+            raise ArgumentError(
+                f"{len(values)} values for comm of size {self.size}"
+            )
+        return self.put_rank_major(jnp.stack([jnp.asarray(v) for v in values]))
+
+    def check_rank(self, rank: int) -> int:
+        if not 0 <= rank < self.size:
+            raise RankError(
+                f"rank {rank} out of range for {self.name} (size {self.size})"
+            )
+        return rank
+
+    def _check_alive(self) -> None:
+        if self._freed:
+            raise CommError(f"{self.name} has been freed")
+
+    # -- collectives (dispatch through the per-comm vtable) ---------------
+
+    def _coll_call(self, opname: str, *args, **kw):
+        self._check_alive()
+        from .core.counters import SPC
+
+        entry = self._coll.get(opname)
+        if entry is None:
+            raise CommError(
+                f"{self.name}: no coll component provides {opname}"
+            )
+        component, fn = entry
+        SPC.record(f"coll_{opname}_calls")
+        return fn(self, *args, **kw)
+
+    def allreduce(self, x, op="sum"):
+        return self._coll_call("allreduce", x, op)
+
+    def bcast(self, x, root: int = 0):
+        return self._coll_call("bcast", x, self.check_rank(root))
+
+    def reduce(self, x, op="sum", root: int = 0):
+        return self._coll_call("reduce", x, op, self.check_rank(root))
+
+    def allgather(self, x):
+        return self._coll_call("allgather", x)
+
+    def reduce_scatter_block(self, x, op="sum"):
+        return self._coll_call("reduce_scatter_block", x, op)
+
+    def alltoall(self, x):
+        return self._coll_call("alltoall", x)
+
+    def gather(self, x, root: int = 0):
+        return self._coll_call("gather", x, self.check_rank(root))
+
+    def scatter(self, x, root: int = 0):
+        return self._coll_call("scatter", x, self.check_rank(root))
+
+    def scan(self, x, op="sum"):
+        return self._coll_call("scan", x, op)
+
+    def exscan(self, x, op="sum"):
+        return self._coll_call("exscan", x, op)
+
+    def barrier(self):
+        return self._coll_call("barrier")
+
+    # Nonblocking variants: JAX async dispatch enqueues the device work
+    # immediately; the request completes when the result array is ready.
+    def _icoll(self, opname: str, *args, **kw):
+        from .coll.framework import DeviceRequest
+
+        result = self._coll_call(opname, *args, **kw)
+        return DeviceRequest(result)
+
+    def iallreduce(self, x, op="sum"):
+        return self._icoll("allreduce", x, op)
+
+    def ibcast(self, x, root: int = 0):
+        return self._icoll("bcast", x, self.check_rank(root))
+
+    def ireduce(self, x, op="sum", root: int = 0):
+        return self._icoll("reduce", x, op, self.check_rank(root))
+
+    def iallgather(self, x):
+        return self._icoll("allgather", x)
+
+    def ireduce_scatter_block(self, x, op="sum"):
+        return self._icoll("reduce_scatter_block", x, op)
+
+    def ialltoall(self, x):
+        return self._icoll("alltoall", x)
+
+    def igather(self, x, root: int = 0):
+        return self._icoll("gather", x, self.check_rank(root))
+
+    def iscatter(self, x, root: int = 0):
+        return self._icoll("scatter", x, self.check_rank(root))
+
+    def iscan(self, x, op="sum"):
+        return self._icoll("scan", x, op)
+
+    def ibarrier(self):
+        return self._icoll("barrier")
+
+    # Persistent collectives (MPI-4 *_init / mpiext pcollreq analog): the
+    # compiled plan IS the persistent schedule; starting it re-runs the
+    # cached executable on new data.
+    def allreduce_init(self, x, op="sum"):
+        from .coll.framework import PersistentColl
+
+        return PersistentColl(self, "allreduce", (op,), x)
+
+    def bcast_init(self, x, root: int = 0):
+        from .coll.framework import PersistentColl
+
+        return PersistentColl(self, "bcast", (self.check_rank(root),), x)
+
+    # -- p2p (delegated to the selected PML) ------------------------------
+
+    @property
+    def pml(self):
+        if self._pml is None:
+            from .pml.framework import select_for_comm as pml_select
+
+            self._pml = pml_select(self)
+        return self._pml
+
+    def send(self, value, dest: int, tag: int = 0, *, source=None):
+        """Send `value` to rank `dest`. The source rank is inferred from
+        the value's device placement, or passed explicitly."""
+        self._check_alive()
+        return self.pml.send(
+            self, value, self.check_rank(dest), tag, source=source
+        )
+
+    def recv(self, source: int = -1, tag: int = -1, *, dest: int):
+        self._check_alive()
+        return self.pml.recv(self, source, tag, dest=dest)
+
+    def isend(self, value, dest: int, tag: int = 0, *, source=None):
+        self._check_alive()
+        return self.pml.isend(
+            self, value, self.check_rank(dest), tag, source=source
+        )
+
+    def irecv(self, source: int = -1, tag: int = -1, *, dest: int):
+        self._check_alive()
+        return self.pml.irecv(self, source, tag, dest=dest)
+
+    def probe(self, source: int = -1, tag: int = -1, *, dest: int):
+        self._check_alive()
+        return self.pml.probe(self, source, tag, dest=dest, blocking=True)
+
+    def iprobe(self, source: int = -1, tag: int = -1, *, dest: int):
+        self._check_alive()
+        return self.pml.probe(self, source, tag, dest=dest, blocking=False)
+
+    def rank(self, rank: int) -> "RankEndpoint":
+        """A rank's-eye view with the MPI-faithful call signatures."""
+        return RankEndpoint(self, self.check_rank(rank))
+
+    # -- construction of derived communicators ----------------------------
+
+    def dup(self, info: Optional[Info] = None) -> "Communicator":
+        self._check_alive()
+        new = Communicator(
+            self.group,
+            self._world_procs,
+            name=f"{self.name}.dup",
+            info=(info or self.info.dup()),
+            parent_cid=self.cid,
+        )
+        self.copy_attrs_to(new)
+        return new
+
+    def create(self, group: Group) -> "Communicator":
+        """MPI_Comm_create: new comm over a subgroup."""
+        self._check_alive()
+        for wr in group.world_ranks:
+            if wr not in self.group:
+                raise ArgumentError(
+                    f"group rank {wr} not in parent {self.name}"
+                )
+        return Communicator(
+            group,
+            self._world_procs,
+            name=f"{self.name}.sub",
+            parent_cid=self.cid,
+        )
+
+    def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+              ) -> dict[int, "Communicator"]:
+        """MPI_Comm_split, driver form: the controller supplies every
+        rank's (color, key); returns {color: communicator}. Color < 0
+        (MPI_UNDEFINED) ranks are excluded."""
+        self._check_alive()
+        if len(colors) != self.size:
+            raise ArgumentError("need one color per rank")
+        keys = list(keys) if keys is not None else list(range(self.size))
+        buckets: dict[int, list[tuple[int, int]]] = {}
+        for r, (c, k) in enumerate(zip(colors, keys)):
+            if c < 0:
+                continue
+            buckets.setdefault(c, []).append((k, r))
+        out = {}
+        for color, members in sorted(buckets.items()):
+            members.sort()
+            g = Group(self.group.world_rank(r) for _, r in members)
+            out[color] = Communicator(
+                g,
+                self._world_procs,
+                name=f"{self.name}.split{color}",
+                parent_cid=self.cid,
+            )
+        return out
+
+    def free(self) -> None:
+        self.free_attrs()
+        self._plan_cache.clear()
+        if self._pml is not None and hasattr(self._pml, "comm_freed"):
+            self._pml.comm_freed(self)
+        self._freed = True
+
+    # -- misc -------------------------------------------------------------
+
+    def set_name(self, name: str) -> None:
+        self.name = name
+
+    def __repr__(self) -> str:
+        return (
+            f"<Communicator {self.name} cid={self.cid} size={self.size}>"
+        )
+
+
+class RankEndpoint:
+    """One rank's view of a communicator: MPI-faithful p2p signatures
+    (send(value, dest, tag) / recv(source, tag)) with the endpoint's rank
+    as the implicit source/destination — the driver-model equivalent of
+    "my rank" inside an SPMD process."""
+
+    def __init__(self, comm: Communicator, rank: int) -> None:
+        self.comm = comm
+        self.rank = rank
+
+    @property
+    def device(self):
+        return self.comm.devices[self.rank]
+
+    def send(self, value, dest: int, tag: int = 0):
+        return self.comm.send(value, dest, tag, source=self.rank)
+
+    def isend(self, value, dest: int, tag: int = 0):
+        return self.comm.isend(value, dest, tag, source=self.rank)
+
+    def recv(self, source: int = -1, tag: int = -1):
+        return self.comm.recv(source, tag, dest=self.rank)
+
+    def irecv(self, source: int = -1, tag: int = -1):
+        return self.comm.irecv(source, tag, dest=self.rank)
+
+    def probe(self, source: int = -1, tag: int = -1):
+        return self.comm.probe(source, tag, dest=self.rank)
+
+    def iprobe(self, source: int = -1, tag: int = -1):
+        return self.comm.iprobe(source, tag, dest=self.rank)
+
+    def sendrecv(self, value, dest: int, source: int = -1, tag: int = 0):
+        req = self.isend(value, dest, tag)
+        out = self.recv(source, tag)
+        req.wait()
+        return out
+
+    def put(self, value):
+        """Place a host value on this rank's device."""
+        import jax
+
+        return jax.device_put(value, self.device)
+
+    def __repr__(self) -> str:
+        return f"<RankEndpoint {self.comm.name}:{self.rank}>"

@@ -56,6 +56,15 @@ class Op:
         self._np_combine = np_combine
         self.predefined = predefined
 
+    @property
+    def cache_key(self) -> str:
+        """Key component for compiled-plan caches: predefined ops are
+        identified by name; user ops by object identity (two user ops may
+        share a name but trace differently)."""
+        if self.predefined:
+            return self.name
+        return f"{self.name}#{id(self)}"
+
     def combine(self, a: Any, b: Any) -> Any:
         """Elementwise combine of two same-structure pytrees (traceable)."""
         if _is_joint(self):

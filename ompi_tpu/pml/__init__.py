@@ -1,0 +1,5 @@
+"""Point-to-point messaging layer (reference: ompi/mca/pml)."""
+
+from .framework import PML, PmlComponent, select_for_comm
+
+__all__ = ["PML", "PmlComponent", "select_for_comm"]

@@ -1,0 +1,36 @@
+"""PML framework: point-to-point messaging layer selection.
+
+Reference: ompi/mca/pml (pml.h:494- module struct; exactly one PML per
+job, pml.h:40-47). Driver-mode: one PML serves all communicators; the
+component is selected once by priority (select_one).
+"""
+
+from __future__ import annotations
+
+from ..core import component as mca
+
+PML = mca.framework("pml", "point-to-point messaging layer")
+
+
+class PmlComponent(mca.Component):
+    """Base class: isend/send/irecv/recv/probe(comm, ...)."""
+
+
+_selected = None
+_registered = False
+
+
+def ensure_components() -> None:
+    global _registered
+    if not _registered:
+        from . import ob1  # noqa: F401 - self-registers
+
+        _registered = True
+
+
+def select_for_comm(comm) -> PmlComponent:
+    global _selected
+    ensure_components()
+    if _selected is None:
+        _selected = PML.select_one(comm=comm)
+    return _selected

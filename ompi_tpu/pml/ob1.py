@@ -1,0 +1,321 @@
+"""pml/ob1 — the matching/protocol engine for point-to-point messaging.
+
+TPU-native equivalent of ompi/mca/pml/ob1 (reference: protocol choice in
+pml_ob1_sendreq.h:385-455 — eager / rendezvous split; receive-side
+matching in pml_ob1_recvfrag.c — per-peer sequence ordering :387-412,
+posted-recv vs unexpected queues :323,771).
+
+Driver-model mapping: the controller issues every rank's sends and
+receives, so the "wire" is the BTL transfer (device-to-device DMA) and
+the matching engine is a host-side state machine:
+
+- envelope = (cid, src, dst, tag, seq); per-(src,dst) sequence numbers
+  enforce MPI's non-overtaking order.
+- eager (payload ≤ btl.eager_limit): the transfer starts at send time;
+  an unmatched arrival parks in the unexpected queue, payload already
+  buffered at the destination — exactly ob1's unexpected eager frag.
+- rendezvous (payload > limit): the payload stays on the source device;
+  the transfer fires when a recv matches — ob1's RNDV/RGET where the
+  receiver's ACK triggers data movement, with zero extra buffering.
+
+Completion is device-side: requests complete when the destination array
+is ready (JAX async dispatch is the progress engine for data; the Python
+engine only pumps the matching state).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..core.counters import SPC
+from ..core.errors import CommError, RankError, TagError
+from ..core.request import ANY_SOURCE, ANY_TAG, Request, Status
+from ..btl.framework import Bml
+from .framework import PML, PmlComponent
+
+
+@dataclass
+class _Envelope:
+    src: int
+    dst: int
+    tag: int
+    nbytes: int
+
+
+class SendRequest(Request):
+    def __init__(self, env: _Envelope) -> None:
+        super().__init__()
+        self.env = env
+        self.status = Status(source=env.src, tag=env.tag, count=env.nbytes)
+        self._payload_dst: Any = None
+
+    def _mark_sent(self, payload_dst: Any) -> None:
+        self._payload_dst = payload_dst
+        self._complete(payload_dst, self.status)
+
+    def _poll(self) -> bool:
+        return self.done
+
+    def wait(self, timeout: float | None = None) -> Status:
+        if not self.done:
+            # A rendezvous send completes only when a recv matches it. In
+            # the single-controller model every recv is issued by this
+            # same driver thread, so an unmatched blocking wait can never
+            # be satisfied — fail fast instead of spinning (the blocking-
+            # probe guard's twin; reference deadlocks instead).
+            raise CommError(
+                f"send {self.env} not matched by any recv: blocking wait "
+                "would deadlock (post the matching recv first)"
+            )
+        return super().wait(timeout)
+
+
+class RecvRequest(Request):
+    def __init__(self, src: int, dst: int, tag: int) -> None:
+        super().__init__()
+        self.want_src = src
+        self.dst = dst
+        self.want_tag = tag
+
+    def _matched(self, env: _Envelope, payload: Any) -> None:
+        self.status = Status(source=env.src, tag=env.tag, count=env.nbytes)
+        self._complete(payload, self.status)
+
+    def _poll(self) -> bool:
+        return self.done
+
+    def wait(self, timeout: float | None = None) -> Status:
+        if not self.done:
+            # Same single-controller deadlock guard as SendRequest.wait:
+            # no concurrent sender exists to match this recv later.
+            raise CommError(
+                f"recv (src={self.want_src}, dst={self.dst}, "
+                f"tag={self.want_tag}) has no matching send: blocking wait "
+                "would deadlock (issue the send first)"
+            )
+        st = super().wait(timeout)
+        # Data completion: block until the transferred arrays are ready.
+        import jax
+
+        if self._result is not None:
+            jax.block_until_ready(self._result)
+        return st
+
+
+@dataclass
+class _PendingSend:
+    env: _Envelope
+    payload_src: Any  # value still on source device (rndv) or dest (eager)
+    eager: bool
+    transferred: Any  # destination-device value once moved
+    request: SendRequest
+    src_proc: Any
+    dst_proc: Any
+    btl: Any
+
+
+class _CommP2P:
+    """Per-communicator matching state. MPI's non-overtaking order falls
+    out of list order: the driver issues sends/recvs sequentially, so
+    arrival order IS send order per (src, dst) — the reference needs
+    explicit per-peer sequence counters (pml_ob1_recvfrag.c:387-412) only
+    because its fragments race over the wire."""
+
+    def __init__(self) -> None:
+        self.unexpected: list[_PendingSend] = []  # arrival order
+        self.posted: list[RecvRequest] = []  # post order
+
+
+def _nbytes_of(value) -> int:
+    import jax
+    import jax.numpy as jnp
+
+    total = 0
+    for leaf in jax.tree.leaves(value):
+        arr = jnp.asarray(leaf)
+        total += arr.size * arr.dtype.itemsize
+    return total
+
+
+@PML.register
+class Ob1Pml(PmlComponent):
+    NAME = "ob1"
+    PRIORITY = 50
+    DESCRIPTION = "matching engine with eager/rndv protocols"
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        self._comm_state: dict[int, _CommP2P] = {}
+        self._bml: dict[int, Bml] = {}
+
+    # -- infrastructure ---------------------------------------------------
+
+    def _state(self, comm) -> _CommP2P:
+        st = self._comm_state.get(comm.cid)
+        if st is None:
+            st = _CommP2P()
+            self._comm_state[comm.cid] = st
+        return st
+
+    def comm_freed(self, comm) -> None:
+        """Drop per-comm matching state (called from Communicator.free);
+        unmatched pending sends' buffers are released with it."""
+        self._comm_state.pop(comm.cid, None)
+        self._bml.pop(comm.cid, None)
+
+    def bml(self, comm) -> Bml:
+        b = self._bml.get(comm.cid)
+        if b is None:
+            b = Bml(comm)
+            self._bml[comm.cid] = b
+        return b
+
+    def _infer_source(self, comm, value, source: Optional[int]) -> int:
+        if source is not None:
+            return comm.check_rank(source)
+        import jax
+
+        leaves = [
+            l for l in jax.tree.leaves(value) if hasattr(l, "devices")
+        ]
+        if leaves:
+            devs = leaves[0].devices()
+            if len(devs) == 1:
+                (dev,) = devs
+                for i, p in enumerate(comm.procs):
+                    if p.device == dev:
+                        return i
+        raise RankError(
+            "cannot infer source rank from value placement; pass source="
+        )
+
+    # -- send path --------------------------------------------------------
+
+    def isend(self, comm, value, dest: int, tag: int,
+              source: Optional[int] = None) -> SendRequest:
+        if tag < 0:
+            raise TagError(f"send tag must be >= 0, got {tag}")
+        src = self._infer_source(comm, value, source)
+        st = self._state(comm)
+        env = _Envelope(
+            src=src, dst=dest, tag=tag, nbytes=_nbytes_of(value)
+        )
+        btl = self.bml(comm).btl_for(src, dest)
+        req = SendRequest(env)
+        eager = env.nbytes <= btl.eager_limit
+        pending = _PendingSend(
+            env=env, payload_src=value, eager=eager, transferred=None,
+            request=req, src_proc=comm.procs[src], dst_proc=comm.procs[dest],
+            btl=btl,
+        )
+        SPC.record("pml_isend_calls")
+        SPC.record("pml_send_bytes", env.nbytes)
+        if eager:
+            # Ship now; parks in the unexpected queue if no recv matches.
+            pending.transferred = btl.transfer(
+                value, pending.src_proc, pending.dst_proc
+            )
+            SPC.record("pml_eager_sends")
+        else:
+            SPC.record("pml_rndv_sends")
+        # Try to match an already-posted recv (order: post order).
+        if not self._match_posted(st, pending):
+            st.unexpected.append(pending)
+        if eager:
+            req._mark_sent(pending.transferred)
+        return req
+
+    def send(self, comm, value, dest: int, tag: int,
+             source: Optional[int] = None):
+        req = self.isend(comm, value, dest, tag, source=source)
+        req.wait()
+        return req
+
+    # -- receive path -----------------------------------------------------
+
+    def irecv(self, comm, source: int, tag: int,
+              dest: Optional[int] = None) -> RecvRequest:
+        if dest is None:
+            raise RankError(
+                "driver-mode recv needs dest= (the receiving rank); or use "
+                "comm.rank(i).recv(...)"
+            )
+        dest = comm.check_rank(dest)
+        if source != ANY_SOURCE:
+            source = comm.check_rank(source)
+        req = RecvRequest(source, dest, tag)
+        st = self._state(comm)
+        SPC.record("pml_irecv_calls")
+        if not self._match_unexpected(st, req):
+            st.posted.append(req)
+        return req
+
+    def recv(self, comm, source: int, tag: int,
+             dest: Optional[int] = None):
+        req = self.irecv(comm, source, tag, dest=dest)
+        req.wait()
+        return req.result()
+
+    # -- matching ---------------------------------------------------------
+
+    @staticmethod
+    def _compatible(req: RecvRequest, env: _Envelope) -> bool:
+        if env.dst != req.dst:
+            return False
+        if req.want_src != ANY_SOURCE and req.want_src != env.src:
+            return False
+        if req.want_tag != ANY_TAG and req.want_tag != env.tag:
+            return False
+        return True
+
+    def _deliver(self, pending: _PendingSend, req: RecvRequest) -> None:
+        if pending.transferred is None:
+            # Rendezvous: move the payload now that the recv is matched.
+            pending.transferred = pending.btl.transfer(
+                pending.payload_src, pending.src_proc, pending.dst_proc
+            )
+            pending.request._mark_sent(pending.transferred)
+        req._matched(pending.env, pending.transferred)
+
+    def _match_posted(self, st: _CommP2P, pending: _PendingSend) -> bool:
+        for i, req in enumerate(st.posted):
+            if self._compatible(req, pending.env):
+                st.posted.pop(i)
+                self._deliver(pending, req)
+                return True
+        return False
+
+    def _match_unexpected(self, st: _CommP2P, req: RecvRequest) -> bool:
+        for i, pending in enumerate(st.unexpected):
+            if self._compatible(req, pending.env):
+                st.unexpected.pop(i)
+                self._deliver(pending, req)
+                return True
+        return False
+
+    # -- probe ------------------------------------------------------------
+
+    def probe(self, comm, source: int, tag: int, *, dest: Optional[int] = None,
+              blocking: bool = True) -> Optional[Status]:
+        if dest is None:
+            raise RankError("driver-mode probe needs dest=")
+        st = self._state(comm)
+        probe_req = RecvRequest(
+            source if source == ANY_SOURCE else comm.check_rank(source),
+            comm.check_rank(dest),
+            tag,
+        )
+        for pending in st.unexpected:
+            if self._compatible(probe_req, pending.env):
+                return Status(
+                    source=pending.env.src,
+                    tag=pending.env.tag,
+                    count=pending.env.nbytes,
+                )
+        if blocking:
+            raise TagError(
+                "blocking probe would deadlock: no matching message and the "
+                "driver controls all sends; use iprobe"
+            )
+        return None

@@ -1,0 +1,298 @@
+"""Driver-mode collective tests: the MPI-style API over COMM_WORLD.
+
+The analog of the reference's single-host multi-rank integration tests
+(SURVEY §4: full stack over loopback) — here the full stack is
+init → communicator → coll component selection → compiled plan → device
+execution on the 8-device virtual mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import ops
+from ompi_tpu.core import config
+from ompi_tpu.core.errors import ArgumentError, CommError, RankError
+
+
+@pytest.fixture(scope="module")
+def world():
+    comm = ompi_tpu.init()
+    yield comm
+    # Leave the runtime up for the other modules: finalize at interpreter
+    # exit (atexit) — MPI-like single init per process.
+
+
+def rank_data(comm, shape=(16,), dtype=np.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    data = rng.standard_normal((comm.size,) + shape).astype(dtype)
+    return data, comm.put_rank_major(data)
+
+
+def test_world_shape(world):
+    assert world.size == 8
+    assert world.name == "WORLD"
+    assert len(world.devices) == 8
+    assert ompi_tpu.COMM_SELF.size == 1
+
+
+def test_allreduce_sum(world):
+    data, x = rank_data(world)
+    out = world.allreduce(x, "sum")
+    expected = data.sum(axis=0)
+    got = np.asarray(out)
+    for r in range(world.size):
+        np.testing.assert_allclose(got[r], expected, rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_forced_algorithms(world):
+    data, x = rank_data(world, seed=1)
+    expected = data.sum(axis=0)
+    for algo in ["ring", "recursive_doubling", "rabenseifner",
+                 "ring_segmented", "nonoverlapping"]:
+        config.VARS.set("coll_tuned_allreduce_algorithm", algo)
+        try:
+            got = np.asarray(world.allreduce(x, "sum"))
+        finally:
+            config.VARS.set("coll_tuned_allreduce_algorithm", "")
+        for r in range(world.size):
+            np.testing.assert_allclose(
+                got[r], expected, rtol=1e-5, atol=1e-5,
+                err_msg=f"algorithm {algo}",
+            )
+
+
+def test_allreduce_vs_basic_oracle(world):
+    """Fabric result must match the host-staged basic component."""
+    from ompi_tpu.coll.framework import COLL
+
+    data, x = rank_data(world, seed=2)
+    fabric = np.asarray(world.allreduce(x, "max"))
+    basic = COLL.component("basic")
+    host = np.asarray(basic.allreduce(world, x, ops.MAX))
+    np.testing.assert_allclose(fabric, host, rtol=1e-6)
+
+
+def test_allreduce_maxloc_pytree(world):
+    vals = np.random.default_rng(3).standard_normal((8, 10)).astype(np.float32)
+    idxs = np.broadcast_to(np.arange(8, dtype=np.int32)[:, None], (8, 10))
+    x = (world.put_rank_major(vals), world.put_rank_major(np.ascontiguousarray(idxs)))
+    out_v, out_i = world.allreduce(x, ops.MAXLOC)
+    np.testing.assert_allclose(np.asarray(out_v)[0], vals.max(0), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(out_i)[0], vals.argmax(0))
+
+
+def test_bcast(world):
+    data, x = rank_data(world, seed=4)
+    out = np.asarray(world.bcast(x, root=3))
+    for r in range(world.size):
+        np.testing.assert_allclose(out[r], data[3], rtol=1e-6)
+
+
+def test_reduce(world):
+    data, x = rank_data(world, seed=5)
+    out = np.asarray(world.reduce(x, "sum", root=2))
+    np.testing.assert_allclose(out, data.sum(0), rtol=1e-5, atol=1e-5)
+
+
+def test_allgather(world):
+    data, x = rank_data(world, shape=(4,), seed=6)
+    out = np.asarray(world.allgather(x))
+    assert out.shape == (8, 8, 4)
+    for r in range(world.size):
+        np.testing.assert_allclose(out[r], data, rtol=1e-6)
+
+
+def test_reduce_scatter_block(world):
+    n = 8
+    data = np.random.default_rng(7).standard_normal((n, n, 3)).astype(np.float32)
+    x = ompi_tpu.COMM_WORLD.put_rank_major(data)
+    out = np.asarray(world.reduce_scatter_block(x, "sum"))
+    expected = data.sum(axis=0)  # (n, 3)
+    np.testing.assert_allclose(out, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_alltoall(world):
+    n = 8
+    data = np.random.default_rng(8).standard_normal((n, n, 2)).astype(np.float32)
+    x = world.put_rank_major(data)
+    out = np.asarray(world.alltoall(x))
+    np.testing.assert_allclose(out, data.swapaxes(0, 1), rtol=1e-6)
+
+
+def test_gather_scatter(world):
+    data, x = rank_data(world, shape=(5,), seed=9)
+    g = np.asarray(world.gather(x, root=1))
+    np.testing.assert_allclose(g, data, rtol=1e-6)
+
+    s = world.scatter(data, root=0)
+    np.testing.assert_allclose(np.asarray(s), data, rtol=1e-6)
+
+
+def test_scan_exscan(world):
+    data, x = rank_data(world, shape=(6,), seed=10)
+    out = np.asarray(world.scan(x, "sum"))
+    np.testing.assert_allclose(out, np.cumsum(data, axis=0), rtol=1e-5,
+                               atol=1e-5)
+    out = np.asarray(world.exscan(x, "sum"))
+    np.testing.assert_allclose(out[0], 0, atol=1e-6)
+    np.testing.assert_allclose(out[1:], np.cumsum(data, axis=0)[:-1],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_barrier(world):
+    world.barrier()  # must not hang or raise
+
+
+def test_nonblocking(world):
+    data, x = rank_data(world, seed=11)
+    req = world.iallreduce(x, "sum")
+    st = req.wait(timeout=30)
+    out = np.asarray(req.result())
+    np.testing.assert_allclose(out[0], data.sum(0), rtol=1e-5, atol=1e-5)
+
+    reqs = [world.iallreduce(x, "sum"), world.ibcast(x, 0), world.ibarrier()]
+    from ompi_tpu.core.request import wait_all
+
+    wait_all(reqs, timeout=30)
+    assert all(r.done for r in reqs)
+
+
+def test_persistent_collective(world):
+    data, x = rank_data(world, seed=12)
+    req = world.allreduce_init(x, "sum")
+    req.start()
+    req.wait()
+    np.testing.assert_allclose(
+        np.asarray(req.result())[0], data.sum(0), rtol=1e-5, atol=1e-5
+    )
+    data2 = data * 2
+    req.bind(world.put_rank_major(data2))
+    req.start()
+    req.wait()
+    np.testing.assert_allclose(
+        np.asarray(req.result())[0], data2.sum(0), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_plan_cache_reuse(world):
+    from ompi_tpu.core.counters import SPC
+
+    data, x = rank_data(world, shape=(32,), seed=13)
+    world.allreduce(x, "sum")
+    before = SPC.counter("coll_plans_compiled").value
+    world.allreduce(x, "sum")  # same shape/dtype/op -> cached plan
+    assert SPC.counter("coll_plans_compiled").value == before
+
+
+def test_dup_split_create(world):
+    dup = world.dup()
+    assert dup.size == world.size and dup.cid != world.cid
+    data, x = rank_data(world, seed=14)
+    out = np.asarray(dup.allreduce(x, "sum"))
+    np.testing.assert_allclose(out[0], data.sum(0), rtol=1e-5, atol=1e-5)
+    dup.free()
+    with pytest.raises(CommError):
+        dup.allreduce(x, "sum")
+
+    halves = world.split(colors=[0, 0, 0, 0, 1, 1, 1, 1])
+    assert set(halves) == {0, 1}
+    lo, hi = halves[0], halves[1]
+    assert lo.size == 4 and hi.size == 4
+    assert [p.rank for p in hi.procs] == [4, 5, 6, 7]
+    sub_data = np.random.default_rng(15).standard_normal((4, 8)).astype(np.float32)
+    sx = lo.put_rank_major(sub_data)
+    out = np.asarray(lo.allreduce(sx, "sum"))
+    np.testing.assert_allclose(out[0], sub_data.sum(0), rtol=1e-5, atol=1e-5)
+
+    sub = world.create(world.group.incl([1, 3, 5]))
+    assert sub.size == 3
+    assert [p.rank for p in sub.procs] == [1, 3, 5]
+
+    # MPI_UNDEFINED color excludes ranks
+    part = world.split(colors=[0, 0, -1, -1, -1, -1, -1, -1])
+    assert part[0].size == 2
+
+
+def test_split_with_keys_reorders(world):
+    out = world.split(colors=[0] * 8, keys=[7, 6, 5, 4, 3, 2, 1, 0])
+    comm = out[0]
+    assert [p.rank for p in comm.procs] == [7, 6, 5, 4, 3, 2, 1, 0]
+
+
+def test_errors(world):
+    data, x = rank_data(world)
+    with pytest.raises(RankError):
+        world.bcast(x, root=99)
+    with pytest.raises(ArgumentError):
+        world.allreduce(jnp.zeros((3, 2)), "sum")  # wrong leading dim
+    with pytest.raises(ArgumentError):
+        world.alltoall(world.put_rank_major(np.zeros((8, 5))))  # not (n,n)
+
+
+def test_self_comm_paths(world):
+    selfc = ompi_tpu.COMM_SELF
+    x = selfc.put_rank_major(np.arange(12, dtype=np.float32).reshape(1, 12))
+    out = np.asarray(selfc.allreduce(x, "sum"))
+    np.testing.assert_allclose(out, np.arange(12).reshape(1, 12))
+    selfc.barrier()
+    g = np.asarray(selfc.allgather(x))
+    assert g.shape == (1, 1, 12)
+
+
+def test_attributes_copied_on_dup(world):
+    from ompi_tpu.core import attributes
+
+    kv = attributes.create_keyval(
+        copy_fn=lambda obj, k, v: (True, v + 1),
+        delete_fn=None,
+    )
+    world.set_attr(kv, 10)
+    dup = world.dup()
+    found, val = dup.get_attr(kv)
+    assert found and val == 11
+    dup.free()
+    world.delete_attr(kv)
+
+
+def test_user_op_plan_cache_not_shared(world):
+    """Two distinct user ops with the same default name must not share a
+    compiled plan."""
+    add = ops.create_op(lambda a, b: a + b, commutative=True)
+    mul = ops.create_op(lambda a, b: a * b, commutative=True)
+    data = np.arange(1, 9, dtype=np.float32).reshape(8, 1)
+    x = world.put_rank_major(data)
+    out_add = np.asarray(world.allreduce(x, add))
+    out_mul = np.asarray(world.allreduce(x, mul))
+    np.testing.assert_allclose(out_add[0], data.sum(0))
+    np.testing.assert_allclose(out_mul[0], data.prod(0))
+
+
+def test_persistent_wait_before_start_raises(world):
+    from ompi_tpu.core.errors import RequestError
+
+    data, x = rank_data(world, seed=20)
+    req = world.allreduce_init(x, "sum")
+    with pytest.raises(RequestError):
+        req.wait()
+
+
+def test_nonblocking_wait_timeout_honored(world):
+    data, x = rank_data(world, seed=21)
+    req = world.iallreduce(x, "sum")
+    req.wait(timeout=30)  # completes well within timeout
+    assert req.done
+
+
+def test_finalize_frees_derived_comms():
+    import ompi_tpu as m
+
+    world = m.init()
+    dup = world.dup()
+    assert not dup._freed
+    m.finalize()
+    assert dup._freed
+    # re-init for following tests in the session
+    m.init()
