@@ -229,8 +229,11 @@ class TunedColl(XlaColl):
                 f"{sorted(ALLREDUCE_ALGOS)}"
             )
         leaves = jax.tree.leaves(x)
-        multi_leaf = len(leaves) > 1
-        if algo not in ("native", "gather_reduce") and multi_leaf:
+        # The explicit single-buffer algorithms (ring, rd, ...) operate
+        # on one plain array; any pytree container (even single-leaf)
+        # routes through the pytree-aware ordered gather+reduce.
+        is_plain_array = hasattr(x, "dtype") and hasattr(x, "shape")
+        if algo not in ("native", "gather_reduce") and not is_plain_array:
             fn = ALLREDUCE_ALGOS["gather_reduce"]
             algo = "gather_reduce"
         key = ("allreduce", algo, op.cache_key, _dtype_key(x))

@@ -72,7 +72,9 @@ class XlaColl(CollComponent):
         x = _leaf_check(comm, x)
         if comm.size == 1:
             return jax.tree.map(lambda l: l[0], x)
-        key = ("reduce", "native", op.cache_key, _dtype_key(x))
+        # Same program as allreduce (root slicing happens outside the
+        # plan) — share its cache entry instead of recompiling.
+        key = ("allreduce", "native", op.cache_key, _dtype_key(x))
         plan = compile_plan(
             comm, key, lambda b: spmd.allreduce_native(b, "ranks", op)
         )
@@ -160,12 +162,14 @@ class XlaColl(CollComponent):
         return plan(x)
 
     def barrier(self, comm):
+        """Returns the fabric token array; the communicator layer blocks
+        on it for barrier() and wraps it for ibarrier()."""
         if comm.size == 1:
-            return
+            return None
         key = ("barrier",)
         plan = compile_plan(
             comm, key,
             lambda b: spmd.barrier("ranks") + 0 * b,
         )
         token = comm.put_rank_major(jnp.zeros((comm.size,), jnp.int32))
-        jax.block_until_ready(plan(token))
+        return plan(token)

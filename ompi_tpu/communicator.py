@@ -205,7 +205,11 @@ class Communicator(HasAttributes):
         return self._coll_call("exscan", x, op)
 
     def barrier(self):
-        return self._coll_call("barrier")
+        token = self._coll_call("barrier")
+        if token is not None:
+            import jax
+
+            jax.block_until_ready(token)
 
     # Nonblocking variants: JAX async dispatch enqueues the device work
     # immediately; the request completes when the result array is ready.
@@ -340,6 +344,10 @@ class Communicator(HasAttributes):
         if len(colors) != self.size:
             raise ArgumentError("need one color per rank")
         keys = list(keys) if keys is not None else list(range(self.size))
+        if len(keys) != self.size:
+            raise ArgumentError(
+                f"need one key per rank: got {len(keys)} for size {self.size}"
+            )
         buckets: dict[int, list[tuple[int, int]]] = {}
         for r, (c, k) in enumerate(zip(colors, keys)):
             if c < 0:

@@ -65,7 +65,7 @@ class Request:
         return self.state == RequestState.COMPLETE
 
     def _complete(self, result: Any = None, status: Status | None = None):
-        if self.state == RequestState.COMPLETE:
+        if self.state in (RequestState.COMPLETE, RequestState.CANCELLED):
             return
         self._result = result
         if status is not None:
@@ -87,6 +87,10 @@ class Request:
         return self.state in (RequestState.COMPLETE, RequestState.CANCELLED)
 
     def test(self) -> tuple[bool, Optional[Status]]:
+        if self.state == RequestState.INACTIVE:
+            # MPI_Test on an inactive persistent request: flag=true,
+            # empty status (MPI-3.1 §3.7.3).
+            return True, None
         if self.state == RequestState.ACTIVE:
             _progress.progress()
             self._poll()

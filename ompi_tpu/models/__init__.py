@@ -1,0 +1,5 @@
+"""Demonstration models exercising the full parallelism stack."""
+
+from . import transformer
+
+__all__ = ["transformer"]

@@ -1,0 +1,52 @@
+"""On-device reduction helpers — the execution engine behind the op
+framework.
+
+TPU-native replacement for the reference's CPU SIMD reduction loops
+(reference: ompi/mca/op/avx/op_avx_functions.c:28-66 — per-(op × dtype)
+AVX512/AVX2/SSE variants with runtime dispatch). Here the "dispatch
+table" is the XLA compile cache: each (op, shape, dtype) combination jits
+once and thereafter runs as a fused VPU/MXU kernel against HBM-resident
+buffers.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from .op import Op, lookup
+
+
+def reduce_local(op: "Op | str", inbuf: Any, inoutbuf: Any) -> Any:
+    """MPI_Reduce_local: combine two buffers on-device
+    (reference: ompi/op + test/datatype/reduce_local.c)."""
+    op = lookup(op)
+    return op.combine(inoutbuf, inbuf)
+
+
+@partial(jax.jit, static_argnums=(1,))
+def _reduce_ranks_sum(x: jax.Array, keep_order: bool) -> jax.Array:
+    return jnp.sum(x, axis=0)
+
+
+def reduce_ranks(x: jax.Array, op: "Op | str") -> jax.Array:
+    """Reduce a (n_ranks, ...) stacked buffer down its leading axis with
+    the op's combine — the compute kernel of every reduction collective
+    (what the reference runs on CPU per segment, SURVEY §3.3 hot loop).
+    """
+    op = lookup(op)
+    if op.xla_reduce == "psum":
+        return _reduce_ranks_sum(x, True)
+    n = x.shape[0]
+    parts = [x[i] for i in range(n)]
+    while len(parts) > 1:
+        nxt = []
+        for i in range(0, len(parts) - 1, 2):
+            nxt.append(op.combine(parts[i], parts[i + 1]))
+        if len(parts) % 2:
+            nxt.append(parts[-1])
+        parts = nxt
+    return parts[0]

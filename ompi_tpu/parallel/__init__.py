@@ -1,0 +1,18 @@
+"""Parallelism strategies built on the collective substrate.
+
+The reference is a communication library with no DP/TP/PP/SP/EP engines;
+SURVEY §2.6 maps each strategy to the comm primitives it is built from.
+This package provides those strategies as first-class components, each
+implemented with the coll/spmd collective library over named mesh axes:
+
+- dp: data parallelism (gradient allreduce — ring/psum family)
+- tp: tensor parallelism (Megatron column/row sharding with
+  allgather / reduce_scatter sequence transitions)
+- sp: sequence/context parallelism (ring attention over ppermute rings)
+- pp: pipeline parallelism (typed edge channels via ppermute shifts)
+- ep: expert parallelism (capacity-based MoE dispatch via all_to_all)
+"""
+
+from . import dp, ep, mesh_utils, pp, sp, tp
+
+__all__ = ["dp", "ep", "mesh_utils", "pp", "sp", "tp"]

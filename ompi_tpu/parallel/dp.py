@@ -1,0 +1,50 @@
+"""Data parallelism: gradient reduction over a mesh axis.
+
+SURVEY §2.6 DP row — the allreduce family (reference:
+coll_base_allreduce.c ring/recursive-doubling/Rabenseifner) applied to
+gradient pytrees. The fabric-native psum is the default; the explicit
+algorithms are selectable for benchmarking (via coll/tuned's config).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ..coll import spmd
+from ..ops import SUM
+
+
+def allreduce_gradients(grads: Any, axis_name: str = "dp") -> Any:
+    """Mean-free allreduce (sum) of a gradient pytree over the dp axis."""
+    return jax.tree.map(
+        lambda g: spmd.allreduce_native(g, axis_name, SUM), grads
+    )
+
+
+def mean_gradients(grads: Any, axis_name: str = "dp") -> Any:
+    """Allreduce-mean of gradients (the usual DP update input)."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    return jax.tree.map(
+        lambda g: spmd.allreduce_native(g, axis_name, SUM) / n, grads
+    )
+
+
+def shard_batch(batch: Any, axis_name: str = "dp"):
+    """Slice a replicated batch to this dp rank's shard (inside shard_map
+    the incoming block is already sharded; this helper is for manual
+    slicing when data arrives replicated)."""
+    from jax import lax
+
+    idx = lax.axis_index(axis_name)
+    n = lax.axis_size(axis_name)
+
+    def slc(x):
+        per = x.shape[0] // n
+        return lax.dynamic_slice_in_dim(x, idx * per, per, axis=0)
+
+    return jax.tree.map(slc, batch)

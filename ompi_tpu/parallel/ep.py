@@ -1,0 +1,94 @@
+"""Expert parallelism: capacity-based MoE dispatch over all_to_all.
+
+SURVEY §2.6 EP row — the reference's alltoallv (vector alltoall,
+coll_base_functions.h:75-76) is the MoE dispatch primitive. TPU-native
+form: static-shape capacity-based dispatch (XLA needs static shapes, so
+ragged alltoallv becomes fixed-capacity buckets with overflow drop — the
+standard Switch/Mixtral formulation) over `lax.all_to_all`.
+
+Experts are sharded over `axis_name`: each of the n ranks owns
+E_local = E_total / n experts. Top-1 routing; gating weight applied on
+combine. Dropped (over-capacity) tokens pass through with zero expert
+contribution (residual connections keep them alive).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..coll import spmd
+
+
+def moe_dispatch_combine(
+    x: jax.Array,  # (T, D) local tokens
+    router_logits: jax.Array,  # (T, E_total)
+    expert_fn: Callable[[int, jax.Array], jax.Array],  # (local_e, (N,D))->(N,D)
+    n_local_experts: int,
+    axis_name: str = "ep",
+    capacity_factor: float = 1.25,
+) -> jax.Array:
+    """Route each token to its top-1 expert (owned by expert_rank =
+    expert // n_local), run the expert, and return combined (T, D).
+    """
+    n = lax.axis_size(axis_name)
+    T, D = x.shape
+    e_total = router_logits.shape[-1]
+    assert e_total == n * n_local_experts
+
+    probs = jax.nn.softmax(router_logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    dest = expert // n_local_experts  # owning rank per token
+    local_e = expert % n_local_experts
+
+    cap = max(1, int(capacity_factor * T / n))
+
+    # Position of each token within its destination bucket.
+    dest_onehot = jax.nn.one_hot(dest, n, dtype=jnp.int32)  # (T, n)
+    pos = jnp.cumsum(dest_onehot, axis=0) - 1  # (T, n)
+    my_pos = jnp.take_along_axis(pos, dest[:, None], axis=-1)[:, 0]  # (T,)
+    keep = my_pos < cap
+
+    # Dispatch buffers: tokens + metadata (local expert id, validity).
+    send = jnp.zeros((n, cap, D), x.dtype)
+    send = send.at[dest, my_pos].add(jnp.where(keep[:, None], x, 0))
+    meta_e = jnp.zeros((n, cap), jnp.int32)
+    meta_e = meta_e.at[dest, my_pos].add(jnp.where(keep, local_e + 1, 0))
+    # meta_e == 0 marks an empty slot; expert id is meta_e - 1.
+
+    recv = spmd.alltoall_native(send, axis_name)  # (n, cap, D)
+    recv_e = spmd.alltoall_native(meta_e[..., None], axis_name)[..., 0]
+
+    flat = recv.reshape(n * cap, D)
+    flat_e = recv_e.reshape(n * cap)
+    out = jnp.zeros_like(flat)
+    for e in range(n_local_experts):
+        mask = (flat_e == e + 1)[:, None]
+        out = out + jnp.where(mask, expert_fn(e, flat), 0)
+
+    # Return the processed tokens to their source ranks and positions.
+    back = spmd.alltoall_native(out.reshape(n, cap, D), axis_name)
+    gathered = back[dest, my_pos]  # (T, D)
+    return jnp.where(keep[:, None], gathered * gate[:, None], 0.0)
+
+
+def aux_load_balance_loss(
+    router_logits: jax.Array, axis_name: str = "ep", n_local_experts: int = 1
+) -> jax.Array:
+    """Switch-style load-balancing auxiliary loss over the global expert
+    set (fraction-routed × mean-prob, allreduced across ep ranks)."""
+    from ..ops import SUM
+
+    probs = jax.nn.softmax(router_logits, axis=-1)  # (T, E)
+    top = jnp.argmax(probs, axis=-1)
+    e_total = probs.shape[-1]
+    frac = jnp.mean(jax.nn.one_hot(top, e_total), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    n = lax.axis_size(axis_name)
+    frac = spmd.allreduce_native(frac, axis_name, SUM) / n
+    mean_prob = spmd.allreduce_native(mean_prob, axis_name, SUM) / n
+    return e_total * jnp.sum(frac * mean_prob)

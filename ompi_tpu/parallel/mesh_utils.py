@@ -1,0 +1,81 @@
+"""Multi-axis device mesh construction for parallelism strategies.
+
+The reference's analog is topo/treematch + hwloc mapping ranks onto
+hardware (SURVEY §2.6 hierarchical row); here the jax Mesh axes ARE the
+communicator structure: each named axis is a family of sub-communicators
+(all ranks differing only along that axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.errors import ArgumentError
+
+
+def factorize(n: int, num_axes: int) -> tuple[int, ...]:
+    """Split n devices into `num_axes` near-balanced power factors,
+    favoring later axes (innermost = fastest-varying = most tightly
+    coupled, where tp wants to live)."""
+    dims = [1] * num_axes
+    remaining = n
+    i = num_axes - 1
+    while remaining > 1 and i >= 0:
+        # Peel the smallest prime factor into axis i, round-robin.
+        for p in (2, 3, 5, 7, 11, 13):
+            if remaining % p == 0:
+                dims[i] *= p
+                remaining //= p
+                break
+        else:
+            dims[i] *= remaining
+            remaining = 1
+        i = i - 1 if i > 0 else num_axes - 1
+    if remaining != 1:
+        dims[-1] *= remaining
+    return tuple(dims)
+
+
+def make_mesh(
+    axis_sizes: dict[str, int],
+    devices: Optional[Sequence] = None,
+):
+    """Build a Mesh with the given axis sizes over the device list.
+
+    Axis order in the dict is mesh-major→minor; sizes must multiply to
+    the device count.
+    """
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    total = 1
+    for s in axis_sizes.values():
+        total *= s
+    if total != len(devices):
+        raise ArgumentError(
+            f"mesh axes {axis_sizes} need {total} devices, have "
+            f"{len(devices)}"
+        )
+    arr = np.asarray(devices, dtype=object).reshape(
+        tuple(axis_sizes.values())
+    )
+    return jax.sharding.Mesh(arr, tuple(axis_sizes))
+
+
+def auto_mesh(
+    n_devices: Optional[int] = None,
+    axes: Sequence[str] = ("dp", "tp"),
+    devices: Optional[Sequence] = None,
+):
+    """Factorize the device count over the requested axis names."""
+    import jax
+
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    dims = factorize(len(devices), len(axes))
+    return make_mesh(dict(zip(axes, dims)), devices)

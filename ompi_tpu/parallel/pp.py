@@ -1,0 +1,74 @@
+"""Pipeline parallelism: typed edge channels over ppermute shifts.
+
+SURVEY §2.6 PP row — the reference's p2p engine with per-peer ordering
+(ob1) and persistent requests is the substrate pipelines are built from;
+the TPU-native form is a static GPipe schedule compiled into the program:
+activations hop stage→stage via `ppermute` (a typed edge channel), and
+the fill/drain bubble is the usual M + P - 1 ticks for M microbatches
+over P stages. The whole schedule is differentiable (ppermute's transpose
+is the reverse hop), so jax.grad performs the backward pipeline
+automatically.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..coll import spmd
+
+
+def pipeline(
+    stage_fn: Callable[[Any, jax.Array], jax.Array],
+    stage_params: Any,
+    microbatches: jax.Array,  # (M, ...) replicated across pp ranks
+    axis_name: str = "pp",
+) -> jax.Array:
+    """Run a GPipe pipeline over the pp axis.
+
+    Every rank applies `stage_fn(stage_params, x)` — its own stage's
+    params — to the microbatch flowing through it, then hands the result
+    to the next stage. Returns the (M, ...) outputs, valid on the LAST
+    stage (zeros elsewhere); combine with `broadcast_from_last` if all
+    stages need them.
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    M = microbatches.shape[0]
+    out_shape = jax.eval_shape(
+        lambda p, x: stage_fn(p, x), stage_params, microbatches[0]
+    )
+    outputs = jnp.zeros((M,) + out_shape.shape, out_shape.dtype)
+    carry = jnp.zeros(out_shape.shape, out_shape.dtype)
+
+    last = n - 1
+    for t in range(M + n - 1):
+        mb_idx = min(t, M - 1)
+        inp = jnp.where(stage == 0, microbatches[mb_idx], carry)
+        h = stage_fn(stage_params, inp)
+        # Collect finished microbatch t-(n-1) on the last stage.
+        done_idx = t - last
+        if done_idx >= 0:
+            outputs = jnp.where(
+                stage == last,
+                outputs.at[done_idx].set(h),
+                outputs,
+            )
+        if t != M + n - 2:
+            carry = spmd.ring_shift(h, axis_name, 1)
+    return outputs
+
+
+def broadcast_from_last(x: jax.Array, axis_name: str = "pp") -> jax.Array:
+    """Broadcast the last stage's value to all pipeline stages."""
+    n = lax.axis_size(axis_name)
+    return spmd.bcast_native(x, axis_name, root=n - 1)
+
+
+def stage_slice(params_all: Any, axis_name: str = "pp") -> Any:
+    """Slice (P, ...) stacked per-stage params to this rank's stage."""
+    stage = lax.axis_index(axis_name)
+    return jax.tree.map(lambda p: jnp.take(p, stage, axis=0), params_all)

@@ -1,0 +1,79 @@
+"""Sequence/context parallelism: ring attention.
+
+SURVEY §2.6 SP row and §5.7 — the reference's ring-pass-with-compute-
+overlap skeleton (allreduce_intra_ring, coll_base_allreduce.c:341) is
+exactly the ring-attention communication pattern: KV blocks circulate the
+ring via single-hop ppermute while each step's attention contribution is
+accumulated with a numerically-stable online softmax. XLA overlaps the
+next hop's DMA with the current block's flash-style compute.
+
+Sequence is sharded over `axis_name`: each rank holds T = S/n tokens.
+Causality is enforced against *global* positions, so results match
+single-device causal attention exactly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..coll import spmd
+
+_NEG = -1e30
+
+
+def ring_attention(
+    q: jax.Array,  # (T, H, Dh) local queries
+    k: jax.Array,  # (T, H, Dh) local keys
+    v: jax.Array,  # (T, H, Dh) local values
+    axis_name: str = "sp",
+    causal: bool = True,
+) -> jax.Array:
+    """Exact attention over the full (sharded) sequence. Returns the
+    (T, H, Dh) outputs for this rank's query block."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    T, H, Dh = q.shape
+    scale = 1.0 / jnp.sqrt(jnp.asarray(Dh, q.dtype))
+
+    q_pos = my * T + jnp.arange(T)  # global positions of my queries
+
+    # Online-softmax accumulators.
+    m = jnp.full((H, T), _NEG, jnp.float32)
+    l = jnp.zeros((H, T), jnp.float32)
+    o = jnp.zeros((H, T, Dh), jnp.float32)
+
+    kb, vb = k, v
+    for step in range(n):
+        src = (my - step) % n  # which rank's KV block we now hold
+        kv_pos = src * T + jnp.arange(T)
+        # (H, Tq, Tk)
+        scores = (
+            jnp.einsum("qhd,khd->hqk", q, kb).astype(jnp.float32) * scale
+        )
+        if causal:
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None], scores, _NEG)
+        blk_max = scores.max(axis=-1)  # (H, Tq)
+        m_new = jnp.maximum(m, blk_max)
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])  # (H, Tq, Tk)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "hqk,khd->hqd", p, vb.astype(jnp.float32)
+        )
+        m = m_new
+        if step != n - 1:
+            kb, vb = spmd.ring_shift((kb, vb), axis_name, 1)
+
+    out = o / jnp.maximum(l, 1e-30)[..., None]  # (H, T, Dh)
+    return out.transpose(1, 0, 2).astype(q.dtype)  # (T, H, Dh)
+
+
+def shard_sequence(x: jax.Array, axis_name: str = "sp") -> jax.Array:
+    """Slice a replicated (S, ...) tensor to this rank's (S/n, ...)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    per = x.shape[0] // n
+    return lax.dynamic_slice_in_dim(x, idx * per, per, axis=0)

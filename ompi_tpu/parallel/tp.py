@@ -1,0 +1,68 @@
+"""Tensor parallelism: Megatron-style column/row sharded matmuls with
+sequence-parallel transitions.
+
+SURVEY §2.6 TP row — allgather / reduce_scatter / alltoall algorithms
+(reference: coll_base_{allgather,reduce_scatter,alltoall}.c) as the
+building blocks of sharded matmul layers:
+
+- activations travel sequence-sharded between blocks (each tp rank holds
+  S/ntp tokens — "sequence parallel" regions);
+- entering a TP region: allgather tokens over tp → full sequence;
+- column-parallel W1 then row-parallel W2 produce partial sums;
+- leaving: reduce_scatter sums the partials AND re-shards the sequence
+  in one fused collective (the Megatron-SP identity:
+  allreduce = allgather ∘ reduce_scatter, split across the region).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..coll import spmd
+from ..ops import SUM
+
+
+def allgather_sequence(x: jax.Array, axis_name: str = "tp") -> jax.Array:
+    """(S/n, D) per rank -> (S, D): gather the sequence shards."""
+    gathered = spmd.allgather_native(x, axis_name)  # (n, S/n, D)
+    return gathered.reshape((-1,) + x.shape[1:])
+
+
+def reduce_scatter_sequence(
+    x: jax.Array, axis_name: str = "tp"
+) -> jax.Array:
+    """(S, D) partial-sum per rank -> (S/n, D): sum partials across tp
+    ranks and keep this rank's sequence shard."""
+    from jax import lax
+
+    n = lax.axis_size(axis_name)
+    blocked = x.reshape((n, -1) + x.shape[1:])  # (n, S/n, D)
+    return spmd.reduce_scatter_native(blocked, axis_name, SUM)
+
+
+def column_parallel(x: jax.Array, w: jax.Array, axis_name: str = "tp"):
+    """x @ w with w column-sharded: each rank computes its feature slice.
+    Input must be full (allgathered); output is feature-sharded."""
+    return x @ w
+
+
+def row_parallel(x: jax.Array, w: jax.Array, axis_name: str = "tp"):
+    """x @ w with w row-sharded: input is feature-sharded; output is a
+    partial sum awaiting reduce(_scatter)."""
+    return x @ w
+
+
+def tp_mlp(
+    x_seq_sharded: jax.Array,
+    w1: jax.Array,  # (D, F/n) column shard
+    w2: jax.Array,  # (F/n, D) row shard
+    axis_name: str = "tp",
+    activation=jax.nn.gelu,
+) -> jax.Array:
+    """Full Megatron-SP MLP: allgather -> col-parallel -> act ->
+    row-parallel -> reduce_scatter. In: (S/n, D). Out: (S/n, D)."""
+    full = allgather_sequence(x_seq_sharded, axis_name)  # (S, D)
+    h = activation(column_parallel(full, w1, axis_name))  # (S, F/n)
+    partial = row_parallel(h, w2, axis_name)  # (S, D) partial
+    return reduce_scatter_sequence(partial, axis_name)  # (S/n, D)

@@ -261,6 +261,10 @@ class Ob1Pml(PmlComponent):
 
     @staticmethod
     def _compatible(req: RecvRequest, env: _Envelope) -> bool:
+        from ..core.request import RequestState
+
+        if req.state is not RequestState.ACTIVE:
+            return False  # cancelled/completed recvs never match
         if env.dst != req.dst:
             return False
         if req.want_src != ANY_SOURCE and req.want_src != env.src:
@@ -279,6 +283,9 @@ class Ob1Pml(PmlComponent):
         req._matched(pending.env, pending.transferred)
 
     def _match_posted(self, st: _CommP2P, pending: _PendingSend) -> bool:
+        from ..core.request import RequestState
+
+        st.posted = [r for r in st.posted if r.state is RequestState.ACTIVE]
         for i, req in enumerate(st.posted):
             if self._compatible(req, pending.env):
                 st.posted.pop(i)

@@ -180,3 +180,14 @@ def test_comm_free_drops_pml_state(world):
     assert dup.cid in pml._comm_state
     dup.free()
     assert dup.cid not in pml._comm_state
+
+
+def test_cancelled_recv_does_not_steal_message(world):
+    r0, r1 = world.rank(0), world.rank(1)
+    req = r1.irecv(source=0, tag=555)
+    req.cancel()
+    assert req.status.cancelled
+    r0.send(r0.put(np.float32(42.0)), dest=1, tag=555)
+    out = r1.recv(source=0, tag=555)  # real recv gets the payload
+    assert float(out) == 42.0
+    assert req.result() is None or req.status.cancelled

@@ -1,0 +1,24 @@
+"""Finalize/re-init lifecycle. Named zz_ so it collects last: finalize
+frees the world communicator other modules' module-scoped fixtures hold.
+"""
+
+import ompi_tpu
+
+
+def test_finalize_frees_derived_comms():
+    world = ompi_tpu.init()
+    dup = world.dup()
+    assert not dup._freed
+    ompi_tpu.finalize()
+    assert dup._freed
+    assert not ompi_tpu.initialized()
+
+
+def test_reinit_after_finalize():
+    world = ompi_tpu.init()
+    assert world.size >= 1
+    import numpy as np
+
+    data = np.ones((world.size, 4), np.float32)
+    out = np.asarray(world.allreduce(world.put_rank_major(data), "sum"))
+    assert out[0][0] == world.size
