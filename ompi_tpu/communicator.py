@@ -172,6 +172,17 @@ class Communicator(HasAttributes):
             )
         component, fn = entry
         SPC.record(f"coll_{opname}_calls")
+        from .monitoring import MONITOR
+
+        if MONITOR.enabled:
+            nbytes = 0
+            if args:
+                import jax
+
+                for leaf in jax.tree.leaves(args[0]):
+                    if hasattr(leaf, "nbytes"):
+                        nbytes += leaf.nbytes
+            MONITOR.record_coll(self.cid, opname, nbytes)
         return fn(self, *args, **kw)
 
     def allreduce(self, x, op="sum"):

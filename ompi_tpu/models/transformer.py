@@ -250,28 +250,39 @@ def _forward_loss(params, tokens, targets, cfg: ModelConfig):
 
     npp = lax.axis_size("pp")
     stage = lax.axis_index("pp")
-    # Only the last stage's activations are real; mask then share.
+    ndp = lax.axis_size("dp")
+    # Only the last stage's activations are real; mask the others. This
+    # is the LOCAL loss share: no collective here — differentiating a
+    # psum under shard_map (rep-checking off) multiplies cotangents by
+    # the group size, so the cross-rank reduction of both loss and
+    # grads happens explicitly outside the grad (_sync_grads / the
+    # caller's psum), keeping per-rank cotangents exactly 1.
     local_sum = jnp.where(stage == npp - 1, local_sum, 0.0)
-    total = lax.psum(lax.psum(local_sum, "tp"), "pp")
-    total = lax.pmean(total, "dp")
-    ntokens = B * S
-    return total / ntokens
+    ntokens_global = B * S * ndp
+    return local_sum / ntokens_global
 
 
 def _sync_grads(grads, cfg: ModelConfig):
-    """Apply the gradient synchronization rules (module docstring)."""
+    """Apply the gradient synchronization rules (module docstring).
+
+    The local loss already carries the 1/(global tokens) normalization,
+    so every cross-rank combination is a SUM: over dp for all params
+    (each dp rank saw a batch shard), over tp for tp-replicated params
+    (each tp rank saw a sequence shard), over pp for the stage-shared
+    top-level params (only one stage's copy received gradient).
+    """
     out = {}
     for name in ("embed", "pos", "head", "ln_f"):
         g = grads[name]
         g = lax.psum(g, "tp")
         g = lax.psum(g, "pp")
-        g = lax.pmean(g, "dp")
+        g = lax.psum(g, "dp")
         out[name] = g
     blocks = {}
     for name, g in grads["blocks"].items():
         if name in _TP_REPLICATED:
             g = lax.psum(g, "tp")
-        g = lax.pmean(g, "dp")
+        g = lax.psum(g, "dp")
         blocks[name] = g
     out["blocks"] = blocks
     return out
@@ -286,13 +297,14 @@ def build_train_step(cfg: ModelConfig, mesh):
     specs = param_specs(cfg)
 
     def per_rank(params, tokens, targets):
-        # stage axis arrives as a single-stage block; strip the leading
-        # pp-sharded axis down to this rank's view where needed is done
-        # inside via stage_slice on a (1, L, ...) -> squeeze.
-        loss, grads = jax.value_and_grad(
+        local_loss, grads = jax.value_and_grad(
             lambda p: _forward_loss(p, tokens, targets, cfg)
         )(params)
         grads = _sync_grads(grads, cfg)
+        # Reported loss: sum the local shares OUTSIDE the grad.
+        loss = lax.psum(
+            lax.psum(lax.psum(local_loss, "tp"), "pp"), "dp"
+        )
         new_params = jax.tree.map(
             lambda p, g: (p - cfg.lr * g).astype(p.dtype), params, grads
         )
@@ -338,7 +350,8 @@ def build_forward(cfg: ModelConfig, mesh):
     def per_rank(params, tokens, targets):
         params = dict(params)
         params["blocks"] = jax.tree.map(lambda l: l[0], params["blocks"])
-        return _forward_loss(params, tokens, targets, cfg)
+        local = _forward_loss(params, tokens, targets, cfg)
+        return lax.psum(lax.psum(lax.psum(local, "tp"), "pp"), "dp")
 
     fn = jax.shard_map(
         per_rank,

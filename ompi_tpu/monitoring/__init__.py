@@ -1,0 +1,6 @@
+"""Monitoring / profiling interposition (reference:
+ompi/mca/common/monitoring + PERUSE + SPC)."""
+
+from .monitoring import MONITOR, Monitoring, profile_api, profiled
+
+__all__ = ["MONITOR", "Monitoring", "profile_api", "profiled"]

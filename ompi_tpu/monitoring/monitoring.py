@@ -1,0 +1,153 @@
+"""Monitoring: per-peer traffic accounting + profiling interposition.
+
+TPU-native equivalent of the reference's monitoring components
+(reference: ompi/mca/common/monitoring/common_monitoring.c — pml/coll/
+osc interposition recording per-peer bytes and message counts,
+internal vs external traffic, dumped at finalize or queried via MPI_T;
+README:27-60) and of PERUSE request-lifecycle hooks (ompi/peruse).
+
+The pml (ob1) and coll layers call into the singleton below on every
+operation when enabled; `flush()` renders the same per-peer matrix the
+reference dumps. PMPI-style interposition — wrapping the public API —
+is `profile_api()`, the functools analog of the weak-symbol shim
+(reference: ompi/mpi/c/allreduce.c:36-41).
+"""
+
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from collections import defaultdict
+from typing import Callable, Optional
+
+from ..core import config
+from ..core.counters import SPC
+
+_enabled = config.register(
+    "monitoring", "base", "enable", type=bool, default=False,
+    description="Record per-peer p2p/coll/osc traffic matrices",
+)
+
+
+class Monitoring:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.reset()
+
+    @property
+    def enabled(self) -> bool:
+        return _enabled.value
+
+    def enable(self, on: bool = True) -> None:
+        config.VARS.set("monitoring_base_enable", on)
+
+    def reset(self) -> None:
+        with getattr(self, "_lock", threading.Lock()):
+            # (cid, src, dst) -> [messages, bytes]
+            self.p2p = defaultdict(lambda: [0, 0])
+            # (cid, opname) -> [calls, bytes]
+            self.coll = defaultdict(lambda: [0, 0])
+            # (cid, origin, target, kind) -> [ops, bytes]
+            self.osc = defaultdict(lambda: [0, 0])
+
+    # -- recording hooks ---------------------------------------------------
+
+    def record_p2p(self, cid: int, src: int, dst: int, nbytes: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self.p2p[(cid, src, dst)]
+            ent[0] += 1
+            ent[1] += nbytes
+
+    def record_coll(self, cid: int, opname: str, nbytes: int) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self.coll[(cid, opname)]
+            ent[0] += 1
+            ent[1] += nbytes
+
+    def record_osc(self, cid: int, target: int, kind: str, nbytes: int
+                   ) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            ent = self.osc[(cid, 0, target, kind)]
+            ent[0] += 1
+            ent[1] += nbytes
+
+    # -- reporting ---------------------------------------------------------
+
+    def peer_matrix(self, comm_size: int, cid: Optional[int] = None
+                    ) -> list[list[int]]:
+        """Bytes sent src->dst (the reference's dump format)."""
+        mat = [[0] * comm_size for _ in range(comm_size)]
+        with self._lock:
+            for (c, src, dst), (_, nbytes) in self.p2p.items():
+                if cid is not None and c != cid:
+                    continue
+                if src < comm_size and dst < comm_size:
+                    mat[src][dst] += nbytes
+        return mat
+
+    def flush(self) -> dict:
+        with self._lock:
+            return {
+                "p2p": {
+                    f"{c}:{s}->{d}": tuple(v)
+                    for (c, s, d), v in self.p2p.items()
+                },
+                "coll": {
+                    f"{c}:{op}": tuple(v)
+                    for (c, op), v in self.coll.items()
+                },
+                "osc": {
+                    f"{c}:{o}->{t}:{k}": tuple(v)
+                    for (c, o, t, k), v in self.osc.items()
+                },
+            }
+
+
+MONITOR = Monitoring()
+
+
+# -- PMPI-style API interposition -------------------------------------------
+
+_PROFILE_HOOKS: list[Callable] = []
+
+
+def profile_api(hook: Callable[[str, float], None]) -> Callable[[], None]:
+    """Register a hook(name, seconds) called after every profiled public
+    API call; returns an unregister function. The PMPI shim analog."""
+    _PROFILE_HOOKS.append(hook)
+
+    def unregister() -> None:
+        if hook in _PROFILE_HOOKS:
+            _PROFILE_HOOKS.remove(hook)
+
+    return unregister
+
+
+def profiled(name: str):
+    """Decorator: time a public API function and feed profile hooks
+    (and an SPC timer)."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*a, **kw):
+            if not _PROFILE_HOOKS:
+                return fn(*a, **kw)
+            t0 = time.perf_counter()
+            try:
+                return fn(*a, **kw)
+            finally:
+                dt = time.perf_counter() - t0
+                SPC.counter(f"{name}_seconds", unit="seconds").add(dt)
+                for hook in list(_PROFILE_HOOKS):
+                    hook(name, dt)
+
+        return wrapper
+
+    return deco

@@ -1,0 +1,12 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference's hot paths are compiled C (SURVEY §2 [native] tags); here
+the equivalents are C++ shared objects built on demand with the system
+toolchain and loaded through ctypes (pybind11 is not in the image). Each
+binding degrades gracefully to a pure-Python path when the toolchain is
+unavailable, and the selection is observable via `available()`.
+"""
+
+from .build import available, get_lib
+
+__all__ = ["available", "get_lib"]

@@ -1,0 +1,110 @@
+"""Build-on-demand loader for the native shared library.
+
+Compiles ompi_tpu/native/src/*.cc into one libompi_tpu_native.so with
+the system g++ the first time it is needed, caches it next to the
+sources, and exposes the ctypes handle. Controlled by the
+`native_base_enable` config var (so pure-Python fallbacks are testable).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+from pathlib import Path
+from typing import Optional
+
+from ..core import config
+from ..core.logging import get_logger
+
+logger = get_logger("native")
+
+_SRC_DIR = Path(__file__).parent / "src"
+_BUILD_DIR = Path(__file__).parent / "build"
+
+_enable = config.register(
+    "native", "base", "enable", type=bool, default=True,
+    description="Build/use the native C++ kernels (fallback: pure Python)",
+)
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _source_digest(sources: list[Path]) -> str:
+    h = hashlib.sha256()
+    for s in sorted(sources):
+        h.update(s.read_bytes())
+    return h.hexdigest()[:16]
+
+
+def _build() -> Optional[Path]:
+    sources = sorted(_SRC_DIR.glob("*.cc"))
+    if not sources:
+        return None
+    digest = _source_digest(sources)
+    out = _BUILD_DIR / f"libompi_tpu_native-{digest}.so"
+    if out.exists():
+        return out
+    _BUILD_DIR.mkdir(parents=True, exist_ok=True)
+    cmd = [
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "-o", str(out),
+    ] + [str(s) for s in sources]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, text=True, timeout=120
+        )
+    except (subprocess.CalledProcessError, OSError,
+            subprocess.TimeoutExpired) as exc:
+        detail = getattr(exc, "stderr", "") or str(exc)
+        logger.warning("native build failed: %s", detail)
+        return None
+    # Drop stale builds.
+    for old in _BUILD_DIR.glob("libompi_tpu_native-*.so"):
+        if old != out:
+            try:
+                old.unlink()
+            except OSError:
+                pass
+    logger.info("built %s", out.name)
+    return out
+
+
+def get_lib() -> Optional[ctypes.CDLL]:
+    """The native library handle, or None (build failure / disabled)."""
+    global _lib, _tried
+    if not _enable.value:
+        return None
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        path = _build()
+        if path is None:
+            return None
+        try:
+            lib = ctypes.CDLL(str(path))
+        except OSError as exc:
+            logger.warning("cannot load %s: %s", path, exc)
+            return None
+        LL = ctypes.c_longlong
+        for name in ("ompi_tpu_pack", "ompi_tpu_unpack"):
+            fn = getattr(lib, name)
+            fn.restype = LL
+            fn.argtypes = [
+                ctypes.c_void_p,  # user buffer
+                ctypes.POINTER(LL), LL,  # segs, nsegs
+                LL, LL, LL,  # extent, elem_size, count
+                LL,  # position
+                ctypes.c_void_p, LL,  # stream, max_bytes
+            ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return get_lib() is not None

@@ -1,0 +1,107 @@
+// Native pack/unpack kernels for the datatype convertor.
+//
+// TPU-native equivalent of the reference's hot copy loops
+// (reference: opal/datatype/opal_datatype_pack.c / _unpack.c — the
+// per-fragment memcpy state machine driven by the convertor). The
+// Python convertor owns the resumable position bookkeeping; these
+// kernels do the byte movement for host-resident buffers: walk the
+// per-element (offset, length) segment table from an arbitrary packed
+// position, memcpy up to max_bytes, and return the bytes moved.
+//
+// Built as a plain shared object, bound via ctypes (no pybind11 in the
+// image). Layout contract: segs = [off0, len0, off1, len1, ...] within
+// one datatype element; elements repeat at `extent` bytes; packed
+// stream is the concatenation of all segments of all `count` elements.
+
+#include <cstring>
+
+extern "C" {
+
+// Copy from a (possibly non-contiguous) user buffer into a packed
+// stream. Returns bytes written to dst.
+long long ompi_tpu_pack(
+    const char* src,
+    const long long* segs, long long nsegs,
+    long long extent, long long elem_size, long long count,
+    long long position, char* dst, long long max_bytes) {
+  if (max_bytes <= 0 || position < 0) return 0;
+  long long total = elem_size * count;
+  if (position >= total) return 0;
+  if (position + max_bytes > total) max_bytes = total - position;
+
+  long long elem = position / elem_size;
+  long long rem = position % elem_size;
+
+  // Find the starting segment within the element.
+  long long seg = 0;
+  while (seg < nsegs && rem >= segs[2 * seg + 1]) {
+    rem -= segs[2 * seg + 1];
+    ++seg;
+  }
+
+  long long written = 0;
+  while (written < max_bytes && elem < count) {
+    const char* ebase = src + elem * extent;
+    for (; seg < nsegs && written < max_bytes; ++seg) {
+      long long off = segs[2 * seg] + rem;
+      long long len = segs[2 * seg + 1] - rem;
+      rem = 0;
+      if (len > max_bytes - written) len = max_bytes - written;
+      std::memcpy(dst + written, ebase + off, (size_t)len);
+      written += len;
+      if (len < segs[2 * seg + 1] - (off - segs[2 * seg])) {
+        // Partial segment: resume here next call.
+        return written;
+      }
+    }
+    if (seg == nsegs) {
+      seg = 0;
+      ++elem;
+    }
+  }
+  return written;
+}
+
+// Copy from a packed stream into a (possibly non-contiguous) user
+// buffer. Returns bytes consumed from src.
+long long ompi_tpu_unpack(
+    char* dst,
+    const long long* segs, long long nsegs,
+    long long extent, long long elem_size, long long count,
+    long long position, const char* src, long long max_bytes) {
+  if (max_bytes <= 0 || position < 0) return 0;
+  long long total = elem_size * count;
+  if (position >= total) return 0;
+  if (position + max_bytes > total) max_bytes = total - position;
+
+  long long elem = position / elem_size;
+  long long rem = position % elem_size;
+  long long seg = 0;
+  while (seg < nsegs && rem >= segs[2 * seg + 1]) {
+    rem -= segs[2 * seg + 1];
+    ++seg;
+  }
+
+  long long consumed = 0;
+  while (consumed < max_bytes && elem < count) {
+    char* ebase = dst + elem * extent;
+    for (; seg < nsegs && consumed < max_bytes; ++seg) {
+      long long off = segs[2 * seg] + rem;
+      long long len = segs[2 * seg + 1] - rem;
+      rem = 0;
+      if (len > max_bytes - consumed) len = max_bytes - consumed;
+      std::memcpy(ebase + off, src + consumed, (size_t)len);
+      consumed += len;
+      if (len < segs[2 * seg + 1] - (off - segs[2 * seg])) {
+        return consumed;
+      }
+    }
+    if (seg == nsegs) {
+      seg = 0;
+      ++elem;
+    }
+  }
+  return consumed;
+}
+
+}  // extern "C"

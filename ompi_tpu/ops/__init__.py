@@ -1,5 +1,6 @@
 """Reduction operators (reference: ompi/op + ompi/mca/op)."""
 
+from .device import reduce_local, reduce_ranks
 from .op import (
     BAND,
     BOR,
@@ -24,5 +25,5 @@ from .op import (
 __all__ = [
     "BAND", "BOR", "BXOR", "LAND", "LOR", "LXOR", "MAX", "MAXLOC",
     "MIN", "MINLOC", "NO_OP", "PREDEFINED", "PROD", "REPLACE", "SUM",
-    "Op", "create_op", "lookup",
+    "Op", "create_op", "lookup", "reduce_local", "reduce_ranks",
 ]

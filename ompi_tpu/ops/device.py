@@ -11,10 +11,8 @@ buffers.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Any
 
-import jax
 import jax.numpy as jnp
 
 from .op import Op, lookup
@@ -27,26 +25,15 @@ def reduce_local(op: "Op | str", inbuf: Any, inoutbuf: Any) -> Any:
     return op.combine(inoutbuf, inbuf)
 
 
-@partial(jax.jit, static_argnums=(1,))
-def _reduce_ranks_sum(x: jax.Array, keep_order: bool) -> jax.Array:
-    return jnp.sum(x, axis=0)
-
-
-def reduce_ranks(x: jax.Array, op: "Op | str") -> jax.Array:
+def reduce_ranks(x, op: "Op | str"):
     """Reduce a (n_ranks, ...) stacked buffer down its leading axis with
     the op's combine — the compute kernel of every reduction collective
     (what the reference runs on CPU per segment, SURVEY §3.3 hot loop).
+    Shares the rank-order-preserving tree fold the collectives execute.
     """
     op = lookup(op)
     if op.xla_reduce == "psum":
-        return _reduce_ranks_sum(x, True)
-    n = x.shape[0]
-    parts = [x[i] for i in range(n)]
-    while len(parts) > 1:
-        nxt = []
-        for i in range(0, len(parts) - 1, 2):
-            nxt.append(op.combine(parts[i], parts[i + 1]))
-        if len(parts) % 2:
-            nxt.append(parts[-1])
-        parts = nxt
-    return parts[0]
+        return jnp.sum(x, axis=0)
+    from ..coll.spmd import _tree_reduce_ranks  # lazy: avoids cycle
+
+    return _tree_reduce_ranks(x, x.shape[0], op)

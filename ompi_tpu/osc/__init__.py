@@ -1,0 +1,16 @@
+"""One-sided communication (reference: ompi/mca/osc)."""
+
+from .window import (
+    LOCK_EXCLUSIVE,
+    LOCK_SHARED,
+    SyncType,
+    Window,
+    WindowResult,
+    allocate_window,
+    create_window,
+)
+
+__all__ = [
+    "LOCK_EXCLUSIVE", "LOCK_SHARED", "SyncType", "Window",
+    "WindowResult", "allocate_window", "create_window",
+]

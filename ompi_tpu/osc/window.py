@@ -1,0 +1,358 @@
+"""One-sided communication: RMA windows.
+
+TPU-native equivalent of ompi/mca/osc (reference: osc/rdma — sync state
+machine osc_rdma_sync.h:24-30 {NONE, LOCK, FENCE, PSCW}, put/get over
+btl RDMA osc_rdma_comm.c, accumulate via remote atomics or active
+message osc_rdma_accumulate.c, dynamic windows osc_rdma_dynamic.c).
+
+Driver-model mapping: a window is a rank-major device buffer (block i =
+rank i's exposed memory, resident on device i). One-sided operations
+are *epoch-buffered*: puts/gets/accumulates enqueue against the target
+block and the queue is applied as compiled scatter/gather programs when
+the epoch closes (fence / unlock / complete) — which is exactly the MPI
+completion contract (RMA ops are only guaranteed at synchronization),
+and lets XLA fuse a whole epoch's updates into few kernels. The
+reference instead issues NIC RDMA per op and tracks completion counts;
+on TPU the "NIC" is the ICI transfer inside the compiled update.
+
+Accumulate ordering: ops apply in issue order per target (the reference
+guarantees same-origin ordered accumulates).
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core.counters import SPC
+from ..core.errors import ArgumentError, RMASyncError, WinError
+from ..ops import NO_OP, REPLACE, Op, lookup as op_lookup
+
+
+class SyncType(enum.Enum):
+    NONE = "none"
+    FENCE = "fence"
+    LOCK = "lock"
+    LOCK_ALL = "lock_all"
+    PSCW = "pscw"
+
+
+LOCK_EXCLUSIVE = "exclusive"
+LOCK_SHARED = "shared"
+
+
+@dataclass
+class _PendingOp:
+    kind: str  # put | get | acc | get_acc | fetch_op | cswap
+    target: int
+    value: Any
+    index: Any  # slice/index into the target block (None = whole)
+    op: Optional[Op] = None
+    result_slot: Optional[list] = None  # filled at epoch close
+    compare: Any = None
+
+
+class Window:
+    """An RMA window over a rank-major device buffer."""
+
+    def __init__(self, comm, buffer, *, name: str = "") -> None:
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(buffer)
+        if arr.shape[0] != comm.size:
+            raise ArgumentError(
+                f"window buffer leading dim {arr.shape[0]} != comm size "
+                f"{comm.size}"
+            )
+        self.comm = comm
+        self._array = comm.put_rank_major(arr)
+        self.name = name or f"win{comm.cid}"
+        self._sync = SyncType.NONE
+        self._pending: list[_PendingOp] = []
+        self._locks: dict[int, str] = {}  # target -> lock type
+        self._pscw_group = None
+        self._freed = False
+        self._plan_cache: dict[tuple, Any] = {}
+
+    # -- accessors --------------------------------------------------------
+
+    @property
+    def array(self):
+        """The current window contents (rank-major device array)."""
+        return self._array
+
+    @property
+    def block_shape(self):
+        return self._array.shape[1:]
+
+    def _check_alive(self):
+        if self._freed:
+            raise WinError(f"{self.name} has been freed")
+
+    def _check_epoch(self, target: Optional[int] = None):
+        if self._sync == SyncType.NONE:
+            raise RMASyncError(
+                f"{self.name}: RMA op outside an access epoch "
+                "(fence/lock/lock_all/start first)"
+            )
+        if self._sync == SyncType.LOCK and target is not None:
+            if target not in self._locks:
+                raise RMASyncError(
+                    f"{self.name}: target {target} is not locked"
+                )
+
+    # -- synchronization --------------------------------------------------
+
+    def fence(self) -> None:
+        """Close the current fence epoch (applying pending ops) and open
+        a new one. First call opens only."""
+        self._check_alive()
+        if self._sync not in (SyncType.NONE, SyncType.FENCE):
+            raise RMASyncError(
+                f"{self.name}: fence inside {self._sync.value} epoch"
+            )
+        self._apply_pending()
+        self.comm.barrier()
+        self._sync = SyncType.FENCE
+        SPC.record("osc_fence_calls")
+
+    def fence_end(self) -> None:
+        """Close the fence epoch without opening another (the
+        MPI_MODE_NOSUCCEED fence)."""
+        self._apply_pending()
+        self.comm.barrier()
+        self._sync = SyncType.NONE
+
+    def lock(self, target: int, lock_type: str = LOCK_SHARED) -> None:
+        self._check_alive()
+        if self._sync in (SyncType.FENCE, SyncType.PSCW):
+            raise RMASyncError(
+                f"{self.name}: lock inside {self._sync.value} epoch"
+            )
+        self.comm.check_rank(target)
+        if target in self._locks:
+            raise RMASyncError(f"{self.name}: target {target} already locked")
+        self._locks[target] = lock_type
+        self._sync = SyncType.LOCK
+        SPC.record("osc_lock_calls")
+
+    def unlock(self, target: int) -> None:
+        self._check_alive()
+        if target not in self._locks:
+            raise RMASyncError(f"{self.name}: target {target} not locked")
+        self._apply_pending(target_filter=target)
+        del self._locks[target]
+        if not self._locks:
+            self._sync = SyncType.NONE
+
+    def lock_all(self) -> None:
+        self._check_alive()
+        if self._sync != SyncType.NONE:
+            raise RMASyncError(f"{self.name}: lock_all inside epoch")
+        self._sync = SyncType.LOCK_ALL
+
+    def unlock_all(self) -> None:
+        if self._sync != SyncType.LOCK_ALL:
+            raise RMASyncError(f"{self.name}: unlock_all without lock_all")
+        self._apply_pending()
+        self._sync = SyncType.NONE
+
+    def flush(self, target: Optional[int] = None) -> None:
+        """Complete pending ops without ending the epoch (btl flush
+        analog, reference btl.h:1205)."""
+        self._check_epoch()
+        self._apply_pending(target_filter=target)
+
+    # PSCW (generalized active target)
+    def post(self, group) -> None:
+        """Expose the window to the group (exposure epoch)."""
+        self._check_alive()
+
+    def start(self, group) -> None:
+        if self._sync != SyncType.NONE:
+            raise RMASyncError(f"{self.name}: start inside epoch")
+        self._sync = SyncType.PSCW
+        self._pscw_group = group
+
+    def complete(self) -> None:
+        if self._sync != SyncType.PSCW:
+            raise RMASyncError(f"{self.name}: complete without start")
+        self._apply_pending()
+        self._sync = SyncType.NONE
+        self._pscw_group = None
+
+    def wait(self) -> None:
+        """Exposure-side wait; driver-mode ops are already applied at the
+        origin's complete()."""
+        self.comm.barrier()
+
+    # -- one-sided operations ---------------------------------------------
+
+    def put(self, value, target: int, index=None) -> None:
+        self._check_alive()
+        self.comm.check_rank(target)
+        self._check_epoch(target)
+        self._pending.append(_PendingOp("put", target, value, index))
+        SPC.record("osc_put_calls")
+        from ..monitoring import MONITOR
+
+        MONITOR.record_osc(
+            self.comm.cid, target, "put",
+            int(getattr(np.asarray(value), "nbytes", 0)),
+        )
+
+    def get(self, target: int, index=None) -> "WindowResult":
+        self._check_alive()
+        self.comm.check_rank(target)
+        self._check_epoch(target)
+        slot: list = []
+        self._pending.append(
+            _PendingOp("get", target, None, index, result_slot=slot)
+        )
+        SPC.record("osc_get_calls")
+        return WindowResult(slot, self)
+
+    def accumulate(self, value, target: int, op="sum", index=None) -> None:
+        self._check_alive()
+        self.comm.check_rank(target)
+        self._check_epoch(target)
+        self._pending.append(
+            _PendingOp("acc", target, value, index, op=op_lookup(op))
+        )
+        SPC.record("osc_accumulate_calls")
+
+    def get_accumulate(self, value, target: int, op="sum", index=None
+                       ) -> "WindowResult":
+        self._check_alive()
+        self.comm.check_rank(target)
+        self._check_epoch(target)
+        slot: list = []
+        self._pending.append(
+            _PendingOp(
+                "get_acc", target, value, index, op=op_lookup(op),
+                result_slot=slot,
+            )
+        )
+        return WindowResult(slot, self)
+
+    def fetch_and_op(self, value, target: int, op="sum", index=None
+                     ) -> "WindowResult":
+        return self.get_accumulate(value, target, op, index)
+
+    def compare_and_swap(self, value, compare, target: int, index=None
+                         ) -> "WindowResult":
+        self._check_alive()
+        self.comm.check_rank(target)
+        self._check_epoch(target)
+        slot: list = []
+        self._pending.append(
+            _PendingOp(
+                "cswap", target, value, index, result_slot=slot,
+                compare=compare,
+            )
+        )
+        return WindowResult(slot, self)
+
+    # -- epoch application -------------------------------------------------
+
+    def _apply_pending(self, target_filter: Optional[int] = None) -> None:
+        """Apply queued ops in issue order as functional updates of the
+        window array (compiled scatter/gathers, device-resident)."""
+        import jax
+        import jax.numpy as jnp
+
+        remaining = []
+        arr = self._array
+        for op in self._pending:
+            if target_filter is not None and op.target != target_filter:
+                remaining.append(op)
+                continue
+            block = arr[op.target]
+            idx = op.index if op.index is not None else Ellipsis
+            if op.kind == "put":
+                newb = block.at[idx].set(jnp.asarray(op.value))
+                arr = arr.at[op.target].set(newb)
+            elif op.kind == "get":
+                op.result_slot.append(block[idx])
+            elif op.kind == "acc":
+                cur = block[idx]
+                if op.op is REPLACE:
+                    upd = jnp.asarray(op.value)
+                else:
+                    upd = op.op.combine(cur, jnp.asarray(op.value))
+                arr = arr.at[op.target].set(block.at[idx].set(upd))
+            elif op.kind == "get_acc":
+                cur = block[idx]
+                op.result_slot.append(cur)
+                if op.op is NO_OP:
+                    pass
+                else:
+                    if op.op is REPLACE:
+                        upd = jnp.asarray(op.value)
+                    else:
+                        upd = op.op.combine(cur, jnp.asarray(op.value))
+                    arr = arr.at[op.target].set(block.at[idx].set(upd))
+            elif op.kind == "cswap":
+                cur = block[idx]
+                eq = cur == jnp.asarray(op.compare)
+                op.result_slot.append(cur)
+                upd = jnp.where(eq, jnp.asarray(op.value), cur)
+                arr = arr.at[op.target].set(block.at[idx].set(upd))
+            else:  # pragma: no cover
+                raise WinError(f"unknown RMA op {op.kind}")
+        self._pending = remaining
+        if arr is not self._array:
+            # Keep the window sharded rank-major.
+            self._array = self.comm.put_rank_major(arr)
+
+    def free(self) -> None:
+        if self._pending:
+            raise RMASyncError(
+                f"{self.name}: free with {len(self._pending)} pending ops "
+                "(close the epoch first)"
+            )
+        self._freed = True
+
+    def __repr__(self) -> str:
+        return (
+            f"<Window {self.name} blocks={self.comm.size}x"
+            f"{self.block_shape} sync={self._sync.value}>"
+        )
+
+
+class WindowResult:
+    """Deferred result of get/get_accumulate/compare_and_swap: defined
+    after the epoch closes (MPI completion semantics)."""
+
+    def __init__(self, slot: list, win: Window) -> None:
+        self._slot = slot
+        self._win = win
+
+    @property
+    def ready(self) -> bool:
+        return bool(self._slot)
+
+    def value(self):
+        if not self._slot:
+            raise RMASyncError(
+                "RMA result read before epoch completion (fence/unlock/"
+                "flush first)"
+            )
+        return self._slot[0]
+
+
+def create_window(comm, buffer, *, name: str = "") -> Window:
+    """MPI_Win_create equivalent (collective over comm)."""
+    return Window(comm, buffer, name=name)
+
+
+def allocate_window(comm, block_shape, dtype="float32", *, name: str = ""
+                    ) -> Window:
+    """MPI_Win_allocate: the window owns freshly zeroed memory."""
+    import jax.numpy as jnp
+
+    buf = jnp.zeros((comm.size,) + tuple(block_shape), dtype)
+    return Window(comm, buf, name=name)

@@ -22,19 +22,18 @@ def factorize(n: int, num_axes: int) -> tuple[int, ...]:
     dims = [1] * num_axes
     remaining = n
     i = num_axes - 1
-    while remaining > 1 and i >= 0:
-        # Peel the smallest prime factor into axis i, round-robin.
+    while remaining > 1:
+        # Peel the smallest prime factor into axis i, round-robin from
+        # the innermost axis outward.
         for p in (2, 3, 5, 7, 11, 13):
             if remaining % p == 0:
                 dims[i] *= p
                 remaining //= p
                 break
-        else:
+        else:  # remaining is prime (> 13): absorb it whole
             dims[i] *= remaining
             remaining = 1
         i = i - 1 if i > 0 else num_axes - 1
-    if remaining != 1:
-        dims[-1] *= remaining
     return tuple(dims)
 
 

@@ -1,0 +1,5 @@
+"""PGAS / SHMEM layer (reference: oshmem/)."""
+
+from .shmem import ShmemContext, SymmetricArray, init
+
+__all__ = ["ShmemContext", "SymmetricArray", "init"]

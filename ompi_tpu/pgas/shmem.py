@@ -1,0 +1,158 @@
+"""PGAS layer: symmetric heap with one-sided put/get/atomics.
+
+TPU-native equivalent of OSHMEM (reference: oshmem/ — spml put/get
+portal spml.h:383-413, memheap symmetric allocation + remote key
+exchange memheap_base_mkey.c, scoll collectives delegating to OMPI coll
+scoll_mpi_ops.c:18-44, atomic framework).
+
+Driver-model mapping: the "symmetric heap" is a set of rank-major device
+buffers — symmetric by construction (every rank's block has identical
+shape at the same logical address = the array handle), which is what
+OSHMEM's remote-key exchange establishes dynamically. put/get/atomics
+ride the osc window machinery; collectives delegate to the comm's coll
+table exactly as scoll/mpi does.
+
+API style follows SHMEM: ctx = shmem.init(comm); x = ctx.malloc(...);
+ctx.put(x, value, pe); ctx.barrier_all().
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..core.errors import ArgumentError
+from ..osc.window import LOCK_SHARED, Window
+
+
+class SymmetricArray:
+    """A symmetric-heap allocation: one identical block per PE."""
+
+    def __init__(self, ctx: "ShmemContext", win: Window) -> None:
+        self._ctx = ctx
+        self._win = win
+
+    @property
+    def array(self):
+        """Rank-major device array of all PEs' blocks."""
+        return self._win.array
+
+    @property
+    def block_shape(self):
+        return self._win.block_shape
+
+    def local(self, pe: int):
+        """PE pe's block (SHMEM local address view)."""
+        return self._win.array[pe]
+
+
+class ShmemContext:
+    """A SHMEM world over a communicator."""
+
+    def __init__(self, comm) -> None:
+        self.comm = comm
+        self._heap: list[SymmetricArray] = []
+
+    @property
+    def n_pes(self) -> int:
+        return self.comm.size
+
+    # -- symmetric heap ----------------------------------------------------
+
+    def malloc(self, shape, dtype="float32", fill=0) -> SymmetricArray:
+        """shmem_malloc: collective; same block on every PE."""
+        import jax.numpy as jnp
+
+        buf = jnp.full(
+            (self.comm.size,) + tuple(shape), fill, dtype
+        )
+        win = Window(self.comm, buf, name=f"shmem{len(self._heap)}")
+        # SHMEM has no epochs: keep a standing lock_all so one-sided ops
+        # are always legal; fence/quiet flush it.
+        win.lock_all()
+        sym = SymmetricArray(self, win)
+        self._heap.append(sym)
+        return sym
+
+    def free(self, sym: SymmetricArray) -> None:
+        if sym in self._heap:
+            sym._win.unlock_all()
+            sym._win.free()
+            self._heap.remove(sym)
+
+    # -- RMA ---------------------------------------------------------------
+
+    def put(self, sym: SymmetricArray, value, pe: int, index=None) -> None:
+        """shmem_put: deliver value into PE pe's block."""
+        sym._win.put(value, pe, index)
+
+    def get(self, sym: SymmetricArray, pe: int, index=None):
+        """shmem_get: read PE pe's block (completes immediately —
+        SHMEM get is blocking)."""
+        res = sym._win.get(pe, index)
+        sym._win.flush(pe)
+        return res.value()
+
+    def quiet(self, sym: Optional[SymmetricArray] = None) -> None:
+        """shmem_quiet: complete all outstanding puts."""
+        targets = [sym] if sym is not None else self._heap
+        for s in targets:
+            s._win.flush()
+
+    fence = quiet  # same-PE ordering == completion in the driver model
+
+    # -- atomics (reference: oshmem/mca/atomic) ----------------------------
+
+    def atomic_add(self, sym: SymmetricArray, value, pe: int, index=None):
+        sym._win.accumulate(value, pe, "sum", index)
+        sym._win.flush(pe)
+
+    def atomic_fetch_add(self, sym: SymmetricArray, value, pe: int,
+                         index=None):
+        res = sym._win.fetch_and_op(value, pe, "sum", index)
+        sym._win.flush(pe)
+        return res.value()
+
+    def atomic_swap(self, sym: SymmetricArray, value, pe: int, index=None):
+        res = sym._win.fetch_and_op(value, pe, "replace", index)
+        sym._win.flush(pe)
+        return res.value()
+
+    def atomic_compare_swap(self, sym: SymmetricArray, compare, value,
+                            pe: int, index=None):
+        res = sym._win.compare_and_swap(value, compare, pe, index)
+        sym._win.flush(pe)
+        return res.value()
+
+    def atomic_fetch(self, sym: SymmetricArray, pe: int, index=None):
+        res = sym._win.fetch_and_op(0, pe, "no_op", index)
+        sym._win.flush(pe)
+        return res.value()
+
+    # -- collectives (scoll/mpi pattern: delegate to comm coll) ------------
+
+    def barrier_all(self) -> None:
+        self.quiet()
+        self.comm.barrier()
+
+    def broadcast(self, sym: SymmetricArray, root: int) -> None:
+        self.quiet(sym)
+        sym._win._array = self.comm.bcast(sym._win.array, root=root)
+
+    def collect(self, sym: SymmetricArray):
+        """fcollect: concatenation of every PE's block, everywhere."""
+        self.quiet(sym)
+        return self.comm.allgather(sym._win.array)
+
+    def reduce_all(self, sym: SymmetricArray, op="sum") -> None:
+        """to_all reduction: every PE's block becomes the reduction."""
+        self.quiet(sym)
+        sym._win._array = self.comm.allreduce(sym._win.array, op)
+
+
+def init(comm=None) -> ShmemContext:
+    """shmem_init: PGAS world over a communicator (default COMM_WORLD)."""
+    if comm is None:
+        import ompi_tpu
+
+        comm = ompi_tpu.world()
+    return ShmemContext(comm)

@@ -211,6 +211,9 @@ class Ob1Pml(PmlComponent):
         )
         SPC.record("pml_isend_calls")
         SPC.record("pml_send_bytes", env.nbytes)
+        from ..monitoring import MONITOR
+
+        MONITOR.record_p2p(comm.cid, src, dest, env.nbytes)
         if eager:
             # Ship now; parks in the unexpected queue if no recv matches.
             pending.transferred = btl.transfer(

@@ -1,0 +1,1 @@
+"""Tools (reference: ompi/tools — ompi_info, wrappers, mpisync)."""

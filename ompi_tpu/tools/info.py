@@ -1,0 +1,96 @@
+"""ompi_tpu_info: dump frameworks, components, config vars, counters.
+
+TPU-native equivalent of ompi_info (reference: ompi/tools/ompi_info —
+dumps every framework/component/MCA var) plus the MPI_T introspection
+surface (cvars = the config registry, pvars = the SPC counters).
+
+Usage: python -m ompi_tpu.tools.info [--all] [--json] [--param FW]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def collect(include_internal: bool = False) -> dict:
+    # Import for their registration side effects.
+    from .. import _version
+    from ..coll import framework as coll_fw
+    from ..pml import framework as pml_fw
+    from ..btl import framework as btl_fw  # noqa: F401
+    from ..core import config
+    from ..core.component import MCA
+    from ..core.counters import SPC
+
+    coll_fw.ensure_components()
+    pml_fw.ensure_components()
+
+    frameworks = {}
+    for name in MCA.names():
+        fw = MCA.framework(name)
+        comps = {}
+        for cname in fw.component_names():
+            comp = fw.component(cname)
+            comps[cname] = {
+                "priority": comp.priority,
+                "description": comp.DESCRIPTION,
+            }
+        frameworks[name] = comps
+
+    return {
+        "version": _version.__version__,
+        "frameworks": frameworks,
+        "config_vars": config.VARS.dump(include_internal),
+        "counters": SPC.dump(),
+    }
+
+
+def render_text(info: dict, param_filter: str = "") -> str:
+    lines = [f"ompi_tpu version: {info['version']}", ""]
+    lines.append("Frameworks and components:")
+    for fw, comps in sorted(info["frameworks"].items()):
+        lines.append(f"  {fw}:")
+        for cname, meta in sorted(
+            comps.items(), key=lambda kv: -kv[1]["priority"]
+        ):
+            lines.append(
+                f"    {cname:<12} priority {meta['priority']:>4}  "
+                f"{meta['description']}"
+            )
+    lines.append("")
+    lines.append("Config vars (cvars):")
+    for var in info["config_vars"]:
+        if param_filter and not var["name"].startswith(param_filter):
+            continue
+        lines.append(
+            f"  {var['name']:<40} = {var['value']!r:<16} "
+            f"[{var['source']}] {var['description']}"
+        )
+    if info["counters"]:
+        lines.append("")
+        lines.append("Performance counters (pvars):")
+        for c in info["counters"]:
+            lines.append(f"  {c['name']:<40} {c['value']} {c['unit']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu_info")
+    ap.add_argument("--json", action="store_true", help="JSON output")
+    ap.add_argument("--all", action="store_true",
+                    help="include internal vars")
+    ap.add_argument("--param", default="",
+                    help="filter config vars by prefix (e.g. coll_tuned)")
+    args = ap.parse_args(argv)
+    info = collect(include_internal=args.all)
+    if args.json:
+        print(json.dumps(info, indent=2, default=str))
+    else:
+        print(render_text(info, args.param))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,19 @@
+"""Process topologies (reference: ompi/mca/topo)."""
+
+from .topology import (
+    CartTopology,
+    DistGraphTopology,
+    GraphTopology,
+    cart_create,
+    dims_create,
+    dist_graph_create,
+    graph_create,
+    neighbor_allgather,
+    neighbor_alltoall,
+)
+
+__all__ = [
+    "CartTopology", "DistGraphTopology", "GraphTopology", "cart_create",
+    "dims_create", "dist_graph_create", "graph_create",
+    "neighbor_allgather", "neighbor_alltoall",
+]

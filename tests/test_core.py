@@ -267,3 +267,27 @@ class TestGroup:
         a = grp.Group([4, 5, 6, 7])
         b = grp.Group([6, 7, 8])
         assert a.translate_ranks([0, 2, 3], b) == [grp.UNDEFINED, 0, 1]
+
+
+class TestOpsDevice:
+    def test_reduce_local_and_ranks(self):
+        import numpy as np
+        import jax.numpy as jnp
+        from ompi_tpu import ops
+
+        a = jnp.asarray(np.arange(4, dtype=np.float32))
+        b = jnp.asarray(np.full(4, 2.0, np.float32))
+        np.testing.assert_array_equal(
+            np.asarray(ops.reduce_local("sum", a, b)), [2, 3, 4, 5]
+        )
+        stacked = jnp.asarray(
+            np.random.default_rng(0).uniform(1, 2, (5, 3)).astype(np.float32)
+        )
+        np.testing.assert_allclose(
+            np.asarray(ops.reduce_ranks(stacked, "prod")),
+            np.asarray(stacked).prod(0), rtol=1e-5,
+        )
+        np.testing.assert_allclose(
+            np.asarray(ops.reduce_ranks(stacked, "sum")),
+            np.asarray(stacked).sum(0), rtol=1e-5,
+        )

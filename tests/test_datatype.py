@@ -1,0 +1,285 @@
+"""Datatype engine tests — modeled on the reference's deepest unit suite
+(SURVEY §4: test/datatype/{ddt_test,ddt_pack,position,external32,
+to_self}.c): pack→unpack round trips through iovec slices of varying
+sizes, position seeks, constructor correctness against numpy slicing
+oracles, and the native/python/device tier equivalence.
+"""
+
+import numpy as np
+import pytest
+
+from ompi_tpu import datatype as dt
+from ompi_tpu.core.errors import DatatypeError, TruncationError
+
+
+def roundtrip(buffer, datatype, count, chunk_sizes=None):
+    """Pack through chunks of the given sizes, then unpack through a
+    different chunking, into a zeroed buffer. Returns the new buffer."""
+    conv = dt.Convertor(datatype, count).prepare_for_send(buffer)
+    chunks = []
+    if chunk_sizes is None:
+        chunks.append(conv.pack())
+    else:
+        i = 0
+        while conv.remaining:
+            chunks.append(conv.pack(chunk_sizes[i % len(chunk_sizes)]))
+            i += 1
+    packed = b"".join(chunks)
+    assert len(packed) == dt.lookup(datatype).size * count
+
+    out = np.zeros_like(buffer)
+    rconv = dt.Convertor(datatype, count).prepare_for_recv(out)
+    # Unpack with a different slicing than the pack used.
+    pos = 0
+    for sz in (7, 13, 64, 1):
+        while pos < len(packed):
+            take = packed[pos:pos + sz]
+            consumed = rconv.unpack(take)
+            pos += consumed
+            if consumed < len(take):
+                break
+            break  # rotate chunk size
+    if pos < len(packed):
+        rconv.unpack(packed[pos:])
+    return out
+
+
+class TestVector:
+    def test_pack_matches_numpy_oracle(self):
+        # vector(count=4, blocklength=3, stride=5) of int32 over a 20-elem
+        # buffer == arr.reshape(4,5)[:, :3]
+        arr = np.arange(20, dtype=np.int32)
+        v = dt.vector(4, 3, 5, dt.INT32)
+        packed = dt.pack(arr, v, 1)
+        expected = arr.reshape(4, 5)[:, :3].tobytes()
+        assert packed == expected
+
+    def test_roundtrip_chunked(self):
+        arr = np.arange(40, dtype=np.float64)
+        v = dt.vector(5, 2, 8, dt.FLOAT64)
+        out = roundtrip(arr, v, 1, chunk_sizes=[5, 3, 17])
+        expected = np.zeros_like(arr)
+        sel = np.zeros(40, bool)
+        sel.reshape(5, 8)[:, :2] = True
+        expected[sel] = arr[sel]
+        np.testing.assert_array_equal(out, expected)
+
+    def test_count_multiple_elements(self):
+        # 2 elements of vector(2,1,2): element extent spans 3 int32.
+        arr = np.arange(8, dtype=np.int32)
+        v = dt.vector(2, 1, 2, dt.INT32)
+        packed = dt.pack(arr, v, 2)
+        got = np.frombuffer(packed, np.int32)
+        # elem 0 at offset 0: picks idx 0, 2; elem 1 starts at extent.
+        ext = v.extent // 4
+        np.testing.assert_array_equal(
+            got, [0, 2, ext, ext + 2]
+        )
+
+
+class TestIndexedStruct:
+    def test_indexed(self):
+        arr = np.arange(30, dtype=np.int32)
+        ind = dt.indexed([2, 3, 1], [0, 10, 25], dt.INT32)
+        packed = dt.pack(arr, ind, 1)
+        got = np.frombuffer(packed, np.int32)
+        np.testing.assert_array_equal(got, [0, 1, 10, 11, 12, 25])
+
+    def test_hindexed_bytes(self):
+        arr = np.arange(16, dtype=np.int32)
+        h = dt.hindexed([2, 1], [4, 40], dt.INT32)
+        got = np.frombuffer(dt.pack(arr, h, 1), np.int32)
+        np.testing.assert_array_equal(got, [1, 2, 10])
+
+    def test_struct_uniform(self):
+        arr = np.arange(12, dtype=np.float32)
+        s = dt.struct([1, 2], [0, 20], [dt.FLOAT32, dt.FLOAT32])
+        got = np.frombuffer(dt.pack(arr, s, 1), np.float32)
+        np.testing.assert_array_equal(got, [0, 5, 6])
+
+    def test_struct_from_numpy_structured(self):
+        rec = np.dtype([("a", np.int32), ("b", np.float64)], align=True)
+        d = dt.from_numpy(rec)
+        assert d.extent == rec.itemsize
+        assert d.size == 12  # 4 + 8 payload
+
+    def test_indexed_block(self):
+        arr = np.arange(20, dtype=np.int32)
+        ib = dt.indexed_block(2, [0, 8, 16], dt.INT32)
+        got = np.frombuffer(dt.pack(arr, ib, 1), np.int32)
+        np.testing.assert_array_equal(got, [0, 1, 8, 9, 16, 17])
+
+
+class TestSubarray:
+    def test_2d_slab(self):
+        arr = np.arange(6 * 8, dtype=np.float32).reshape(6, 8)
+        sub = dt.subarray([6, 8], [2, 3], [1, 4], dt.FLOAT32)
+        packed = dt.pack(np.ascontiguousarray(arr), sub, 1)
+        got = np.frombuffer(packed, np.float32).reshape(2, 3)
+        np.testing.assert_array_equal(got, arr[1:3, 4:7])
+
+    def test_3d_fortran_order(self):
+        arr = np.arange(2 * 3 * 4, dtype=np.int32)
+        sub_c = dt.subarray([4, 3, 2], [2, 1, 1], [1, 1, 0], dt.INT32,
+                            order=dt.ORDER_C)
+        sub_f = dt.subarray([2, 3, 4], [1, 1, 2], [0, 1, 1], dt.INT32,
+                            order=dt.ORDER_FORTRAN)
+        assert dt.pack(arr, sub_c, 1) == dt.pack(arr, sub_f, 1)
+
+    def test_out_of_bounds_raises(self):
+        with pytest.raises(DatatypeError):
+            dt.subarray([4, 4], [2, 2], [3, 0], dt.INT32)
+
+    def test_roundtrip(self):
+        arr = np.arange(5 * 7, dtype=np.float64)
+        sub = dt.subarray([5, 7], [3, 2], [1, 3], dt.FLOAT64)
+        out = roundtrip(arr, sub, 1, chunk_sizes=[11, 3])
+        mask = np.zeros((5, 7), bool)
+        mask[1:4, 3:5] = True
+        expected = np.where(mask.ravel(), arr, 0)
+        np.testing.assert_array_equal(out, expected)
+
+
+class TestDarray:
+    def test_block_distribution_covers_disjointly(self):
+        g = [8, 6]
+        pieces = []
+        for rank in range(4):
+            d = dt.darray(
+                4, rank, g, [dt.DISTRIBUTE_BLOCK, dt.DISTRIBUTE_BLOCK],
+                [dt.DISTRIBUTE_DFLT_DARG] * 2, [2, 2], dt.INT32,
+            )
+            pieces.append(d)
+        arr = np.arange(48, dtype=np.int32)
+        seen = []
+        for d in pieces:
+            seen.extend(np.frombuffer(dt.pack(arr, d, 1), np.int32))
+        assert sorted(seen) == list(range(48))
+
+    def test_cyclic(self):
+        d = dt.darray(
+            2, 0, [6], [dt.DISTRIBUTE_CYCLIC], [1], [2], dt.INT32
+        )
+        arr = np.arange(6, dtype=np.int32)
+        got = np.frombuffer(dt.pack(arr, d, 1), np.int32)
+        np.testing.assert_array_equal(got, [0, 2, 4])
+
+
+class TestPosition:
+    def test_seek_matches_full_pack(self):
+        arr = np.arange(50, dtype=np.int32)
+        v = dt.vector(5, 3, 10, dt.INT32)
+        full = dt.pack(arr, v, 1)
+        conv = dt.Convertor(v, 1).prepare_for_send(arr)
+        for pos in (0, 1, 4, 11, 30, 59):
+            conv.set_position(pos)
+            got = conv.pack(8)
+            assert got == full[pos:pos + 8], f"position {pos}"
+
+    def test_position_out_of_range(self):
+        conv = dt.Convertor(dt.INT32, 4)
+        with pytest.raises(DatatypeError):
+            conv.set_position(999)
+
+
+class TestTiers:
+    def test_native_available_and_matches_python(self):
+        from ompi_tpu.core import config
+        from ompi_tpu import native
+
+        arr = np.arange(100, dtype=np.float32)
+        v = dt.vector(10, 3, 10, dt.FLOAT32)
+        native_ok = native.available()
+        packed_native = dt.pack(arr, v, 1)
+        config.VARS.set("native_base_enable", False)
+        try:
+            packed_py = dt.pack(arr, v, 1)
+        finally:
+            config.VARS.set("native_base_enable", True)
+        assert packed_native == packed_py
+        assert native_ok, "native C++ convertor should build in this image"
+
+    def test_device_pack_unpack(self):
+        import jax.numpy as jnp
+
+        arr = np.arange(24, dtype=np.float32)
+        v = dt.vector(4, 2, 6, dt.FLOAT32)
+        packed = dt.pack_device(jnp.asarray(arr), v, 1)
+        expected = arr.reshape(4, 6)[:, :2].reshape(-1)
+        np.testing.assert_array_equal(np.asarray(packed), expected)
+
+        tmpl = jnp.zeros(24, jnp.float32)
+        out = dt.unpack_device(packed, tmpl, v, 1)
+        host = np.zeros(24, np.float32)
+        host.reshape(4, 6)[:, :2] = arr.reshape(4, 6)[:, :2]
+        np.testing.assert_array_equal(np.asarray(out), host)
+
+
+class TestExternal32:
+    def test_roundtrip_and_byteorder(self):
+        arr = np.arange(10, dtype=np.int32)
+        packed = dt.pack_external32(arr, dt.INT32, 10)
+        # big-endian on the wire
+        np.testing.assert_array_equal(
+            np.frombuffer(packed, np.dtype(">i4")), arr
+        )
+        out = np.zeros(10, np.int32)
+        dt.unpack_external32(packed, out, dt.INT32, 10)
+        np.testing.assert_array_equal(out, arr)
+
+
+class TestErrors:
+    def test_truncation_on_small_buffer(self):
+        v = dt.vector(4, 2, 4, dt.INT32)
+        small = np.zeros(3, np.int32)
+        with pytest.raises(TruncationError):
+            dt.Convertor(v, 1).prepare_for_send(small)
+
+    def test_unpack_overflow_raises(self):
+        out = np.zeros(2, np.int32)
+        conv = dt.Convertor(dt.INT32, 2).prepare_for_recv(out)
+        with pytest.raises(TruncationError):
+            conv.unpack(b"\x00" * 12)
+
+    def test_unknown_name(self):
+        with pytest.raises(DatatypeError):
+            dt.lookup("float128x")
+
+
+class TestQueries:
+    def test_size_extent(self):
+        v = dt.vector(3, 2, 5, dt.INT32)
+        assert v.size == 3 * 2 * 4
+        assert v.extent == ((3 - 1) * 5 + 2) * 4
+        r = v.resized(0, 100)
+        assert r.extent == 100 and r.size == v.size
+
+    def test_contiguous_detection(self):
+        assert dt.contiguous(8, dt.FLOAT32).commit().is_contiguous
+        assert not dt.vector(2, 1, 3, dt.FLOAT32).commit().is_contiguous
+
+    def test_envelope(self):
+        v = dt.vector(3, 2, 5, dt.INT32)
+        kind = v.envelope[0]
+        assert kind == "hvector"  # vector lowers to hvector (byte stride)
+
+
+def test_to_self_noncontiguous_through_p2p():
+    """The reference's to_self.c: a non-contiguous layout travels the
+    full send path (pack -> transfer -> unpack) rank0 -> rank0."""
+    import jax.numpy as jnp
+
+    import ompi_tpu
+
+    world = ompi_tpu.init()
+    r0 = world.rank(0)
+    arr = np.arange(30, dtype=np.float32)
+    v = dt.vector(3, 2, 10, dt.FLOAT32)
+    payload = dt.pack_device(jnp.asarray(arr), v, 1)
+    r0.send(r0.put(np.asarray(payload)), dest=0, tag=42)
+    got = r0.recv(source=0, tag=42)
+    tmpl = jnp.zeros(30, jnp.float32)
+    out = dt.unpack_device(jnp.asarray(got), tmpl, v, 1)
+    expected = np.zeros(30, np.float32)
+    expected.reshape(3, 10)[:, :2] = arr.reshape(3, 10)[:, :2]
+    np.testing.assert_array_equal(np.asarray(out), expected)

@@ -1,0 +1,96 @@
+"""Monitoring interposition + info tool tests (reference:
+test/monitoring/*, ompi_info)."""
+
+import json
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.monitoring import MONITOR, profile_api
+
+
+@pytest.fixture(scope="module")
+def world():
+    return ompi_tpu.init()
+
+
+def test_p2p_peer_matrix(world):
+    MONITOR.reset()
+    MONITOR.enable(True)
+    try:
+        r0, r2 = world.rank(0), world.rank(2)
+        payload = r0.put(np.ones(10, np.float32))
+        r0.send(payload, dest=2, tag=1)
+        world.rank(2).recv(source=0, tag=1)
+        mat = MONITOR.peer_matrix(world.size)
+        assert mat[0][2] == 40
+        assert sum(map(sum, mat)) == 40
+    finally:
+        MONITOR.enable(False)
+
+
+def test_coll_recording(world):
+    MONITOR.reset()
+    MONITOR.enable(True)
+    try:
+        x = world.put_rank_major(np.ones((world.size, 4), np.float32))
+        world.allreduce(x, "sum")
+        flushed = MONITOR.flush()
+        key = f"{world.cid}:allreduce"
+        assert key in flushed["coll"]
+        calls, nbytes = flushed["coll"][key]
+        assert calls == 1 and nbytes == world.size * 16
+    finally:
+        MONITOR.enable(False)
+
+
+def test_disabled_records_nothing(world):
+    MONITOR.reset()
+    x = world.put_rank_major(np.ones((world.size, 4), np.float32))
+    world.allreduce(x, "sum")
+    assert MONITOR.flush()["coll"] == {}
+
+
+def test_profile_api_hook():
+    from ompi_tpu.monitoring.monitoring import profiled
+
+    seen = []
+    unreg = profile_api(lambda name, dt: seen.append((name, dt)))
+
+    @profiled("test_fn")
+    def fn(x):
+        return x + 1
+
+    assert fn(1) == 2
+    unreg()
+    assert seen and seen[0][0] == "test_fn"
+    fn(1)
+    assert len(seen) == 1  # unregistered
+
+
+def test_info_tool_collect():
+    from ompi_tpu.tools.info import collect, render_text
+
+    info = collect()
+    assert "coll" in info["frameworks"]
+    assert {"tuned", "basic", "xla", "self"} <= set(
+        info["frameworks"]["coll"]
+    )
+    assert "pml" in info["frameworks"]
+    assert any(v["name"] == "coll_tuned_segment_bytes"
+               for v in info["config_vars"])
+    text = render_text(info, param_filter="coll_tuned")
+    assert "coll_tuned_segment_bytes" in text
+
+
+def test_info_tool_cli_json():
+    out = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.info", "--json"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert out.returncode == 0, out.stderr
+    data = json.loads(out.stdout)
+    assert "frameworks" in data and "config_vars" in data

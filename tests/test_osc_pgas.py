@@ -1,0 +1,197 @@
+"""One-sided (osc) and PGAS (shmem) tests — mirroring the reference's
+RMA semantics: ops complete at epoch boundaries; sync misuse raises.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import osc, pgas
+from ompi_tpu.core.errors import RMASyncError
+
+
+@pytest.fixture(scope="module")
+def world():
+    return ompi_tpu.init()
+
+
+class TestWindowFence:
+    def test_put_get_fence_epoch(self, world):
+        win = osc.allocate_window(world, (4,), "float32")
+        win.fence()
+        win.put(np.full(4, 7.0, np.float32), target=3)
+        res = win.get(target=3)
+        assert not res.ready  # not until the epoch closes
+        win.fence()
+        np.testing.assert_array_equal(np.asarray(res.value()),
+                                      np.full(4, 7.0))
+        np.testing.assert_array_equal(
+            np.asarray(win.array)[3], np.full(4, 7.0)
+        )
+        win.fence_end()
+        win.free()
+
+    def test_indexed_put(self, world):
+        win = osc.allocate_window(world, (6,), "int32")
+        win.fence()
+        win.put(np.int32(9), target=1, index=2)
+        win.fence_end()
+        got = np.asarray(win.array)[1]
+        np.testing.assert_array_equal(got, [0, 0, 9, 0, 0, 0])
+        win.free()
+
+    def test_ops_outside_epoch_raise(self, world):
+        win = osc.allocate_window(world, (2,), "float32")
+        with pytest.raises(RMASyncError):
+            win.put(np.zeros(2, np.float32), target=0)
+        win.free()
+
+    def test_result_read_before_close_raises(self, world):
+        win = osc.allocate_window(world, (2,), "float32")
+        win.fence()
+        res = win.get(target=0)
+        with pytest.raises(RMASyncError):
+            res.value()
+        win.fence_end()
+        win.free()
+
+
+class TestAccumulate:
+    def test_accumulate_ordering_same_origin(self, world):
+        win = osc.allocate_window(world, (1,), "float32")
+        win.fence()
+        win.accumulate(np.float32(5.0), target=2, op="sum")
+        win.accumulate(np.float32(3.0), target=2, op="prod")
+        win.fence_end()
+        # (0 + 5) * 3 = 15 — issue order preserved
+        assert float(np.asarray(win.array)[2][0]) == 15.0
+        win.free()
+
+    def test_get_accumulate_returns_old(self, world):
+        win = osc.allocate_window(world, (1,), "int32")
+        win.fence()
+        win.put(np.asarray([10], np.int32), target=0)
+        win.fence()
+        res = win.get_accumulate(np.asarray([5], np.int32), target=0,
+                                 op="sum")
+        win.fence_end()
+        assert int(np.asarray(res.value())[0]) == 10
+        assert int(np.asarray(win.array)[0][0]) == 15
+        win.free()
+
+    def test_compare_and_swap(self, world):
+        win = osc.allocate_window(world, (1,), "int32")
+        win.lock(0)
+        r1 = win.compare_and_swap(np.int32(42), compare=np.int32(0),
+                                  target=0)
+        win.unlock(0)
+        assert int(np.asarray(r1.value())[()] if np.asarray(r1.value()).shape == () else np.asarray(r1.value())[0]) == 0
+        win.lock(0)
+        r2 = win.compare_and_swap(np.int32(99), compare=np.int32(7),
+                                  target=0)  # mismatch: no swap
+        win.unlock(0)
+        assert int(np.asarray(win.array)[0][0]) == 42
+        win.free()
+
+
+class TestLockEpochs:
+    def test_lock_unlock_flush(self, world):
+        win = osc.allocate_window(world, (3,), "float32")
+        win.lock(4, osc.LOCK_EXCLUSIVE)
+        win.put(np.ones(3, np.float32), target=4)
+        win.flush(4)
+        np.testing.assert_array_equal(np.asarray(win.array)[4], np.ones(3))
+        win.unlock(4)
+        with pytest.raises(RMASyncError):
+            win.unlock(4)
+        win.free()
+
+    def test_lock_all(self, world):
+        win = osc.allocate_window(world, (1,), "float32")
+        win.lock_all()
+        for t in range(world.size):
+            win.put(np.asarray([float(t)], np.float32), target=t)
+        win.unlock_all()
+        got = np.asarray(win.array)[:, 0]
+        np.testing.assert_array_equal(got, np.arange(world.size))
+        win.free()
+
+    def test_double_lock_raises(self, world):
+        win = osc.allocate_window(world, (1,), "float32")
+        win.lock(0)
+        with pytest.raises(RMASyncError):
+            win.lock(0)
+        win.unlock(0)
+        win.free()
+
+    def test_free_with_pending_raises(self, world):
+        win = osc.allocate_window(world, (1,), "float32")
+        win.lock(0)
+        win.put(np.zeros(1, np.float32), target=0)
+        with pytest.raises(RMASyncError):
+            win.free()
+        win.unlock(0)
+        win.free()
+
+
+class TestPscw:
+    def test_start_complete(self, world):
+        win = osc.allocate_window(world, (2,), "float32")
+        grp = world.group.incl([1, 2])
+        win.post(grp)
+        win.start(grp)
+        win.put(np.full(2, 3.0, np.float32), target=1)
+        win.complete()
+        win.wait()
+        np.testing.assert_array_equal(np.asarray(win.array)[1],
+                                      np.full(2, 3.0))
+        win.free()
+
+
+class TestShmem:
+    def test_put_get_roundtrip(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((4,), "float32")
+        ctx.put(x, np.full(4, 2.5, np.float32), pe=5)
+        ctx.quiet(x)
+        got = ctx.get(x, pe=5)
+        np.testing.assert_array_equal(np.asarray(got), np.full(4, 2.5))
+        ctx.free(x)
+
+    def test_atomics(self, world):
+        ctx = pgas.init(world)
+        c = ctx.malloc((1,), "int32")
+        old = ctx.atomic_fetch_add(c, np.asarray([5], np.int32), pe=0)
+        assert int(np.asarray(old)[0]) == 0
+        ctx.atomic_add(c, np.asarray([3], np.int32), pe=0)
+        assert int(np.asarray(ctx.atomic_fetch(c, pe=0))[0]) == 8
+        swapped = ctx.atomic_compare_swap(
+            c, compare=np.asarray([8], np.int32),
+            value=np.asarray([100], np.int32), pe=0,
+        )
+        assert int(np.asarray(swapped)[0]) == 8
+        assert int(np.asarray(ctx.atomic_fetch(c, pe=0))[0]) == 100
+        ctx.free(c)
+
+    def test_collectives_delegate(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((2,), "float32")
+        for pe in range(ctx.n_pes):
+            ctx.put(x, np.full(2, float(pe), np.float32), pe=pe)
+        ctx.barrier_all()
+        ctx.reduce_all(x, "sum")
+        expected = sum(range(ctx.n_pes))
+        got = np.asarray(x.array)
+        for pe in range(ctx.n_pes):
+            np.testing.assert_array_equal(got[pe], np.full(2, expected))
+        ctx.free(x)
+
+    def test_broadcast(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((3,), "float32")
+        ctx.put(x, np.asarray([1.0, 2.0, 3.0], np.float32), pe=2)
+        ctx.broadcast(x, root=2)
+        got = np.asarray(x.array)
+        for pe in range(ctx.n_pes):
+            np.testing.assert_array_equal(got[pe], [1.0, 2.0, 3.0])
+        ctx.free(x)

@@ -190,4 +190,4 @@ def test_cancelled_recv_does_not_steal_message(world):
     r0.send(r0.put(np.float32(42.0)), dest=1, tag=555)
     out = r1.recv(source=0, tag=555)  # real recv gets the payload
     assert float(out) == 42.0
-    assert req.result() is None or req.status.cancelled
+    assert req._result is None  # payload was not stolen

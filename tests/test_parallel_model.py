@@ -209,9 +209,12 @@ class TestFlagshipModel:
 
         mesh1 = T.demo_mesh(1)
         step1 = T.build_train_step(cfg1, mesh1)
-        loss1, _ = step1(
+        loss1, p1_next = step1(
             jax.device_put(params1), tokens, targets
         )
+        # Second step validates the distributed GRADIENTS (via the
+        # updated params), not just the forward pass.
+        loss1b, _ = step1(p1_next, tokens, targets)
 
         mesh8 = T.demo_mesh(8)
         step8 = T.build_train_step(cfg8, mesh8)
@@ -225,9 +228,13 @@ class TestFlagshipModel:
             [jax.device_put(x, NamedSharding(mesh8, s))
              for x, s in zip(leaves, spec_leaves)],
         )
-        loss8, _ = step8(p8, tokens, targets)
+        loss8, p8_next = step8(p8, tokens, targets)
+        loss8b, _ = step8(p8_next, tokens, targets)
         np.testing.assert_allclose(
             float(loss1), float(loss8), rtol=5e-4, atol=5e-4
+        )
+        np.testing.assert_allclose(
+            float(loss1b), float(loss8b), rtol=2e-3, atol=2e-3
         )
 
     def test_training_reduces_loss(self):

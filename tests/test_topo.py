@@ -1,0 +1,102 @@
+"""Topology tests (reference: ompi/mca/topo/base + MPI cart semantics)."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu import topo
+from ompi_tpu.core.errors import ArgumentError, TopologyError
+
+
+@pytest.fixture(scope="module")
+def world():
+    return ompi_tpu.init()
+
+
+class TestCart:
+    def test_coords_rank_roundtrip(self, world):
+        c = topo.cart_create(world, [2, 4], [False, True])
+        t = c.topo
+        for r in range(8):
+            assert t.rank(t.coords(r)) == r
+        assert t.coords(0) == (0, 0)
+        assert t.coords(7) == (1, 3)
+
+    def test_periodic_wrap(self, world):
+        c = topo.cart_create(world, [2, 4], [False, True])
+        t = c.topo
+        assert t.rank((0, 5)) == t.rank((0, 1))  # periodic dim wraps
+        with pytest.raises(TopologyError):
+            t.rank((2, 0))  # non-periodic out of range
+
+    def test_shift(self, world):
+        c = topo.cart_create(world, [2, 4], [False, True])
+        t = c.topo
+        src, dst = t.shift_for(0, 0, 1)  # dim 0 non-periodic
+        assert src is None  # PROC_NULL at the edge
+        assert dst == t.rank((1, 0))
+        src, dst = t.shift_for(0, 1, 1)  # dim 1 periodic
+        assert src == t.rank((0, 3))
+        assert dst == t.rank((0, 1))
+
+    def test_cart_sub(self, world):
+        c = topo.cart_create(world, [2, 4], [False, False])
+        rows = c.topo.sub([False, True])  # keep dim 1 -> 2 row comms
+        assert len(rows) == 2
+        for fixed, sub in rows.items():
+            assert sub.size == 4
+            assert sub.topo.dims == (4,)
+
+    def test_wrong_size_raises(self, world):
+        with pytest.raises(ArgumentError):
+            topo.cart_create(world, [3, 3], [False, False])
+
+    def test_dims_create(self):
+        assert topo.dims_create(8, 3) == (2, 2, 2)
+        assert topo.dims_create(12, 2) == (4, 3)
+        assert topo.dims_create(7, 2) == (7, 1)
+
+
+class TestGraph:
+    def test_neighbors(self, world):
+        # ring graph in CSR form
+        n = world.size
+        index, edges = [], []
+        total = 0
+        for r in range(n):
+            es = [(r - 1) % n, (r + 1) % n]
+            edges.extend(es)
+            total += len(es)
+            index.append(total)
+        g = topo.graph_create(world, index, edges)
+        assert g.topo.neighbors(0) == [n - 1, 1]
+        assert g.topo.neighbor_count(3) == 2
+
+
+class TestNeighborColl:
+    def test_neighbor_allgather_cart(self, world):
+        c = topo.cart_create(world, [2, 4], [True, True])
+        data = np.arange(8, dtype=np.float32)[:, None] * np.ones(
+            (8, 3), np.float32
+        )
+        x = c.put_rank_major(data)
+        out = topo.neighbor_allgather(c, x)
+        t = c.topo
+        for r in range(8):
+            neigh = t.neighbors(r)
+            got = np.asarray(out[r])
+            np.testing.assert_array_equal(got[:, 0],
+                                          np.asarray(neigh, np.float32))
+
+    def test_neighbor_alltoall_dist_graph(self, world):
+        import jax.numpy as jnp
+
+        # rank r sends to r+1 (mod n): sources/destinations maps.
+        n = world.size
+        dests = {r: [(r + 1) % n] for r in range(n)}
+        srcs = {r: [(r - 1) % n] for r in range(n)}
+        g = topo.dist_graph_create(world, srcs, dests)
+        send = {r: jnp.asarray([[float(r)]]) for r in range(n)}
+        recv = topo.neighbor_alltoall(g, send)
+        for r in range(n):
+            assert float(np.asarray(recv[r])[0][0]) == float((r - 1) % n)
