@@ -81,6 +81,7 @@ def compile_plan(
     per_rank_fn: Callable,
     *,
     donate: bool = False,
+    check_vma: bool = True,
 ) -> Callable:
     """Build (or fetch) the jitted shard_map program applying
     ``per_rank_fn(block)`` on every rank's leading-axis block."""
@@ -99,8 +100,12 @@ def compile_plan(
         res = per_rank_fn(squeezed)
         return jax.tree.map(lambda r: r[None], res)
 
+    # check_vma=False is for pallas plans only: pallas_call outputs
+    # mix varying and replicated values that trip jax's vma tracking
+    # (jax's documented workaround); other components keep the check.
     fn = jax.shard_map(
-        wrapped, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks")
+        wrapped, mesh=mesh, in_specs=P("ranks"), out_specs=P("ranks"),
+        check_vma=check_vma,
     )
     plan = jax.jit(fn, donate_argnums=(0,) if donate else ())
     cache[key] = plan
@@ -220,7 +225,7 @@ class PersistentColl(Request):
 
 def register_components() -> None:
     """Import all in-tree coll components so they self-register."""
-    from . import basic, selfcoll, tuned, xla  # noqa: F401
+    from . import basic, pallas_ring, selfcoll, tuned, xla  # noqa: F401
 
 
 _registered = False

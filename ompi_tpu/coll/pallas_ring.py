@@ -1,0 +1,439 @@
+"""coll/pallas — hand-scheduled ICI ring collectives as Pallas kernels.
+
+The TPU-native replacement for the reference's explicit algorithm
+implementations (reference: ring allreduce coll_base_allreduce.c:341,
+ring allgather coll_base_allgather.c, reduce_scatter ring
+coll_base_reduce_scatter.c): instead of PML send/recv per round with a
+CPU SIMD reduce (ompi/mca/op/avx) between rounds, each kernel drives the
+inter-chip DMA engines directly (`pltpu.make_async_remote_copy` over
+ICI) and fuses the per-step reduction on the VPU while the next block is
+in flight — the compute/communication overlap the segmented-ring
+algorithm (coll_base_allreduce.c:618) approximates in software.
+
+Flow control: the two-slot communication buffer is protected by a
+capacity semaphore the consumer remote-signals back to its upstream
+neighbor after draining a slot; the producer waits before re-filling.
+(The reference's analog is the BTL flow-control window / fastbox
+`in_use` flags, btl_sm_fbox.h:22-60 — without it a fast sender clobbers
+a slot two steps ahead, which we observed in practice.)
+
+These kernels are selected by the `coll/pallas` component (opt-in via
+``coll_select=pallas`` or per-op tuned rules); `coll/xla` remains the
+default since XLA's own collectives are already ICI-optimal for the
+common cases. The kernels run compiled on TPU meshes and in Mosaic
+interpret mode on the CPU test mesh (tests/conftest.py's 8 virtual
+devices), mirroring the reference's strategy of exercising transport
+algorithms over loopback (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..core import config
+from ..ops import lookup as op_lookup
+from ..ops.op import Op
+
+__all__ = [
+    "ring_allgather", "ring_reduce_scatter", "ring_allreduce",
+    "ppermute_shift",
+]
+
+_interpret_var = config.register(
+    "coll", "pallas", "interpret",
+    type=bool, default=None,
+    description="Force Mosaic interpret mode (auto: on for CPU backend)",
+)
+
+
+def _interpret():
+    """False on TPU (compiled); Mosaic TPU-interpret params on CPU —
+    the mode that emulates inter-device DMA + remote semaphore signals
+    (plain ``interpret=True`` cannot discharge remote signals)."""
+    forced = _interpret_var.value
+    if forced is not None and not forced:
+        return False
+    if forced or jax.default_backend() == "cpu":
+        return pltpu.InterpretParams()
+    return False
+
+
+def _combine_blocks(op: Op, a, b):
+    """Per-step reduction on the VPU (replaces ompi/mca/op/avx's CPU
+    SIMD loops; reference dispatch: op_avx_functions.c:28-66)."""
+    return op.combine(a, b)
+
+
+# ---------------------------------------------------------------------------
+# Kernels. All operate on a (n, chunk) view: the leading axis indexes
+# ring positions (rank blocks), `chunk` is the flattened payload slice.
+# ---------------------------------------------------------------------------
+
+def _allgather_kernel(axis_name: str, n: int, local_ref, out_ref,
+                      comm_buf, send_sem, recv_sem, cap_sem):
+    me = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+
+    out_ref[me] = local_ref[:]
+    comm_buf[0] = local_ref[:]
+
+    for step in range(n - 1):
+        slot = step % 2
+        nslot = (step + 1) % 2
+        # Backpressure: the slot we are about to fill downstream was
+        # last filled at step-2; wait until the consumer drained it.
+        if step >= 2:
+            pltpu.semaphore_wait(cap_sem.at[nslot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[slot],
+            dst_ref=comm_buf.at[nslot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nslot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        src_block = jax.lax.rem(me - step - 1 + n, n)
+        out_ref[src_block] = comm_buf[nslot]
+        # Drained comm_buf[nslot]; let upstream reuse it at step+2.
+        if step < n - 3:
+            pltpu.semaphore_signal(
+                cap_sem.at[nslot], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+
+def _reduce_scatter_kernel(axis_name: str, n: int, op: Op, x_ref, out_ref,
+                           comm_buf, send_sem, recv_sem, cap_sem):
+    """Ring reduce-scatter (the first phase of the reference's ring
+    allreduce, coll_base_allreduce.c:341): at step s, pass the partial
+    for block (me - s - 1) to the right, reducing on arrival; after
+    n-1 steps each rank holds the full reduction of block me."""
+    me = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+
+    # Start the chain with our partial for the block owned by our left
+    # neighbor's ... standard schedule: send block (me - 1), so that
+    # block b circulates from rank b+1 around to rank b, accumulating.
+    first = jax.lax.rem(me - 1 + n, n)
+    comm_buf[0] = x_ref[first]
+
+    for step in range(n - 1):
+        slot = step % 2
+        nslot = (step + 1) % 2
+        if step >= 2:
+            pltpu.semaphore_wait(cap_sem.at[nslot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[slot],
+            dst_ref=comm_buf.at[nslot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nslot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        # Arrived: partial sum for block (me - step - 2) ... derive from
+        # schedule: we received what left sent = left's block index
+        # (left - step - 1) = me - step - 2.
+        blk = jax.lax.rem(me - step - 2 + 2 * n, n)
+        reduced = _combine_blocks(op, comm_buf[nslot], x_ref[blk])
+        if step < n - 2:
+            comm_buf[nslot] = reduced
+            if step < n - 3:
+                pltpu.semaphore_signal(
+                    cap_sem.at[nslot], inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+        else:
+            out_ref[:] = reduced
+
+
+def _allreduce_kernel(axis_name: str, n: int, op: Op, x_ref, out_ref,
+                      comm_buf, send_sem, recv_sem, cap_sem):
+    """Ring allreduce = reduce-scatter phase + allgather phase in one
+    kernel (2(n-1) steps, the bandwidth-optimal schedule the tuned
+    decision layer picks for large commutative reductions —
+    coll_tuned_decision_fixed.c:45-87)."""
+    me = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+
+    first = jax.lax.rem(me - 1 + n, n)
+    comm_buf[0] = x_ref[first]
+
+    for step in range(2 * (n - 1)):
+        slot = step % 2
+        nslot = (step + 1) % 2
+        if step >= 2:
+            pltpu.semaphore_wait(cap_sem.at[nslot], 1)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=comm_buf.at[slot],
+            dst_ref=comm_buf.at[nslot],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[nslot],
+            device_id=right,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+        if step < n - 1:
+            # reduce-scatter phase
+            blk = jax.lax.rem(me - step - 2 + 2 * n, n)
+            val = _combine_blocks(op, comm_buf[nslot], x_ref[blk])
+            comm_buf[nslot] = val
+            # The block completed at the last RS step (blk == me) is the
+            # first fully-reduced one; store it before the AG phase.
+            if step == n - 2:
+                out_ref[blk] = val
+        else:
+            # allgather phase: circulate the fully-reduced blocks.
+            blk = jax.lax.rem(me - (step - (n - 1)) - 1 + 2 * n, n)
+            out_ref[blk] = comm_buf[nslot]
+        if step < 2 * (n - 1) - 2:
+            pltpu.semaphore_signal(
+                cap_sem.at[nslot], inc=1, device_id=left,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+
+# ---------------------------------------------------------------------------
+# Host-callable wrappers (shard_map bodies). Input per shard: the local
+# (n, chunk) contribution view.
+# ---------------------------------------------------------------------------
+
+def _sems():
+    return [
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.DMA((2,)),
+        pltpu.SemaphoreType.REGULAR((2,)),
+    ]
+
+
+def _pad_chunk(x: jax.Array) -> tuple[jax.Array, int, tuple]:
+    """Flatten to (lanes,) padded to the f32 tile quantum so VMEM
+    blocks tile cleanly (pallas_guide: min tile (8,128) for f32)."""
+    orig_shape = x.shape
+    flat = x.reshape(-1)
+    pad = (-flat.size) % 128
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, pad, orig_shape
+
+
+def ring_allgather(x: jax.Array, axis_name: str) -> jax.Array:
+    """Inside shard_map: local block (chunk,) -> gathered (n, chunk)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x[None]
+    flat, pad, shape = _pad_chunk(x)
+    kernel = functools.partial(_allgather_kernel, axis_name, n)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, flat.size), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((2, flat.size), flat.dtype)] + _sems(),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=0,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape((n,) + shape)
+
+
+def ring_reduce_scatter(x: jax.Array, axis_name: str, op: Any = "sum"
+                        ) -> jax.Array:
+    """Inside shard_map: local (n, chunk) contributions -> own reduced
+    block (chunk,)."""
+    op = op_lookup(op)
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x[0]
+    shape = x.shape[1:]
+    flat = x.reshape(n, -1)
+    lanes = flat.shape[1]
+    pad = (-lanes) % 128
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    kernel = functools.partial(_reduce_scatter_kernel, axis_name, n, op)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.shape[1],), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((2, flat.shape[1]), flat.dtype)] + _sems(),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=1,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+def ring_allreduce(x: jax.Array, axis_name: str, op: Any = "sum"
+                   ) -> jax.Array:
+    """Inside shard_map: local (n, chunk) contributions -> fully
+    reduced (n, chunk) (every block identical across ranks only in the
+    rank-major world view; here each rank returns all blocks)."""
+    op = op_lookup(op)
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    shape = x.shape[1:]
+    flat = x.reshape(n, -1)
+    pad = (-flat.shape[1]) % 128
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    kernel = functools.partial(_allreduce_kernel, axis_name, n, op)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[pltpu.VMEM((2, flat.shape[1]), flat.dtype)] + _sems(),
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=2,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape((n,) + shape)
+
+
+def ppermute_shift(x: jax.Array, axis_name: str, shift: int = 1
+                   ) -> jax.Array:
+    """One ring hop as a Pallas remote DMA — the building block for
+    ring attention's rotating KV blocks (SURVEY §5.7 plan: 'ring
+    send-recv Pallas kernel with double-buffered ICI DMA')."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    flat, pad, shape = _pad_chunk(x)
+
+    def kernel(local_ref, out_ref, send_sem, recv_sem):
+        me = jax.lax.axis_index(axis_name)
+        dst = jax.lax.rem(me + shift + n, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=local_ref,
+            dst_ref=out_ref,
+            send_sem=send_sem,
+            recv_sem=recv_sem,
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.size,), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA(()), pltpu.SemaphoreType.DMA(()),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=3,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# Component: comm-vtable entry points over the kernels. Each rank's
+# buffer is split into n ring segments so the schedule pipelines the
+# whole payload (the reference's ring operates on per-rank blocks the
+# same way, coll_base_allreduce.c:341).
+# ---------------------------------------------------------------------------
+
+from .framework import COLL, CollComponent, compile_plan, rank_major_check  # noqa: E402
+
+
+def _split_ring(b: jax.Array, n: int) -> tuple[jax.Array, int, tuple]:
+    shape = b.shape
+    flat = b.reshape(-1)
+    pad = (-flat.size) % (n * 128)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat.reshape(n, -1), pad, shape
+
+
+def _unsplit_ring(blocks: jax.Array, pad: int, shape: tuple) -> jax.Array:
+    flat = blocks.reshape(-1)
+    if pad:
+        flat = flat[:-pad]
+    return flat.reshape(shape)
+
+
+def allreduce_block(b: jax.Array, axis_name: str, op: Any) -> jax.Array:
+    """shard_map body: rank's contribution -> fully reduced buffer."""
+    n = jax.lax.axis_size(axis_name)
+    segs, pad, shape = _split_ring(b, n)
+    out = ring_allreduce(segs, axis_name, op)
+    return _unsplit_ring(out, pad, shape)
+
+
+@COLL.register
+class PallasColl(CollComponent):
+    NAME = "pallas"
+    PRIORITY = 30  # below coll/xla (40): opt-in via coll_select/priority
+    DESCRIPTION = "hand-scheduled ICI ring kernels (Pallas remote DMA)"
+
+    def allreduce(self, comm, x, op):
+        op = op_lookup(op)
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x
+        key = ("allreduce", "pallas", op.cache_key, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: allreduce_block(b, "ranks", op),
+            check_vma=False,
+        )
+        return plan(x)
+
+    def allgather(self, comm, x):
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x[:, None]
+        key = ("allgather", "pallas", x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: ring_allgather(b, "ranks"),
+            check_vma=False,
+        )
+        return plan(x)
+
+    def reduce_scatter_block(self, comm, x, op):
+        op = op_lookup(op)
+        x = rank_major_check(comm, x, min_ndim=2)
+        if comm.size == 1:
+            return x[:, 0]
+        key = ("reduce_scatter_block", "pallas", op.cache_key, x.shape,
+               str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: ring_reduce_scatter(b, "ranks", op),
+            check_vma=False,
+        )
+        return plan(x)

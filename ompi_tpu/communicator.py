@@ -139,14 +139,26 @@ class Communicator(HasAttributes):
         return jax.device_put(arr, self.rank_sharding())
 
     def from_rank_values(self, values: Sequence) -> Any:
-        """Stack one array per rank into a rank-major buffer."""
+        """Assemble one array per rank into a rank-major buffer without
+        moving data: block i stays on rank i's device (zero-copy when
+        the values already live there)."""
+        import jax
         import jax.numpy as jnp
 
         if len(values) != self.size:
             raise ArgumentError(
                 f"{len(values)} values for comm of size {self.size}"
             )
-        return self.put_rank_major(jnp.stack([jnp.asarray(v) for v in values]))
+        if self.size == 1:
+            return self.put_rank_major(jnp.asarray(values[0])[None])
+        blocks = [
+            jnp.expand_dims(jax.device_put(jnp.asarray(v), d), 0)
+            for v, d in zip(values, self.devices)
+        ]
+        shape = (self.size,) + tuple(blocks[0].shape[1:])
+        return jax.make_array_from_single_device_arrays(
+            shape, self.rank_sharding(), blocks
+        )
 
     def check_rank(self, rank: int) -> int:
         if not 0 <= rank < self.size:

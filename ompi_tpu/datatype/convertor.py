@@ -270,6 +270,17 @@ def _element_indices(datatype: Datatype, count: int,
 _device_plan_cache: dict[tuple, object] = {}
 
 
+def _structural_key(datatype: Datatype) -> tuple:
+    """Layout-identity key: two datatypes with the same typemap and
+    extent share a plan; id() would alias a dead datatype's plan onto a
+    new object reusing its address."""
+    return (
+        tuple((e.offset, str(e.dtype)) for e in datatype.elements),
+        datatype.extent,
+        datatype.lb,
+    )
+
+
 def pack_device(x, datatype, count: int):
     """Gather a non-contiguous layout out of a device array into a
     packed device array (stays in HBM)."""
@@ -279,7 +290,8 @@ def pack_device(x, datatype, count: int):
     datatype = lookup(datatype).commit()
     arr = jnp.asarray(x)
     idx = _element_indices(datatype, count, arr.dtype.itemsize)
-    key = ("pack", id(datatype), count, arr.shape, str(arr.dtype))
+    key = ("pack", _structural_key(datatype), count, arr.shape,
+           str(arr.dtype))
     fn = _device_plan_cache.get(key)
     if fn is None:
         idx_dev = jnp.asarray(idx)
@@ -303,7 +315,8 @@ def unpack_device(packed, out_template, datatype, count: int):
     datatype = lookup(datatype).commit()
     tmpl = jnp.asarray(out_template)
     idx = _element_indices(datatype, count, tmpl.dtype.itemsize)
-    key = ("unpack", id(datatype), count, tmpl.shape, str(tmpl.dtype))
+    key = ("unpack", _structural_key(datatype), count, tmpl.shape,
+           str(tmpl.dtype))
     fn = _device_plan_cache.get(key)
     if fn is None:
         idx_dev = jnp.asarray(idx)

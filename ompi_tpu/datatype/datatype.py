@@ -123,9 +123,12 @@ class Datatype:
         return self
 
     def _merge_segments(self) -> tuple[tuple[int, int], ...]:
-        spans = sorted(
-            (e.offset, e.dtype.itemsize) for e in self._elements
-        )
+        # Typemap order, NOT memory order: MPI pack order is typemap
+        # order (reference: opal_datatype_optimize.c merges only
+        # consecutive typemap entries), and the device pack path
+        # (_element_indices) walks the typemap too — sorting here would
+        # silently reorder the packed stream for non-monotone typemaps.
+        spans = [(e.offset, e.dtype.itemsize) for e in self._elements]
         merged: list[list[int]] = []
         for off, ln in spans:
             if merged and merged[-1][0] + merged[-1][1] == off:

@@ -75,7 +75,6 @@ class Window:
         self._locks: dict[int, str] = {}  # target -> lock type
         self._pscw_group = None
         self._freed = False
-        self._plan_cache: dict[tuple, Any] = {}
 
     # -- accessors --------------------------------------------------------
 
@@ -199,10 +198,13 @@ class Window:
         SPC.record("osc_put_calls")
         from ..monitoring import MONITOR
 
-        MONITOR.record_osc(
-            self.comm.cid, target, "put",
-            int(getattr(np.asarray(value), "nbytes", 0)),
-        )
+        if MONITOR.enabled:
+            # nbytes without forcing a device→host transfer: jax arrays
+            # expose it directly; only host data goes through asarray.
+            nbytes = getattr(value, "nbytes", None)
+            if nbytes is None:
+                nbytes = int(getattr(np.asarray(value), "nbytes", 0))
+            MONITOR.record_osc(self.comm.cid, target, "put", int(nbytes))
 
     def get(self, target: int, index=None) -> "WindowResult":
         self._check_alive()

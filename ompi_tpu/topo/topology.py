@@ -202,13 +202,15 @@ def cart_create(comm, dims: Sequence[int],
     order follows ICI-adjacent devices (treematch analog)."""
     if periods is None:
         periods = [False] * len(dims)
-    new = comm.dup()
+    new = None
     if reorder:
         order = mesh_mod.ring_order(comm.procs)
         if order != [p.rank for p in comm.procs]:
             from ..group import Group
 
             new = comm.create(Group(order))
+    if new is None:
+        new = comm.dup()
     new.topo = CartTopology(new, dims, periods)
     new.set_name(f"{comm.name}.cart{tuple(dims)}")
     return new
@@ -216,6 +218,9 @@ def cart_create(comm, dims: Sequence[int],
 
 def graph_create(comm, index: Sequence[int], edges: Sequence[int],
                  reorder: bool = False):
+    # reorder is advisory in MPI; no graph-aware reorder is implemented
+    # (the reference's treematch analog only drives cart_create), so an
+    # unreordered communicator is returned either way.
     new = comm.dup()
     new.topo = GraphTopology(new, index, edges)
     return new
@@ -278,6 +283,16 @@ def neighbor_alltoall(comm, sendblocks: dict):
             mail[(r, dst)] = blocks[j]
     out = {}
     for r in range(comm.size):
-        got = [mail[(src, r)] for src in ins(r) if (src, r) in mail]
+        got = []
+        for src in ins(r):
+            if (src, r) not in mail:
+                # MPI semantics: every in-edge must have a matching
+                # out-edge at the source; a silent skip would misalign
+                # received blocks against in-neighbor order.
+                raise TopologyError(
+                    f"rank {r} lists {src} as in-neighbor but rank "
+                    f"{src} does not list {r} as out-neighbor"
+                )
+            got.append(mail[(src, r)])
         out[r] = jnp.stack(got) if got else None
     return out

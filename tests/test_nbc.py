@@ -1,0 +1,183 @@
+"""libnbc-analog schedule engine tests (SURVEY §2.3 coll/libnbc).
+
+Mirrors the reference's test model: collectives composed from p2p over
+the full stack on one host (SURVEY §4 — btl/self + multi-rank loopback),
+with round-by-round progress observable from the outside.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.coll import nbc
+
+
+@pytest.fixture(scope="module")
+def world():
+    return ompi_tpu.init()
+
+
+def rank_data(comm, shape=(8,), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((comm.size,) + shape).astype(np.float32)
+
+
+def test_schedule_structure(world):
+    n = world.size
+    s = nbc.sched_bcast_binomial(n, 0).commit()
+    # binomial tree: ceil(log2(n)) rounds
+    assert s.n_rounds == int(np.ceil(np.log2(n)))
+    s = nbc.sched_barrier_dissemination(n).commit()
+    assert s.n_rounds == int(np.ceil(np.log2(n)))
+
+
+def test_ibcast(world):
+    data = rank_data(world, seed=1)
+    for root in [0, 3, world.size - 1]:
+        req = nbc.ibcast(world, data, root=root)
+        req.wait()
+        got = np.asarray(req.result())
+        for r in range(world.size):
+            np.testing.assert_array_equal(got[r], data[root])
+
+
+def test_iallreduce(world):
+    data = rank_data(world, seed=2)
+    req = nbc.iallreduce(world, data, "sum")
+    req.wait()
+    got = np.asarray(req.result())
+    for r in range(world.size):
+        np.testing.assert_allclose(got[r], data.sum(0), rtol=1e-5)
+
+
+def test_iallreduce_max(world):
+    data = rank_data(world, seed=3)
+    req = nbc.iallreduce(world, data, "max")
+    got = np.asarray(req.result())
+    for r in range(world.size):
+        np.testing.assert_array_equal(got[r], data.max(0))
+
+
+def test_ireduce(world):
+    data = rank_data(world, seed=4)
+    req = nbc.ireduce(world, data, "sum", root=2)
+    got = np.asarray(req.result())
+    np.testing.assert_allclose(got, data.sum(0), rtol=1e-5)
+
+
+def test_iallgather(world):
+    data = rank_data(world, seed=5)
+    req = nbc.iallgather(world, data)
+    got = np.asarray(req.result())
+    for r in range(world.size):
+        np.testing.assert_array_equal(got[r], data)
+
+
+def test_ialltoall(world):
+    n = world.size
+    rng = np.random.default_rng(6)
+    data = rng.standard_normal((n, n, 4)).astype(np.float32)
+    req = nbc.ialltoall(world, data)
+    got = np.asarray(req.result())
+    for r in range(n):
+        np.testing.assert_array_equal(got[r], data[:, r])
+
+
+def test_igather_iscatter(world):
+    n = world.size
+    data = rank_data(world, seed=7)
+    req = nbc.igather(world, data, root=1)
+    got = np.asarray(req.result())
+    np.testing.assert_array_equal(got, data)
+
+    req = nbc.iscatter(world, data, root=1)
+    got = np.asarray(req.result())
+    np.testing.assert_array_equal(got, data)
+
+
+def test_ireduce_scatter_block(world):
+    n = world.size
+    rng = np.random.default_rng(8)
+    data = rng.standard_normal((n, n, 4)).astype(np.float32)
+    req = nbc.ireduce_scatter_block(world, data, "sum")
+    got = np.asarray(req.result())
+    expected = data.sum(0)
+    for r in range(n):
+        np.testing.assert_allclose(got[r], expected[r], rtol=1e-5)
+
+
+def test_iscan_iexscan(world):
+    data = rank_data(world, seed=9)
+    req = nbc.iscan(world, data, "sum")
+    got = np.asarray(req.result())
+    expected = np.cumsum(data, axis=0)
+    for r in range(world.size):
+        np.testing.assert_allclose(got[r], expected[r], rtol=1e-5)
+
+    req = nbc.iexscan(world, data, "sum")
+    got = np.asarray(req.result())
+    np.testing.assert_allclose(got[0], np.zeros_like(data[0]))
+    for r in range(1, world.size):
+        np.testing.assert_allclose(got[r], expected[r - 1], rtol=1e-5)
+
+
+def test_ibarrier(world):
+    req = nbc.ibarrier(world)
+    req.wait()
+    assert req.done
+
+
+def test_round_by_round_progress(world):
+    """The schedule advances at most one round per progress tick —
+    the observable overlap property (reference: NBC_Progress)."""
+    from ompi_tpu.core import progress
+
+    data = rank_data(world, seed=10)
+    req = nbc.iallreduce(world, data, "sum")
+    n_rounds = req._sched.n_rounds
+    assert not req.done
+    seen = [req.rounds_done]
+    for _ in range(n_rounds + 2):
+        progress.progress()
+        seen.append(req.rounds_done)
+    assert req.done
+    # monotone, stepping by <= 1 round per tick
+    assert all(b - a <= 1 for a, b in zip(seen, seen[1:]))
+    got = np.asarray(req.result())
+    np.testing.assert_allclose(got[0], data.sum(0), rtol=1e-5)
+
+
+def test_overlapping_schedules(world):
+    """Two in-flight schedules interleave and complete independently."""
+    d1 = rank_data(world, seed=11)
+    d2 = rank_data(world, seed=12)
+    r1 = nbc.iallreduce(world, d1, "sum")
+    r2 = nbc.ibcast(world, d2, root=0)
+    r2.wait()
+    r1.wait()
+    np.testing.assert_allclose(
+        np.asarray(r1.result())[3], d1.sum(0), rtol=1e-5
+    )
+    np.testing.assert_array_equal(np.asarray(r2.result())[5], d2[0])
+
+
+def test_schedule_cache(world):
+    """Same (op, size) reuses the compiled schedule (libnbc's cache)."""
+    d = rank_data(world, seed=13)
+    r1 = nbc.iallreduce(world, d, "sum")
+    s1 = r1._sched
+    r1.wait()
+    r2 = nbc.iallreduce(world, d, "max")
+    assert r2._sched is s1
+    r2.wait()
+
+
+def test_subcommunicator(world):
+    """Schedules run on split communicators (vrank mapping)."""
+    colors = [r % 2 for r in range(world.size)]
+    sub = world.split(colors)  # color -> sub-communicator
+    for c in sub.values():
+        data = np.arange(c.size * 4, dtype=np.float32).reshape(c.size, 4)
+        req = nbc.iallreduce(c, data, "sum")
+        got = np.asarray(req.result())
+        np.testing.assert_allclose(got[0], data.sum(0), rtol=1e-5)
