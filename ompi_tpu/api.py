@@ -97,6 +97,18 @@ def finalize() -> None:
             return
         from .communicator import live_comms
 
+        try:
+            from .io import fbtl as _fbtl
+            from .io.file import live_files
+
+            for fh in list(live_files):
+                try:
+                    fh.close()
+                except Exception:
+                    logger.exception("finalize: file close failed")
+            _fbtl.shutdown_pool()
+        except ImportError:
+            pass
         for comm in list(live_comms):
             if not comm._freed:
                 comm.free()

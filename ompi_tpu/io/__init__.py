@@ -1,0 +1,27 @@
+"""Parallel IO: the OMPIO-style stack (fs / fbtl / fcoll / sharedfp).
+
+TPU-native equivalent of ompi/mca/io (reference: io/ompio + the
+fs/fbtl/fcoll/sharedfp frameworks it decomposes into, SURVEY §2.3).
+"""
+
+from . import fbtl, fcoll, fs, sharedfp, view
+from .file import File, delete, live_files, open
+from .fs import (
+    APPEND,
+    CREATE,
+    DELETE_ON_CLOSE,
+    EXCL,
+    RDONLY,
+    RDWR,
+    SEQUENTIAL,
+    UNIQUE_OPEN,
+    WRONLY,
+)
+from .view import FileView, contiguous_view
+
+__all__ = [
+    "APPEND", "CREATE", "DELETE_ON_CLOSE", "EXCL", "File", "FileView",
+    "RDONLY", "RDWR", "SEQUENTIAL", "UNIQUE_OPEN", "WRONLY",
+    "contiguous_view", "delete", "fbtl", "fcoll", "fs", "live_files",
+    "open", "sharedfp", "view",
+]

@@ -1,0 +1,156 @@
+"""fs framework: filesystem-level operations (open/close/delete/size).
+
+TPU-native equivalent of OMPIO's fs framework (reference: ompi/mca/fs —
+one component per filesystem: ufs/lustre/gpfs/pvfs2/ime; the base
+selects by probing the mount, fs_base_file_select.c). Here the default
+component is POSIX (covers local disk and FUSE-mounted object stores,
+which is how TPU VMs see GCS buckets); the selection hook keys on the
+path so cluster-filesystem components can claim their mounts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any
+
+from ..core import component as mca
+from ..core.errors import IOError_
+
+FS = mca.framework("fs", "file system operations")
+
+# amode flags (MPI 3.1 §13.2.1)
+RDONLY = 0x0001
+RDWR = 0x0002
+WRONLY = 0x0004
+CREATE = 0x0008
+EXCL = 0x0010
+DELETE_ON_CLOSE = 0x0020
+UNIQUE_OPEN = 0x0040
+SEQUENTIAL = 0x0100
+APPEND = 0x0200
+# Internal extension (not an MPI mode): fopen-style "w"/"w+" truncate.
+TRUNCATE = 0x8000
+
+_ACCESS = RDONLY | RDWR | WRONLY
+
+
+def check_amode(amode: int) -> int:
+    n = bin(amode & _ACCESS).count("1")
+    if n != 1:
+        raise IOError_(
+            "amode must have exactly one of RDONLY/RDWR/WRONLY"
+        )
+    if (amode & RDONLY) and (amode & (CREATE | EXCL)):
+        raise IOError_("RDONLY cannot combine with CREATE/EXCL")
+    return amode
+
+
+def parse_amode(spec) -> int:
+    """Accept an int flag word or an fopen-style string:
+    'r' → RDONLY, 'w' → WRONLY|CREATE, 'r+'/'w+' → RDWR(+CREATE),
+    'a' → WRONLY|CREATE|APPEND."""
+    if isinstance(spec, int):
+        return check_amode(spec)
+    table = {
+        "r": RDONLY,
+        "w": WRONLY | CREATE | TRUNCATE,
+        "r+": RDWR,
+        "w+": RDWR | CREATE | TRUNCATE,
+        "a": WRONLY | CREATE | APPEND,
+        "a+": RDWR | CREATE | APPEND,
+    }
+    try:
+        return table[spec]
+    except KeyError:
+        raise IOError_(f"bad amode {spec!r}") from None
+
+
+class FsComponent(mca.Component):
+    """Interface: open/close/delete/get_size/set_size/sync."""
+
+    def fs_open(self, path: str, amode: int) -> Any:
+        raise NotImplementedError
+
+    def fs_close(self, handle: Any) -> None:
+        raise NotImplementedError
+
+    def fs_delete(self, path: str) -> None:
+        raise NotImplementedError
+
+    def fs_get_size(self, handle: Any) -> int:
+        raise NotImplementedError
+
+    def fs_set_size(self, handle: Any, size: int) -> None:
+        raise NotImplementedError
+
+    def fs_preallocate(self, handle: Any, size: int) -> None:
+        raise NotImplementedError
+
+    def fs_sync(self, handle: Any) -> None:
+        raise NotImplementedError
+
+
+@FS.register
+class PosixFs(FsComponent):
+    """POSIX filesystem ops (reference: ompi/mca/fs/ufs/fs_ufs_file_open.c
+    — plain open(2) with mode translation)."""
+
+    NAME = "posix"
+    PRIORITY = 10
+    DESCRIPTION = "POSIX open/close/truncate/fsync"
+
+    def fs_open(self, path: str, amode: int) -> int:
+        flags = 0
+        if amode & RDONLY:
+            flags |= os.O_RDONLY
+        elif amode & WRONLY:
+            flags |= os.O_WRONLY
+        elif amode & RDWR:
+            flags |= os.O_RDWR
+        if amode & CREATE:
+            flags |= os.O_CREAT
+        if amode & EXCL:
+            flags |= os.O_EXCL
+        if amode & TRUNCATE:
+            flags |= os.O_TRUNC
+        # APPEND deliberately does NOT set O_APPEND: Linux pwrite(2)
+        # ignores its offset on O_APPEND fds, which would break every
+        # positioned write. MPI_MODE_APPEND only asks for file pointers
+        # to start at EOF (MPI 3.1 §13.2.1) — File.__init__ does that.
+        try:
+            return os.open(path, flags, 0o644)
+        except OSError as e:
+            raise IOError_(f"open({path!r}): {e}") from e
+
+    def fs_close(self, handle: int) -> None:
+        try:
+            os.close(handle)
+        except OSError as e:
+            raise IOError_(f"close: {e}") from e
+
+    def fs_delete(self, path: str) -> None:
+        try:
+            os.unlink(path)
+        except OSError as e:
+            raise IOError_(f"delete({path!r}): {e}") from e
+
+    def fs_get_size(self, handle: int) -> int:
+        return os.fstat(handle).st_size
+
+    def fs_set_size(self, handle: int, size: int) -> None:
+        os.ftruncate(handle, size)
+
+    def fs_preallocate(self, handle: int, size: int) -> None:
+        try:
+            os.posix_fallocate(handle, 0, size)
+        except (OSError, AttributeError):
+            # tmpfs and some FUSE mounts reject fallocate; grow instead
+            if os.fstat(handle).st_size < size:
+                os.ftruncate(handle, size)
+
+    def fs_sync(self, handle: int) -> None:
+        os.fsync(handle)
+
+
+def select(path: str) -> FsComponent:
+    return FS.select_one(path=path)

@@ -1,0 +1,126 @@
+"""File views: (disp, etype, filetype) → file byte runs.
+
+TPU-native equivalent of OMPIO's file-view machinery (reference:
+ompi/mca/common/ompio/common_ompio_file_view.c — `mca_common_ompio_set_view`
+flattens the filetype into an (offset, length) iovec list that every
+read/write walks). Here the flattening reuses `Datatype.segments()` (the
+merged per-extent byte runs) and the tiling is computed lazily, so a view
+over a petabyte file costs nothing until accessed.
+
+Semantics (MPI-IO, MPI 3.1 §13.3): the filetype tiles the file starting
+at byte `disp`; only bytes inside the filetype's segments are visible.
+Offsets in the File API are in *etype units*; one filetype tile holds
+`filetype.size // etype.size` etypes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from ..core.errors import ArgumentError, DatatypeError
+from ..datatype import datatype as dt
+
+
+@dataclass(frozen=True)
+class FileView:
+    """One rank's window onto a file."""
+
+    disp: int  # absolute displacement, bytes
+    etype: dt.Datatype  # elementary unit of all offsets/counts
+    filetype: dt.Datatype  # tiling pattern (must be etype-aligned)
+
+    def __post_init__(self):
+        esz = self.etype.size
+        if esz == 0:
+            raise DatatypeError("etype must have nonzero size")
+        if self.filetype.size % esz != 0:
+            raise DatatypeError(
+                f"filetype size {self.filetype.size} not a multiple of "
+                f"etype size {esz}"
+            )
+        prev_end = None
+        for off, length in self.filetype.segments:
+            if off % esz or length % esz:
+                raise DatatypeError(
+                    "filetype segments must be etype-aligned: "
+                    f"({off}, {length}) vs etype size {esz}"
+                )
+            # MPI 3.1 §13.3 requires monotonically nondecreasing
+            # filetype displacements.
+            if prev_end is not None and off < prev_end:
+                raise DatatypeError(
+                    "filetype displacements must be monotonically "
+                    "nondecreasing for file views"
+                )
+            prev_end = off + length
+
+    @property
+    def etypes_per_tile(self) -> int:
+        return self.filetype.size // self.etype.size
+
+    @property
+    def tile_extent(self) -> int:
+        return self.filetype.extent
+
+    def byte_offset(self, offset_etypes: int) -> int:
+        """Absolute file byte position of etype index `offset_etypes`
+        (MPI_File_get_byte_offset)."""
+        for off, _ in self.runs(offset_etypes, self.etype.size):
+            return off
+        raise ArgumentError(f"bad view offset {offset_etypes}")
+
+    def runs(self, offset_etypes: int, nbytes: int
+             ) -> Iterator[tuple[int, int]]:
+        """Yield (file_byte_offset, length) covering `nbytes` of visible
+        data starting at etype index `offset_etypes`, coalescing runs
+        that are contiguous in the file."""
+        if nbytes < 0 or offset_etypes < 0:
+            raise ArgumentError("negative offset/length")
+        if nbytes == 0:
+            return
+        if nbytes % self.etype.size != 0:
+            raise ArgumentError(
+                f"access of {nbytes} bytes is not a whole number of "
+                f"etypes (etype size {self.etype.size})"
+            )
+        segs = self.filetype.segments
+        ept = self.etypes_per_tile
+        tile = offset_etypes // ept
+        # data-byte position inside the current tile:
+        data_pos = (offset_etypes % ept) * self.etype.size
+
+        pend_off: Optional[int] = None
+        pend_len = 0
+        remaining = nbytes
+        while remaining > 0:
+            tile_base = self.disp + tile * self.tile_extent
+            consumed = 0  # data bytes consumed so far within this tile
+            for seg_off, seg_len in segs:
+                if remaining <= 0:
+                    break
+                if data_pos >= consumed + seg_len:
+                    consumed += seg_len
+                    continue
+                skip = data_pos - consumed
+                start = tile_base + seg_off + skip
+                take = min(seg_len - skip, remaining)
+                if pend_off is not None and pend_off + pend_len == start:
+                    pend_len += take
+                else:
+                    if pend_off is not None:
+                        yield pend_off, pend_len
+                    pend_off, pend_len = start, take
+                remaining -= take
+                data_pos += take
+                consumed += seg_len
+            tile += 1
+            data_pos = 0
+        if pend_off is not None:
+            yield pend_off, pend_len
+
+
+def contiguous_view(etype: dt.Datatype) -> FileView:
+    """The default view: disp 0, filetype == etype (MPI_File_open's
+    initial state, MPI 3.1 §13.3)."""
+    return FileView(0, etype, etype)

@@ -1,0 +1,402 @@
+"""MPI-IO stack tests: views, individual/collective/shared/nonblocking.
+
+Mirrors the reference's IO test strategy (SURVEY §4): round-trips
+through strided views without a cluster, two-phase vs individual
+equivalence, shared-pointer ordering.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core import config
+from ompi_tpu.core.errors import ArgumentError, DatatypeError, IOError_
+from ompi_tpu.datatype import datatype as dt
+from ompi_tpu.io import view as view_mod
+from ompi_tpu import io as io_mod
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+# -- view machinery --------------------------------------------------------
+
+def test_contiguous_view_runs():
+    v = view_mod.contiguous_view(dt.FLOAT32)
+    runs = list(v.runs(2, 16))
+    assert runs == [(8, 16)]
+
+
+def test_vector_view_tiles():
+    # filetype: 2 floats taken, 2 skipped, per 16-byte tile
+    ft = dt.vector(1, 2, 4, dt.FLOAT32).resized(0, 16)
+    v = view_mod.FileView(0, dt.FLOAT32, ft)
+    assert v.etypes_per_tile == 2
+    runs = list(v.runs(0, 24))
+    assert runs == [(0, 8), (16, 8), (32, 8)]
+    # offset into the middle of a tile
+    assert list(v.runs(1, 8)) == [(4, 4), (16, 4)]
+    assert v.byte_offset(3) == 20
+
+
+def test_view_coalesces_adjacent():
+    v = view_mod.contiguous_view(dt.UINT8)
+    assert list(v.runs(0, 100)) == [(0, 100)]
+
+
+def test_view_rejects_misaligned_filetype():
+    ft = dt.vector(2, 3, 4, dt.UINT8)  # 3-byte blocks vs float32 etype
+    with pytest.raises(DatatypeError):
+        view_mod.FileView(0, dt.FLOAT32, ft)
+
+
+def test_view_disp_shifts_everything():
+    v = view_mod.FileView(100, dt.FLOAT32, dt.FLOAT32)
+    assert list(v.runs(0, 8)) == [(100, 8)]
+
+
+# -- individual read/write -------------------------------------------------
+
+def test_write_read_roundtrip(tmp_path, comm):
+    p = str(tmp_path / "a.bin")
+    data = np.arange(32, dtype=np.float32)
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.FLOAT32)
+        assert fh.write_at(0, data) == 32
+        back = np.asarray(fh.read_at(0, 32))
+    np.testing.assert_array_equal(back, data)
+
+
+def test_read_lands_on_rank_device(tmp_path, comm):
+    p = str(tmp_path / "d.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.FLOAT32)
+        fh.write_at(0, np.ones(4, np.float32))
+        r = comm.size - 1
+        arr = fh.read_at(0, 4, rank=r)
+        assert list(arr.devices())[0] == comm.devices[r]
+
+
+def test_individual_pointer_and_seek(tmp_path, comm):
+    p = str(tmp_path / "b.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.INT32)
+        fh.write(np.arange(4, dtype=np.int32))
+        fh.write(np.arange(4, 8, dtype=np.int32))
+        assert fh.get_position() == 8
+        fh.seek(0)
+        got = np.asarray(fh.read(8))
+        np.testing.assert_array_equal(got, np.arange(8))
+        fh.seek(-2, whence=2)
+        np.testing.assert_array_equal(np.asarray(fh.read(2)), [6, 7])
+
+
+def test_strided_view_interleaves_ranks(tmp_path, comm):
+    """Each rank writes its column through a vector filetype; the file
+    interleaves them round-robin — the canonical MPI-IO pattern."""
+    n = comm.size
+    p = str(tmp_path / "interleaved.bin")
+    per = 6
+    with io_mod.open(comm, p, "w+") as fh:
+        esz = 4
+        ft = dt.vector(1, 1, 1, dt.FLOAT32).resized(0, n * esz)
+        for r in range(n):
+            fh.set_view(r * esz, dt.FLOAT32, ft, rank=r)
+        for r in range(n):
+            fh.write_at(0, np.full(per, r, np.float32), rank=r)
+    raw = np.fromfile(p, np.float32)
+    expect = np.tile(np.arange(n, dtype=np.float32), per)
+    np.testing.assert_array_equal(raw, expect)
+
+
+def test_amode_enforcement(tmp_path, comm):
+    p = str(tmp_path / "ro.bin")
+    np.arange(4, dtype=np.uint8).tofile(p)
+    with io_mod.open(comm, p, "r") as fh:
+        with pytest.raises(IOError_):
+            fh.write_at(0, np.zeros(2, np.uint8))
+    with io_mod.open(comm, p, "w") as fh:
+        with pytest.raises(IOError_):
+            fh.read_at(0, 1)
+
+
+def test_append_mode_positions_pointers(tmp_path, comm):
+    """MPI_MODE_APPEND starts pointers at EOF but positioned writes
+    still honor their offsets (no O_APPEND fd semantics)."""
+    p = str(tmp_path / "app.bin")
+    np.full(8, 9, np.uint8).tofile(p)
+    with io_mod.open(comm, p, "a+") as fh:
+        assert fh.get_position() == 8
+        assert fh.get_position_shared() == 8
+        fh.write(np.full(4, 1, np.uint8))
+        # positioned write must land at offset 0, not append
+        fh.write_at(0, np.full(2, 5, np.uint8))
+    raw = np.fromfile(p, np.uint8)
+    np.testing.assert_array_equal(
+        raw, [5, 5, 9, 9, 9, 9, 9, 9, 1, 1, 1, 1]
+    )
+
+
+def test_w_mode_truncates(tmp_path, comm):
+    p = str(tmp_path / "tr.bin")
+    np.full(100, 3, np.uint8).tofile(p)
+    with io_mod.open(comm, p, "w") as fh:
+        fh.write_at(0, np.full(4, 1, np.uint8))
+    assert np.fromfile(p, np.uint8).shape == (4,)
+
+
+def test_delete_on_close(tmp_path, comm):
+    p = str(tmp_path / "tmp.bin")
+    fh = io_mod.File(
+        comm, p,
+        io_mod.WRONLY | io_mod.CREATE | io_mod.DELETE_ON_CLOSE,
+    )
+    fh.write_at(0, np.zeros(4, np.uint8))
+    fh.close()
+    import os
+
+    assert not os.path.exists(p)
+
+
+def test_size_sync_preallocate(tmp_path, comm):
+    p = str(tmp_path / "sz.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.preallocate(64)
+        assert fh.get_size() == 64
+        fh.set_size(16)
+        assert fh.get_size() == 16
+        fh.sync()
+
+
+# -- collective ------------------------------------------------------------
+
+def _rank_major(comm, per, dtype=np.float32):
+    return np.stack(
+        [np.full(per, r, dtype) for r in range(comm.size)]
+    )
+
+
+def test_write_at_all_two_phase(tmp_path, comm):
+    n = comm.size
+    per = 100
+    p = str(tmp_path / "coll.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.FLOAT32)
+        offs = [r * per for r in range(n)]
+        fh.write_at_all(offs, _rank_major(comm, per))
+        back = np.asarray(fh.read_at_all(offs, per))
+    for r in range(n):
+        np.testing.assert_array_equal(back[r], np.full(per, r, np.float32))
+    raw = np.fromfile(p, np.float32)
+    assert raw.shape == (n * per,)
+
+
+def test_two_phase_matches_individual(tmp_path, comm):
+    """Same strided collective write through two_phase and individual
+    must produce identical files."""
+    n = comm.size
+    paths = []
+    for comp in ("two_phase", "individual"):
+        p = str(tmp_path / f"{comp}.bin")
+        paths.append(p)
+        config.set("fcoll_select", comp)
+        try:
+            with io_mod.open(comm, p, "w+") as fh:
+                esz = 4
+                ft = dt.vector(1, 1, 1, dt.FLOAT32).resized(0, n * esz)
+                for r in range(n):
+                    fh.set_view(r * esz, dt.FLOAT32, ft, rank=r)
+                offs = [0] * n
+                fh.write_at_all(
+                    offs,
+                    np.stack([
+                        np.arange(8, dtype=np.float32) + 100 * r
+                        for r in range(n)
+                    ]),
+                )
+        finally:
+            config.set("fcoll_select", "")
+    a, b = (np.fromfile(x, np.float32) for x in paths)
+    np.testing.assert_array_equal(a, b)
+
+
+def test_two_phase_rmw_preserves_holes(tmp_path, comm):
+    """A collective write that covers only part of the domain must not
+    clobber pre-existing bytes in the holes."""
+    n = comm.size
+    p = str(tmp_path / "rmw.bin")
+    sentinel = np.full(n * 16 + 16, 7, np.uint8)
+    sentinel.tofile(p)
+    with io_mod.open(comm, p, "r+") as fh:
+        # each rank writes 2 bytes at widely spaced offsets
+        offs = [r * 16 for r in range(n)]
+        fh.write_at_all(
+            offs, np.stack([np.full(2, r, np.uint8) for r in range(n)])
+        )
+    raw = np.fromfile(p, np.uint8)
+    for r in range(n):
+        assert raw[r * 16] == r and raw[r * 16 + 1] == r
+        assert (raw[r * 16 + 2:r * 16 + 16] == 7).all()
+
+
+def test_read_all_with_pointer_update(tmp_path, comm):
+    n = comm.size
+    p = str(tmp_path / "ptr.bin")
+    np.arange(n * 8, dtype=np.int32).tofile(p)
+    with io_mod.open(comm, p, "r") as fh:
+        fh.set_view(0, dt.INT32)
+        fh.set_views([
+            view_mod.FileView(r * 32, dt.INT32, dt.INT32)
+            for r in range(n)
+        ])
+        out1 = np.asarray(fh.read_all(4))
+        out2 = np.asarray(fh.read_all(4))
+    for r in range(n):
+        np.testing.assert_array_equal(out1[r], np.arange(r * 8, r * 8 + 4))
+        np.testing.assert_array_equal(
+            out2[r], np.arange(r * 8 + 4, r * 8 + 8)
+        )
+
+
+def test_split_collective(tmp_path, comm):
+    n = comm.size
+    p = str(tmp_path / "split.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.FLOAT32)
+        offs = [r * 4 for r in range(n)]
+        fh.write_at_all_begin(offs, _rank_major(comm, 4))
+        fh.write_at_all_end()
+        fh.read_at_all_begin(offs, 4)
+        out = np.asarray(fh.read_at_all_end())
+    for r in range(n):
+        np.testing.assert_array_equal(out[r], np.full(4, r, np.float32))
+
+
+# -- shared pointer --------------------------------------------------------
+
+def test_shared_pointer_appends(tmp_path, comm):
+    p = str(tmp_path / "shared.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.INT32)
+        for r in range(comm.size):
+            fh.write_shared(np.full(2, r, np.int32), rank=r)
+        assert fh.get_position_shared() == 2 * comm.size
+        fh.seek_shared(0)
+        seen = []
+        for r in range(comm.size):
+            seen.extend(np.asarray(fh.read_shared(2, rank=r)).tolist())
+    # every rank's pair lands somewhere, no overlap
+    assert sorted(seen) == sorted(
+        v for r in range(comm.size) for v in (r, r)
+    )
+
+
+def test_write_ordered_is_rank_ordered(tmp_path, comm):
+    n = comm.size
+    p = str(tmp_path / "ordered.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.INT32)
+        fh.write_ordered(
+            np.stack([np.full(3, r, np.int32) for r in range(n)])
+        )
+    raw = np.fromfile(p, np.int32)
+    expect = np.repeat(np.arange(n, dtype=np.int32), 3)
+    np.testing.assert_array_equal(raw, expect)
+
+
+def test_lockedfile_sharedfp(tmp_path, comm):
+    config.set("sharedfp_select", "lockedfile")
+    try:
+        import os
+
+        p = str(tmp_path / "lf.bin")
+        with io_mod.open(comm, p, "w+") as fh:
+            fh.set_view(0, dt.INT32)
+            fh.write_shared(np.arange(4, dtype=np.int32))
+            assert fh.get_position_shared() == 4
+            assert os.path.exists(p + ".sharedfp")
+        # sidecar is removed at close (reference lockedfile behavior)
+        assert not os.path.exists(p + ".sharedfp")
+    finally:
+        config.set("sharedfp_select", "")
+
+
+# -- nonblocking -----------------------------------------------------------
+
+def test_nonblocking_individual(tmp_path, comm):
+    p = str(tmp_path / "nb.bin")
+    data = np.arange(1000, dtype=np.float64)
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.FLOAT64)
+        wreq = fh.iwrite_at(0, data)
+        wreq.wait()
+        rreq = fh.iread_at(0, 1000)
+        back = np.asarray(rreq.result())
+    np.testing.assert_array_equal(back, data)
+
+
+def test_nonblocking_error_surfaces(tmp_path, comm):
+    p = str(tmp_path / "nberr.bin")
+    np.zeros(4, np.uint8).tofile(p)
+    fh = io_mod.open(comm, p, "r")
+    fh.close()
+    # fd is closed: the async read must raise on wait, not hang
+    req = fh.fbtl.ipreadv(fh.handle, [(0, 4)])
+    with pytest.raises(Exception):
+        req.wait()
+
+
+def test_file_delete(tmp_path, comm):
+    p = str(tmp_path / "gone.bin")
+    np.zeros(4, np.uint8).tofile(p)
+    io_mod.delete(p)
+    import os
+
+    assert not os.path.exists(p)
+
+
+def test_darray_view_roundtrip(tmp_path, comm):
+    """Block-distributed 2-D array via darray filetypes: every rank
+    writes its block; a serial read sees the global row-major array."""
+    n = comm.size
+    if n % 2:
+        pytest.skip("needs even rank count")
+    pr, pc = 2, n // 2
+    g = (4, 2 * pc)
+    p = str(tmp_path / "darray.bin")
+    full = np.arange(g[0] * g[1], dtype=np.float32).reshape(g)
+    with io_mod.open(comm, p, "w+") as fh:
+        views = [
+            view_mod.FileView(
+                0, dt.FLOAT32,
+                dt.darray(
+                    n, r, g,
+                    (dt.DISTRIBUTE_BLOCK, dt.DISTRIBUTE_BLOCK),
+                    (dt.DISTRIBUTE_DFLT_DARG, dt.DISTRIBUTE_DFLT_DARG),
+                    (pr, pc), dt.FLOAT32,
+                ),
+            )
+            for r in range(n)
+        ]
+        fh.set_views(views)
+        br, bc = g[0] // pr, g[1] // pc
+        blocks = []
+        for r in range(n):
+            # darray rank order: row-major over the process grid
+            ri, ci = divmod(r, pc)
+            blocks.append(
+                full[ri * br:(ri + 1) * br, ci * bc:(ci + 1) * bc].ravel()
+            )
+        offs = [0] * n
+        fh.write_at_all(offs, np.stack(blocks))
+    raw = np.fromfile(p, np.float32).reshape(g)
+    np.testing.assert_array_equal(raw, full)
