@@ -132,3 +132,107 @@ class BasicColl(CollComponent):
 
     def barrier(self, comm):
         return None
+
+    # -- vector (ragged) variants -----------------------------------------
+    # Driver-mode ragged convention: inputs are per-rank sequences of
+    # arrays whose leading dims differ (the counts are carried by the
+    # shapes, so no separate counts argument — reference alltoallv's
+    # sendcounts/displs arrays collapse into the block list).
+
+    @staticmethod
+    def _ragged_in(comm, values) -> list[np.ndarray]:
+        if len(values) != comm.size:
+            raise ArgumentError(
+                f"need one block per rank ({comm.size}), got {len(values)}"
+            )
+        return [np.asarray(_to_host(v)) for v in values]
+
+    def allgatherv(self, comm, values):
+        host = self._ragged_in(comm, values)
+        cat = np.concatenate(host, axis=0)
+        return jax.device_put(cat, comm.replicated_sharding())
+
+    def gatherv(self, comm, values, root):
+        host = self._ragged_in(comm, values)
+        cat = np.concatenate(host, axis=0)
+        return jax.device_put(cat, comm.devices[root])
+
+    def scatterv(self, comm, blocks, root):
+        host = self._ragged_in(comm, blocks)
+        return [
+            jax.device_put(b, comm.devices[r])
+            for r, b in enumerate(host)
+        ]
+
+    def alltoallv(self, comm, blocks):
+        """blocks[src][dst] = array for dst; returns out[dst] =
+        concatenation over src of blocks[src][dst], on dst's device."""
+        n = comm.size
+        if len(blocks) != n:
+            raise ArgumentError(f"need {n} send lists, got {len(blocks)}")
+        out = []
+        for dstr in range(n):
+            pieces = [
+                np.asarray(_to_host(blocks[src][dstr])) for src in range(n)
+            ]
+            out.append(
+                jax.device_put(
+                    np.concatenate(pieces, axis=0), comm.devices[dstr]
+                )
+            )
+        return out
+
+    def alltoallw(self, comm, blocks):
+        """Like alltoallv but fully heterogeneous: no concatenation —
+        out[dst][src] keeps each block's own shape/dtype (reference
+        MPI_Alltoallw's per-block datatypes)."""
+        n = comm.size
+        if len(blocks) != n:
+            raise ArgumentError(f"need {n} send lists, got {len(blocks)}")
+        return [
+            [
+                jax.device_put(
+                    np.asarray(_to_host(blocks[src][dst])),
+                    comm.devices[dst],
+                )
+                for src in range(n)
+            ]
+            for dst in range(n)
+        ]
+
+    def reduce_scatter(self, comm, values, counts, op):
+        """MPI_Reduce_scatter: element-wise reduce the per-rank (total,
+        ...) buffers, then scatter piece r (counts[r] rows) to rank r."""
+        op = op_lookup(op)
+        host = self._ragged_in(comm, values)
+        n = comm.size
+        if len(counts) != n:
+            raise ArgumentError(f"need {n} counts, got {len(counts)}")
+        total = sum(counts)
+        for h in host:
+            if h.shape[0] != total:
+                raise ArgumentError(
+                    f"buffer rows {h.shape[0]} != sum(counts) {total}"
+                )
+        acc = host[0]
+        for i in range(1, n):
+            acc = op.np_reduce(acc, host[i])
+        out, start = [], 0
+        for r, c in enumerate(counts):
+            out.append(
+                jax.device_put(acc[start:start + c], comm.devices[r])
+            )
+            start += c
+        return out
+
+    # -- neighborhood collectives over the attached topology --------------
+
+    def neighbor_allgather(self, comm, x):
+        from ..topo import topology as topo_mod
+
+        return topo_mod.neighbor_allgather(comm, x)
+
+    def neighbor_alltoall(self, comm, sendblocks):
+        from ..topo import topology as topo_mod
+
+        return topo_mod.neighbor_alltoall(comm, sendblocks)

@@ -42,6 +42,18 @@ OPERATIONS = (
     "scan",
     "exscan",
     "barrier",
+    # vector (per-rank counts) variants, reference
+    # coll_base_functions.h:75-76 (alltoallv/w) and the *v family
+    "allgatherv",
+    "gatherv",
+    "scatterv",
+    "alltoallv",
+    "alltoallw",
+    "reduce_scatter",
+    # neighborhood collectives over the comm topology, reference
+    # coll_base_functions.h:62-66
+    "neighbor_allgather",
+    "neighbor_alltoall",
 )
 
 COLL = mca.framework("coll", "collective operations")

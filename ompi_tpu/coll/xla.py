@@ -173,3 +173,91 @@ class XlaColl(CollComponent):
         )
         token = comm.put_rank_major(jnp.zeros((comm.size,), jnp.int32))
         return plan(token)
+
+    # -- vector (ragged) variants ------------------------------------------
+    # Device path: pad every ragged block to the max count (one device
+    # pad each, no host round-trip), run the cached fixed-shape fabric
+    # plan, slice the live rows back out on device. Counts are static
+    # Python ints, so each distinct count profile compiles once — the
+    # reference's alltoallv walks its displs arrays per call; here the
+    # profile IS the executable (SURVEY §7: persistent pre-compiled
+    # plans).
+
+    @staticmethod
+    def _pad_stack(comm, values, max_len):
+        n = comm.size
+        blocks = []
+        for v in values:
+            arr = jnp.asarray(v)
+            pad = [(0, max_len - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+            blocks.append(jnp.pad(arr, pad))
+        return comm.from_rank_values(blocks)
+
+    def allgatherv(self, comm, values):
+        if len(values) != comm.size:
+            raise ArgumentError(
+                f"need one block per rank ({comm.size}), got {len(values)}"
+            )
+        counts = [jnp.shape(v)[0] for v in values]
+        m = max(counts) if counts else 0
+        if m == 0:
+            first = jnp.asarray(values[0])
+            return jax.device_put(first, comm.replicated_sharding())
+        gathered = self.allgather(comm, self._pad_stack(comm, values, m))
+        # gathered: (size, size, m, ...) rank-major; every rank's copy is
+        # identical, take rank 0's and drop the padding per segment.
+        full = gathered[0]
+        return jnp.concatenate(
+            [full[r, :c] for r, c in enumerate(counts)], axis=0
+        )
+
+    def alltoallv(self, comm, blocks):
+        n = comm.size
+        if len(blocks) != n:
+            raise ArgumentError(f"need {n} send lists, got {len(blocks)}")
+        counts = [[jnp.shape(blocks[s][d])[0] for d in range(n)]
+                  for s in range(n)]
+        m = max((c for row in counts for c in row), default=0)
+        if m == 0:
+            return [jnp.asarray(blocks[0][d]) for d in range(n)]
+        padded = []
+        for s in range(n):
+            row = []
+            for d in range(n):
+                arr = jnp.asarray(blocks[s][d])
+                pad = [(0, m - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+                row.append(jnp.pad(arr, pad))
+            padded.append(jnp.stack(row))
+        x = comm.from_rank_values(padded)  # (size, size, m, ...)
+        swapped = self.alltoall(comm, x)  # (dst, src, m, ...)
+        return [
+            jnp.concatenate(
+                [swapped[d, s, :counts[s][d]] for s in range(n)], axis=0
+            )
+            for d in range(n)
+        ]
+
+    def reduce_scatter(self, comm, values, counts, op):
+        op = op_lookup(op)
+        n = comm.size
+        if len(values) != n:
+            raise ArgumentError(
+                f"need one buffer per rank ({n}), got {len(values)}"
+            )
+        if len(counts) != n:
+            raise ArgumentError(f"need {n} counts, got {len(counts)}")
+        total = sum(counts)
+        for v in values:
+            if jnp.shape(v)[0] != total:
+                raise ArgumentError(
+                    f"buffer rows {jnp.shape(v)[0]} != sum(counts) {total}"
+                )
+        x = comm.from_rank_values(values)
+        red = self.allreduce(comm, x, op)[0]
+        out, start = [], 0
+        for r, c in enumerate(counts):
+            out.append(
+                jax.device_put(red[start:start + c], comm.devices[r])
+            )
+            start += c
+        return out

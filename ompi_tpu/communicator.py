@@ -234,6 +234,35 @@ class Communicator(HasAttributes):
 
             jax.block_until_ready(token)
 
+    # vector (ragged) variants — per-rank block lists carry the counts
+    def allgatherv(self, values):
+        return self._coll_call("allgatherv", list(values))
+
+    def gatherv(self, values, root: int = 0):
+        return self._coll_call("gatherv", list(values),
+                               self.check_rank(root))
+
+    def scatterv(self, blocks, root: int = 0):
+        return self._coll_call("scatterv", list(blocks),
+                               self.check_rank(root))
+
+    def alltoallv(self, blocks):
+        return self._coll_call("alltoallv", [list(b) for b in blocks])
+
+    def alltoallw(self, blocks):
+        return self._coll_call("alltoallw", [list(b) for b in blocks])
+
+    def reduce_scatter(self, values, counts, op="sum"):
+        return self._coll_call("reduce_scatter", list(values),
+                               list(counts), op)
+
+    # neighborhood collectives (need an attached cart/graph topology)
+    def neighbor_allgather(self, x):
+        return self._coll_call("neighbor_allgather", x)
+
+    def neighbor_alltoall(self, sendblocks):
+        return self._coll_call("neighbor_alltoall", sendblocks)
+
     # Nonblocking variants: JAX async dispatch enqueues the device work
     # immediately; the request completes when the result array is ready.
     def _icoll(self, opname: str, *args, **kw):
@@ -271,6 +300,21 @@ class Communicator(HasAttributes):
 
     def ibarrier(self):
         return self._icoll("barrier")
+
+    def iallgatherv(self, values):
+        return self._icoll("allgatherv", list(values))
+
+    def ialltoallv(self, blocks):
+        return self._icoll("alltoallv", [list(b) for b in blocks])
+
+    def ireduce_scatter(self, values, counts, op="sum"):
+        return self._icoll("reduce_scatter", list(values), list(counts), op)
+
+    def ineighbor_allgather(self, x):
+        return self._icoll("neighbor_allgather", x)
+
+    def ineighbor_alltoall(self, sendblocks):
+        return self._icoll("neighbor_alltoall", sendblocks)
 
     # Persistent collectives (MPI-4 *_init / mpiext pcollreq analog): the
     # compiled plan IS the persistent schedule; starting it re-runs the
