@@ -35,7 +35,7 @@ def __getattr__(name):
             api = importlib.import_module(".api", __name__)
             return getattr(api, name)
         if name in ("coll", "datatype", "pml", "runtime", "osc", "topo",
-                    "parallel", "pgas", "io", "monitoring"):
+                    "parallel", "pgas", "io", "monitoring", "ft"):
             return importlib.import_module(f".{name}", __name__)
     except ImportError as exc:
         raise AttributeError(

@@ -33,7 +33,7 @@ import numpy as np
 
 from .core import config
 from .core.attributes import HasAttributes
-from .core.errors import ArgumentError, CommError, RankError
+from .core.errors import ArgumentError, CommError, HasErrhandler, RankError
 from .core.info import Info
 from .core.logging import get_logger
 from .group import Group
@@ -55,7 +55,7 @@ def _next_cid() -> int:
         return next(_cid_counter)
 
 
-class Communicator(HasAttributes):
+class Communicator(HasAttributes, HasErrhandler):
     """A communication context over an ordered set of rank-devices."""
 
     def __init__(

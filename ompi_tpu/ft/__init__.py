@@ -1,0 +1,17 @@
+"""Fault tolerance: failure events, checkpoint/restart, quiesce, logging.
+
+TPU-native equivalent of the reference FT stack (SURVEY §5.3-5.4):
+PMIx failure events → `events`; opal/mca/crs → `crs`; crcp/bkmrk →
+`crcp`; vprotocol/pessimist → `vprotocol`; opal_cr runtime +
+opal-checkpoint tooling → `manager`.
+"""
+
+from . import crcp, crs, events, manager, vprotocol
+from .crs import CheckpointError
+from .events import Event, EventClass, ProcFailedError
+from .manager import CheckpointManager
+
+__all__ = [
+    "CheckpointError", "CheckpointManager", "Event", "EventClass",
+    "ProcFailedError", "crcp", "crs", "events", "manager", "vprotocol",
+]

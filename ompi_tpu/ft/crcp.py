@@ -1,0 +1,106 @@
+"""CRCP — checkpoint coordination: quiescing in-flight communication.
+
+TPU-native equivalent of ompi/mca/crcp/bkmrk (reference: the "bookmark"
+protocol exchanges per-peer sent/received counts and drains traffic
+until they agree, crcp_bkmrk_pml.c, SURVEY §5.3). In the driver model
+both sides' state is directly visible, so the bookmark exchange
+collapses to an inspection of the PML matching lists plus a progress
+loop — but the contract is the same: after `quiesce()` returns, no
+message is in flight on the communicator, so a checkpoint taken then is
+consistent.
+
+Collectives are bulk-synchronous XLA programs, so quiescing them is
+`jax.block_until_ready` on outstanding plans — handled by the request
+layer; only p2p has cross-call state to drain.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+from ..core import progress as progress_mod
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+
+logger = get_logger("ft.crcp")
+
+
+class QuiesceTimeout(OmpiTpuError):
+    errclass = "ERR_PENDING"
+
+
+@dataclass
+class Bookmark:
+    """The drain report (reference: bkmrk's per-peer counters)."""
+
+    comm: str
+    unexpected: int = 0  # sends no recv has matched yet
+    posted: int = 0  # recvs no send has matched yet
+    drained_waits: int = 0
+    details: list = field(default_factory=list)
+
+    @property
+    def quiet(self) -> bool:
+        return self.unexpected == 0 and self.posted == 0
+
+
+def _inspect(comm) -> Bookmark:
+    bm = Bookmark(comm=comm.name)
+    pml = comm.pml
+    st = getattr(pml, "_state", None)
+    base = pml
+    # vprotocol interposition forwards state inspection to its host pml
+    while hasattr(base, "host"):
+        base = base.host
+        st = getattr(base, "_state", None)
+    if st is None:
+        return bm
+    s = base._state(comm)
+    bm.unexpected = len(s.unexpected)
+    bm.posted = len(s.posted)
+    for p in s.unexpected:
+        bm.details.append(
+            ("unmatched-send", p.env.src, p.env.dst, p.env.tag)
+        )
+    for r in s.posted:
+        bm.details.append(
+            ("unmatched-recv", r.want_src, r.dst, r.want_tag)
+        )
+    return bm
+
+
+def inspect(comm) -> Bookmark:
+    """Non-blocking bookmark: current in-flight counts."""
+    return _inspect(comm)
+
+
+def quiesce(comm, timeout: float = 5.0,
+            require_empty: bool = True) -> Bookmark:
+    """Progress until the communicator's p2p channels are quiet.
+
+    With require_empty (the bkmrk contract), raises QuiesceTimeout if
+    unmatched traffic remains after `timeout` — the caller must not
+    checkpoint. With require_empty=False, returns the residual bookmark
+    for the caller to persist alongside the snapshot (message-logging
+    restart can replay it, vprotocol analog)."""
+    deadline = time.monotonic() + timeout
+    waits = 0
+    while True:
+        bm = _inspect(comm)
+        bm.drained_waits = waits
+        if bm.quiet:
+            SPC.record("ft_quiesce_ok")
+            return bm
+        if time.monotonic() >= deadline:
+            SPC.record("ft_quiesce_timeout")
+            if require_empty:
+                raise QuiesceTimeout(
+                    f"{comm.name}: traffic still in flight after "
+                    f"{timeout}s: {bm.details[:8]}"
+                )
+            return bm
+        progress_mod.progress()
+        waits += 1
+        time.sleep(0.001)

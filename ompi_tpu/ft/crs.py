@@ -1,0 +1,213 @@
+"""CRS — checkpoint/restart service framework.
+
+TPU-native equivalent of opal/mca/crs (reference: crs/self = app
+callbacks, crs/none; driven by opal-checkpoint/opal-restart tools,
+SURVEY §5.3-5.4). The reference snapshots *process images*; the TPU
+analog snapshots *array state* (SURVEY §5.4: "the TPU analog is
+array-state checkpointing, not process images"): a pytree of jax.Arrays
+plus JSON metadata, written atomically (tmp + rename) so a crash
+mid-checkpoint never corrupts the previous snapshot.
+
+Components:
+- **arrays**: numpy .npz payload + treedef sidecar; restore re-places
+  leaves on devices (optionally to a target sharding).
+- **orbax**: delegates to orbax.checkpoint when importable — the
+  ecosystem-standard path for large sharded state.
+- **app**: registered application callbacks (reference crs/self's
+  checkpoint/continue/restart hooks).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..core import component as mca
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+
+logger = get_logger("ft.crs")
+
+CRS = mca.framework("crs", "checkpoint/restart service")
+
+
+class CheckpointError(OmpiTpuError):
+    errclass = "ERR_OTHER"
+
+
+class CrsComponent(mca.Component):
+    def save(self, path: str, state: Any, meta: dict) -> None:
+        raise NotImplementedError
+
+    def load(self, path: str, like: Any = None) -> tuple[Any, dict]:
+        """Restore. `like` is an abstract/concrete template pytree: when
+        given, the result has its structure and its leaves' placement
+        (device_put to matching shardings); when omitted the result is a
+        flat {keypath: np.ndarray} dict."""
+        raise NotImplementedError
+
+
+def _paths_of(state):
+    import jax
+
+    flat, treedef = jax.tree_util.tree_flatten_with_path(state)
+    keys = [jax.tree_util.keystr(kp) for kp, _ in flat]
+    if len(set(keys)) != len(keys):
+        raise CheckpointError("duplicate pytree key paths")
+    return keys, [leaf for _, leaf in flat], treedef
+
+
+@CRS.register
+class ArraysCrs(CrsComponent):
+    """Atomic npz snapshot of a pytree of arrays."""
+
+    NAME = "arrays"
+    PRIORITY = 20
+    DESCRIPTION = "npz array-state snapshots"
+
+    def save(self, path: str, state: Any, meta: dict) -> None:
+        import jax
+
+        keys, leaves, treedef = _paths_of(state)
+        host = [np.asarray(l) for l in leaves]
+        tmp = path + ".tmp"
+        os.makedirs(tmp, exist_ok=True)
+        np.savez(
+            os.path.join(tmp, "arrays.npz"),
+            **{f"leaf{i}": h for i, h in enumerate(host)},
+        )
+        doc = {
+            "keys": keys,
+            "treedef": str(treedef),
+            "meta": meta,
+            "format": "ompi_tpu.crs.arrays.v1",
+        }
+        with open(os.path.join(tmp, "meta.json"), "w") as f:
+            json.dump(doc, f)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        os.rename(tmp, path)
+        SPC.record("ft_checkpoints_saved")
+
+    def load(self, path: str, like: Any = None) -> tuple[Any, dict]:
+        import jax
+
+        with open(os.path.join(path, "meta.json")) as f:
+            doc = json.load(f)
+        if doc.get("format") != "ompi_tpu.crs.arrays.v1":
+            raise CheckpointError(f"{path}: unknown snapshot format")
+        data = np.load(os.path.join(path, "arrays.npz"))
+        leaves = [data[f"leaf{i}"] for i in range(len(doc["keys"]))]
+        SPC.record("ft_checkpoints_loaded")
+        if like is None:
+            return dict(zip(doc["keys"], leaves)), doc["meta"]
+        want_keys, want_leaves, treedef = _paths_of(like)
+        if want_keys != doc["keys"]:
+            raise CheckpointError(
+                f"template structure mismatch: snapshot has "
+                f"{doc['keys'][:4]}..., template {want_keys[:4]}..."
+            )
+        placed = []
+        for raw, tmpl in zip(leaves, want_leaves):
+            if hasattr(tmpl, "sharding"):
+                placed.append(jax.device_put(raw, tmpl.sharding))
+            else:
+                placed.append(raw)
+        state = jax.tree_util.tree_unflatten(treedef, placed)
+        return state, doc["meta"]
+
+
+@CRS.register
+class OrbaxCrs(CrsComponent):
+    """Orbax-backed snapshots (sharded-state capable)."""
+
+    NAME = "orbax"
+    PRIORITY = 10
+    DESCRIPTION = "orbax.checkpoint array-state snapshots"
+
+    def available(self, **ctx: Any) -> bool:
+        try:
+            import orbax.checkpoint  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+    def save(self, path: str, state: Any, meta: dict) -> None:
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        if os.path.exists(path):
+            shutil.rmtree(path)
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "state"), state)
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"meta": meta,
+                       "format": "ompi_tpu.crs.orbax.v1"}, f)
+        SPC.record("ft_checkpoints_saved")
+
+    def load(self, path: str, like: Any = None) -> tuple[Any, dict]:
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(path)
+        with open(os.path.join(path, "meta.json")) as f:
+            doc = json.load(f)
+        with ocp.StandardCheckpointer() as ckptr:
+            if like is not None:
+                state = ckptr.restore(os.path.join(path, "state"), like)
+            else:
+                state = ckptr.restore(os.path.join(path, "state"))
+        SPC.record("ft_checkpoints_loaded")
+        return state, doc["meta"]
+
+
+@CRS.register
+class AppCrs(CrsComponent):
+    """Application-callback checkpointing (reference: crs/self —
+    OPAL_CRS_CHECKPOINT/CONTINUE/RESTART callbacks)."""
+
+    NAME = "app"
+    PRIORITY = 0
+    DESCRIPTION = "application checkpoint/restart callbacks"
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        self.checkpoint_cb: Optional[Callable[[str], dict]] = None
+        self.restart_cb: Optional[Callable[[str, dict], Any]] = None
+
+    def register_callbacks(self, checkpoint: Callable[[str], dict],
+                           restart: Callable[[str, dict], Any]) -> None:
+        self.checkpoint_cb = checkpoint
+        self.restart_cb = restart
+
+    def save(self, path: str, state: Any, meta: dict) -> None:
+        if self.checkpoint_cb is None:
+            raise CheckpointError("no app checkpoint callback registered")
+        os.makedirs(path, exist_ok=True)
+        app_meta = self.checkpoint_cb(path) or {}
+        with open(os.path.join(path, "meta.json"), "w") as f:
+            json.dump({"meta": {**meta, **app_meta},
+                       "format": "ompi_tpu.crs.app.v1"}, f)
+        SPC.record("ft_checkpoints_saved")
+
+    def load(self, path: str, like: Any = None) -> tuple[Any, dict]:
+        if self.restart_cb is None:
+            raise CheckpointError("no app restart callback registered")
+        with open(os.path.join(path, "meta.json")) as f:
+            doc = json.load(f)
+        state = self.restart_cb(path, doc["meta"])
+        SPC.record("ft_checkpoints_loaded")
+        return state, doc["meta"]
+
+
+def select(**ctx) -> CrsComponent:
+    return CRS.select_one(**ctx)
+
+
+def component(name: str) -> CrsComponent:
+    return CRS.component(name)

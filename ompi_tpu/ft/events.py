@@ -1,0 +1,148 @@
+"""Failure detection and event routing.
+
+TPU-native equivalent of the PMIx event machinery the reference wires at
+init (reference: ompi_mpi_init.c:524 PMIx_Register_event_handler →
+ompi_errhandler_callback; errhandlers per comm/win/file). The driver
+model has no daemon: failure signals come from (a) the JAX runtime
+surfacing device/ICI errors as exceptions, (b) explicit probes
+(`check_devices`), and (c) test injection (`inject`). All three funnel
+through one registry that routes to registered handlers and then to the
+errhandlers of affected communicators.
+"""
+
+from __future__ import annotations
+
+import enum
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+
+logger = get_logger("ft.events")
+
+
+class EventClass(enum.Enum):
+    PROC_FAILED = "proc_failed"  # a rank/device is gone
+    DEVICE_ERROR = "device_error"  # device raised but may survive
+    CHECKPOINT = "checkpoint"  # a checkpoint is being taken
+    RESTART = "restart"  # state was restored
+    USER = "user"
+
+
+class ProcFailedError(OmpiTpuError):
+    errclass = "ERR_PROC_FAILED"
+
+
+@dataclass
+class Event:
+    evclass: EventClass
+    info: dict = field(default_factory=dict)
+
+    @property
+    def rank(self) -> Optional[int]:
+        return self.info.get("rank")
+
+
+Handler = Callable[[Event], None]
+
+_handlers: dict[int, tuple[EventClass, Handler]] = {}
+_ids = itertools.count(1)
+_lock = threading.Lock()
+
+
+def register(evclass: EventClass, handler: Handler) -> int:
+    """Register a handler; returns an id for deregister (the PMIx
+    Register_event_handler analog)."""
+    with _lock:
+        hid = next(_ids)
+        _handlers[hid] = (evclass, handler)
+        return hid
+
+
+def deregister(hid: int) -> None:
+    with _lock:
+        _handlers.pop(hid, None)
+
+
+def clear() -> None:
+    with _lock:
+        _handlers.clear()
+
+
+def raise_event(evclass: EventClass, **info: Any) -> Event:
+    """Deliver an event to every matching handler, then (for failures)
+    to the errhandler of every live communicator containing the rank."""
+    ev = Event(evclass, info)
+    SPC.record(f"ft_events_{evclass.value}")
+    with _lock:
+        targets = [h for c, h in _handlers.values() if c == evclass]
+    for h in targets:
+        try:
+            h(ev)
+        except Exception:
+            logger.exception("event handler failed for %s", evclass)
+    if evclass in (EventClass.PROC_FAILED, EventClass.DEVICE_ERROR):
+        _route_to_errhandlers(ev)
+    return ev
+
+
+def _route_to_errhandlers(ev: Event) -> None:
+    from ..communicator import live_comms
+
+    world_rank = ev.info.get("world_rank")
+    exc = ProcFailedError(
+        f"process failure reported: {ev.info}"
+    )
+    for comm in list(live_comms):
+        if comm._freed:
+            continue
+        if world_rank is not None and world_rank not in comm.group:
+            continue
+        try:
+            comm._invoke_errhandler(exc)
+        except ProcFailedError:
+            # ERRORS_RETURN re-raises; routing must still reach the
+            # remaining comms — the caller sees failures via handlers.
+            pass
+        except Exception:
+            logger.exception("errhandler raised for %s", comm.name)
+
+
+def inject(world_rank: int, **info: Any) -> Event:
+    """Fault injection for tests (the reference's only injection is
+    abort-style test programs, SURVEY §5.3)."""
+    return raise_event(
+        EventClass.PROC_FAILED, world_rank=world_rank, injected=True,
+        **info,
+    )
+
+
+def check_devices(comm=None) -> list[int]:
+    """Probe each rank-device with a trivial computation; returns the
+    world ranks whose device failed the probe (raising PROC_FAILED for
+    each). The active-probing analog of a PMIx heartbeat."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import api
+
+    comm = comm or api.world()
+    failed = []
+    for r, dev in enumerate(comm.devices):
+        try:
+            val = jax.device_put(jnp.ones((), jnp.int32), dev)
+            if int(val) != 1:
+                raise RuntimeError(f"bad probe result {val}")
+        except Exception as exc:
+            failed.append(r)
+            raise_event(
+                EventClass.PROC_FAILED,
+                world_rank=comm.group.world_rank(r),
+                rank=r,
+                error=str(exc),
+            )
+    return failed

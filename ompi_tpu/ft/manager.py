@@ -1,0 +1,94 @@
+"""Checkpoint manager: step-numbered snapshots with quiesce + retention.
+
+Ties the FT stack together the way the reference's opal_cr runtime +
+opal-checkpoint tool drive CRS/CRCP (reference: opal/runtime/opal_cr.c,
+SURVEY §5.3): quiesce the network (crcp), snapshot array state (crs),
+raise CHECKPOINT/RESTART events, keep the last N snapshots.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Optional
+
+from ..core import config
+from ..core.logging import get_logger
+from . import crcp, crs, events
+
+logger = get_logger("ft.manager")
+
+_keep = config.register(
+    "ft", "manager", "keep", type=int, default=3,
+    description="Snapshots retained per checkpoint directory",
+)
+
+_SNAP_RE = re.compile(r"^snap-(\d+)$")
+
+
+class CheckpointManager:
+    """Directory of `snap-<step>` snapshots (orbax-style layout)."""
+
+    def __init__(self, directory: str, *, component: Optional[str] = None,
+                 keep: Optional[int] = None) -> None:
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+        self.crs = (
+            crs.component(component) if component else crs.select()
+        )
+        self.keep = keep if keep is not None else _keep.value
+
+    # -- inventory ---------------------------------------------------------
+
+    def steps(self) -> list[int]:
+        out = []
+        for name in os.listdir(self.directory):
+            m = _SNAP_RE.match(name)
+            if m and os.path.isdir(os.path.join(self.directory, name)):
+                out.append(int(m.group(1)))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    def path(self, step: int) -> str:
+        return os.path.join(self.directory, f"snap-{step}")
+
+    # -- save/restore ------------------------------------------------------
+
+    def save(self, step: int, state: Any, *, comm=None,
+             meta: Optional[dict] = None,
+             quiesce_timeout: float = 5.0) -> str:
+        """Quiesce (when a comm is given), snapshot, prune."""
+        meta = dict(meta or {})
+        meta["step"] = step
+        if comm is not None:
+            bm = crcp.quiesce(comm, timeout=quiesce_timeout)
+            meta["quiesce_waits"] = bm.drained_waits
+        events.raise_event(events.EventClass.CHECKPOINT, step=step)
+        p = self.path(step)
+        self.crs.save(p, state, meta)
+        self._prune()
+        logger.info("checkpoint step %d -> %s", step, p)
+        return p
+
+    def restore(self, step: Optional[int] = None, *, like: Any = None
+                ) -> tuple[Any, dict]:
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise crs.CheckpointError(
+                    f"{self.directory}: no snapshots"
+                )
+        state, meta = self.crs.load(self.path(step), like=like)
+        events.raise_event(events.EventClass.RESTART, step=step)
+        return state, meta
+
+    def _prune(self) -> None:
+        steps = self.steps()
+        while self.keep > 0 and len(steps) > self.keep:
+            victim = steps.pop(0)
+            shutil.rmtree(self.path(victim), ignore_errors=True)
+            logger.info("pruned snapshot step %d", victim)

@@ -1,0 +1,207 @@
+"""vprotocol/pessimist — message logging for deterministic replay.
+
+TPU-native equivalent of ompi/mca/vprotocol/pessimist hosted by pml/v
+(reference: vprotocol_pessimist_sender_based.c sender-based payload
+logging, vprotocol_pessimist_eventlog.c delivery-order event log,
+SURVEY §5.3). The interposition pattern mirrors pml/v: a wrapper PML
+forwards every call to the host PML, recording
+
+- **send events**: envelope + a host copy of the payload (sender-based
+  logging — the payload survives the sender's device state), and
+- **delivery events**: the (src, tag, seq) each recv actually matched —
+  the only nondeterminism MPI allows (wildcard source/tag).
+
+`replay()` re-executes the log against a fresh communicator: sends are
+re-issued from logged payloads in order, recvs are re-posted with their
+*resolved* sources/tags, so the original matching order is reproduced
+exactly — the pessimist guarantee.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+from ..pml.framework import PmlComponent
+
+logger = get_logger("ft.vprotocol")
+
+enable_var = config.register(
+    "vprotocol", "pessimist", "enable", type=bool, default=False,
+    description="Interpose the message-logging PML (pml/v analog)",
+)
+
+
+class ReplayError(OmpiTpuError):
+    errclass = "ERR_OTHER"
+
+
+@dataclass
+class SendEvent:
+    seq: int
+    src: int
+    dst: int
+    tag: int
+    payload: Any  # host copy
+
+
+@dataclass
+class DeliveryEvent:
+    seq: int  # matches the SendEvent seq delivered
+    src: int
+    dst: int
+    tag: int
+    wildcard_src: bool
+    wildcard_tag: bool
+
+
+@dataclass
+class EventLog:
+    sends: list[SendEvent] = field(default_factory=list)
+    deliveries: list[DeliveryEvent] = field(default_factory=list)
+
+    def clear(self) -> None:
+        self.sends.clear()
+        self.deliveries.clear()
+
+
+class PessimistPml(PmlComponent):
+    """Interposition wrapper around the selected host PML."""
+
+    NAME = "v"
+    DESCRIPTION = "pessimist message-logging interposition"
+
+    def __init__(self, framework, host: PmlComponent) -> None:
+        super().__init__(framework)
+        self.host = host
+        self.log = EventLog()
+        self._seq = itertools.count(0)
+        self._req_seq: dict[int, int] = {}  # id(SendRequest) -> seq
+        self._lock = threading.Lock()
+
+    # -- send side ---------------------------------------------------------
+
+    def _log_send(self, comm, value, dest, tag, source, req) -> None:
+        import jax
+
+        host_copy = jax.tree.map(lambda l: np.asarray(l), value)
+        with self._lock:
+            seq = next(self._seq)
+            self.log.sends.append(
+                SendEvent(seq, req.env.src, dest, tag, host_copy)
+            )
+            self._req_seq[id(req)] = seq
+        SPC.record("vprotocol_sends_logged")
+
+    def isend(self, comm, value, dest, tag, source=None):
+        req = self.host.isend(comm, value, dest, tag, source=source)
+        self._log_send(comm, value, dest, tag, source, req)
+        return req
+
+    def send(self, comm, value, dest, tag, source=None):
+        req = self.isend(comm, value, dest, tag, source=source)
+        req.wait()
+        return req
+
+    # -- recv side ---------------------------------------------------------
+
+    def _log_delivery(self, req, want_src, want_tag) -> None:
+        # The matched pending send is identified through the envelope of
+        # the completed request's status.
+        def on_complete(r):
+            st = r.status
+            if st is None or r.status.cancelled:
+                return
+            with self._lock:
+                # find the logged send this delivery corresponds to:
+                # earliest un-delivered send with this (src, dst, tag)
+                delivered = {d.seq for d in self.log.deliveries}
+                seq = -1
+                for ev in self.log.sends:
+                    if (ev.seq not in delivered and ev.src == st.source
+                            and ev.dst == r.dst and ev.tag == st.tag):
+                        seq = ev.seq
+                        break
+                self.log.deliveries.append(
+                    DeliveryEvent(
+                        seq, st.source, r.dst, st.tag,
+                        wildcard_src=want_src < 0,
+                        wildcard_tag=want_tag < 0,
+                    )
+                )
+            SPC.record("vprotocol_deliveries_logged")
+
+        req.on_complete(on_complete)
+
+    def irecv(self, comm, source, tag, dest=None):
+        req = self.host.irecv(comm, source, tag, dest=dest)
+        self._log_delivery(req, source, tag)
+        return req
+
+    def recv(self, comm, source, tag, dest=None):
+        req = self.irecv(comm, source, tag, dest=dest)
+        req.wait()
+        return req.result()
+
+    # -- pass-through ------------------------------------------------------
+
+    def probe(self, comm, source, tag, **kw):
+        return self.host.probe(comm, source, tag, **kw)
+
+    def comm_freed(self, comm) -> None:
+        if hasattr(self.host, "comm_freed"):
+            self.host.comm_freed(comm)
+
+
+def replay(comm, log: EventLog) -> list[Any]:
+    """Deterministically re-execute a log on `comm`: returns the received
+    payloads in original delivery order. Wildcard recvs are replayed with
+    their RESOLVED source/tag (the pessimist rule: nondeterministic
+    choices are fixed by the log)."""
+    results = []
+    # Snapshot: if `comm` itself runs under the logging PML (recovery
+    # with logging re-armed), replay traffic appends to `log` — iterate
+    # the pre-replay state only.
+    sends = list(log.sends)
+    deliveries = list(log.deliveries)
+    send_by_seq = {ev.seq: ev for ev in sends}
+    issued: set[int] = set()
+    for d in deliveries:
+        if d.seq < 0:
+            raise ReplayError(
+                f"delivery {d} has no matched send event in the log"
+            )
+        ev = send_by_seq.get(d.seq)
+        if ev is None:
+            raise ReplayError(f"send seq {d.seq} missing from log")
+        # Re-issue every logged send up to and including this one's seq
+        # so ordering between same-(src,dst,tag) sends is preserved.
+        for s in sends:
+            if s.seq <= d.seq and s.seq not in issued:
+                comm.isend(s.payload, s.dst, s.tag, source=s.src)
+                issued.add(s.seq)
+        out = comm.recv(d.src, d.tag, dest=d.dst)
+        results.append(out)
+    # flush any logged sends never delivered (they were in flight)
+    for s in sends:
+        if s.seq not in issued:
+            comm.isend(s.payload, s.dst, s.tag, source=s.src)
+    SPC.record("vprotocol_replays")
+    return results
+
+
+def maybe_wrap(pml: PmlComponent, framework) -> PmlComponent:
+    """Called by the PML selection path: interpose when enabled
+    (reference: pml/v loads when vprotocol is requested)."""
+    if enable_var.value and not isinstance(pml, PessimistPml):
+        logger.info("interposing pessimist message-logging PML")
+        return PessimistPml(framework, pml)
+    return pml

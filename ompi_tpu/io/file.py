@@ -26,7 +26,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ..core.counters import SPC
-from ..core.errors import ArgumentError, IOError_
+from ..core.errors import ArgumentError, HasErrhandler, IOError_
 from ..core.info import Info
 from ..core.logging import get_logger
 from ..core.request import Request
@@ -50,7 +50,7 @@ def _np_dtype(etype: dt.Datatype):
     return None
 
 
-class File:
+class File(HasErrhandler):
     """A collective file handle (MPI_File)."""
 
     def __init__(self, comm, path: str, amode: int,

@@ -28,7 +28,12 @@ from typing import Any, Optional
 import numpy as np
 
 from ..core.counters import SPC
-from ..core.errors import ArgumentError, RMASyncError, WinError
+from ..core.errors import (
+    ArgumentError,
+    HasErrhandler,
+    RMASyncError,
+    WinError,
+)
 from ..ops import NO_OP, REPLACE, Op, lookup as op_lookup
 
 
@@ -55,7 +60,7 @@ class _PendingOp:
     compare: Any = None
 
 
-class Window:
+class Window(HasErrhandler):
     """An RMA window over a rank-major device buffer."""
 
     def __init__(self, comm, buffer, *, name: str = "") -> None:

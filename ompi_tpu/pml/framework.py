@@ -32,5 +32,16 @@ def select_for_comm(comm) -> PmlComponent:
     global _selected
     ensure_components()
     if _selected is None:
-        _selected = PML.select_one(comm=comm)
+        selected = PML.select_one(comm=comm)
+        # FT interposition (reference: pml/v hosts vprotocol; crcpw
+        # hosts crcp) — wraps rather than replaces the winner.
+        from ..ft import vprotocol
+
+        _selected = vprotocol.maybe_wrap(selected, PML)
     return _selected
+
+
+def reset_selection() -> None:
+    """Drop the cached PML (used when interposition config changes)."""
+    global _selected
+    _selected = None

@@ -1,0 +1,84 @@
+"""Checkpoint inspection/management CLI (opal-checkpoint/restart analog).
+
+Reference: opal/tools/opal-checkpoint and opal-restart drive the CRS
+(SURVEY §2.5). The array-state analog is snapshot-directory management:
+
+    python -m ompi_tpu.tools.ckpt list <dir>
+    python -m ompi_tpu.tools.ckpt show <dir> [--step N]
+    python -m ompi_tpu.tools.ckpt prune <dir> --keep N
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _manager(directory: str, keep=None):
+    from ..ft.manager import CheckpointManager
+
+    return CheckpointManager(directory, keep=keep)
+
+
+def cmd_list(args) -> int:
+    m = _manager(args.dir)
+    steps = m.steps()
+    if not steps:
+        print(f"{args.dir}: no snapshots")
+        return 1
+    for s in steps:
+        meta_path = os.path.join(m.path(s), "meta.json")
+        extra = ""
+        if os.path.exists(meta_path):
+            with open(meta_path) as f:
+                doc = json.load(f)
+            extra = f"  [{doc.get('format', '?')}]"
+        mark = " (latest)" if s == steps[-1] else ""
+        print(f"snap-{s}{extra}{mark}")
+    return 0
+
+
+def cmd_show(args) -> int:
+    m = _manager(args.dir)
+    step = args.step if args.step is not None else m.latest_step()
+    if step is None:
+        print(f"{args.dir}: no snapshots", file=sys.stderr)
+        return 1
+    meta_path = os.path.join(m.path(step), "meta.json")
+    with open(meta_path) as f:
+        doc = json.load(f)
+    print(json.dumps(doc, indent=2, default=str))
+    return 0
+
+
+def cmd_prune(args) -> int:
+    m = _manager(args.dir, keep=args.keep)
+    before = m.steps()
+    m._prune()
+    after = m.steps()
+    print(f"pruned {len(before) - len(after)} snapshots, kept {after}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu.tools.ckpt")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p = sub.add_parser("list")
+    p.add_argument("dir")
+    p.set_defaults(fn=cmd_list)
+    p = sub.add_parser("show")
+    p.add_argument("dir")
+    p.add_argument("--step", type=int, default=None)
+    p.set_defaults(fn=cmd_show)
+    p = sub.add_parser("prune")
+    p.add_argument("dir")
+    p.add_argument("--keep", type=int, required=True)
+    p.set_defaults(fn=cmd_prune)
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
