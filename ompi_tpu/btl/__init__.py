@@ -1,5 +1,6 @@
 """Inter-device transfer layer (reference: opal/mca/btl)."""
 
 from .framework import BTL, Bml, BtlComponent
+from . import dcn  # noqa: F401 - registers btl/dcn
 
-__all__ = ["BTL", "Bml", "BtlComponent"]
+__all__ = ["BTL", "Bml", "BtlComponent", "dcn"]

@@ -51,7 +51,7 @@ def _build() -> Optional[Path]:
         return out
     _BUILD_DIR.mkdir(parents=True, exist_ok=True)
     cmd = [
-        "g++", "-O3", "-shared", "-fPIC", "-std=c++17",
+        "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
         "-o", str(out),
     ] + [str(s) for s in sources]
     try:
@@ -102,8 +102,57 @@ def get_lib() -> Optional[ctypes.CDLL]:
                 LL,  # position
                 ctypes.c_void_p, LL,  # stream, max_bytes
             ]
+        _declare_dcn(lib)
+        _declare_pool(lib)
         _lib = lib
         return _lib
+
+
+def _declare_dcn(lib: ctypes.CDLL) -> None:
+    LL = ctypes.c_longlong
+    P = ctypes.c_void_p
+    lib.dcn_create.restype = P
+    lib.dcn_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                               ctypes.POINTER(ctypes.c_int)]
+    lib.dcn_connect.restype = ctypes.c_int
+    lib.dcn_connect.argtypes = [P, ctypes.c_char_p, ctypes.c_int,
+                                ctypes.c_int, LL, ctypes.c_int]
+    lib.dcn_send.restype = LL
+    lib.dcn_send.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL]
+    lib.dcn_poll_recv.restype = LL
+    lib.dcn_poll_recv.argtypes = [
+        P, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(LL),
+        ctypes.POINTER(LL),
+    ]
+    lib.dcn_read.restype = LL
+    lib.dcn_read.argtypes = [P, LL, ctypes.c_void_p, LL]
+    lib.dcn_poll_send.restype = LL
+    lib.dcn_poll_send.argtypes = [P]
+    lib.dcn_set_eager.restype = None
+    lib.dcn_set_eager.argtypes = [P, LL]
+    lib.dcn_port.restype = ctypes.c_int
+    lib.dcn_port.argtypes = [P]
+    lib.dcn_stat.restype = LL
+    lib.dcn_stat.argtypes = [P, ctypes.c_int]
+    lib.dcn_destroy.restype = None
+    lib.dcn_destroy.argtypes = [P]
+
+
+def _declare_pool(lib: ctypes.CDLL) -> None:
+    LL = ctypes.c_longlong
+    P = ctypes.c_void_p
+    lib.pool_create.restype = P
+    lib.pool_create.argtypes = [LL]
+    lib.pool_destroy.restype = None
+    lib.pool_destroy.argtypes = [P]
+    lib.pool_base.restype = P
+    lib.pool_base.argtypes = [P]
+    lib.pool_alloc.restype = LL
+    lib.pool_alloc.argtypes = [P, LL]
+    lib.pool_free.restype = ctypes.c_int
+    lib.pool_free.argtypes = [P, LL]
+    lib.pool_stat.restype = LL
+    lib.pool_stat.argtypes = [P, ctypes.c_int]
 
 
 def available() -> bool:

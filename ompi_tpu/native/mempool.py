@@ -1,0 +1,132 @@
+"""Host staging-buffer pool over the native bucket allocator.
+
+TPU-native equivalent of opal/mca/mpool + allocator/bucket (reference:
+allocator_bucket_alloc.c size-class free lists; mpool's pinned-memory
+reuse). `HostPool.alloc` returns a numpy view into one long-lived
+arena, so repeated host<->device staging and DCN sends reuse warm
+memory instead of hitting the allocator per message.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Optional
+
+import numpy as np
+
+from ..core import config
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+from . import build
+
+logger = get_logger("native.mempool")
+
+_default_cap = config.register(
+    "mpool", "base", "capacity", type=int, default=256 * 1024 * 1024,
+    description="Host staging pool arena size in bytes",
+)
+
+
+class PoolExhausted(OmpiTpuError):
+    errclass = "ERR_NO_MEM"
+
+
+class Block:
+    """A pooled buffer: numpy uint8 view + release handle."""
+
+    __slots__ = ("view", "offset", "_pool", "_freed")
+
+    def __init__(self, pool: "HostPool", offset: int, view: np.ndarray):
+        self._pool = pool
+        self.offset = offset
+        self.view = view
+        self._freed = False
+
+    def free(self) -> None:
+        if not self._freed:
+            self._pool._free(self.offset)
+            self._freed = True
+
+    def __enter__(self) -> "Block":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.free()
+
+
+class HostPool:
+    """Bucket-allocated arena; falls back to plain numpy when the
+    native library is unavailable."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        self.capacity = capacity or _default_cap.value
+        self._lib = build.get_lib()
+        self._ctx = None
+        self._arena: Optional[np.ndarray] = None
+        if self._lib is not None:
+            self._ctx = self._lib.pool_create(self.capacity)
+            base = self._lib.pool_base(self._ctx)
+            buf = (ctypes.c_char * self.capacity).from_address(base)
+            self._arena = np.frombuffer(buf, dtype=np.uint8)
+
+    @property
+    def native(self) -> bool:
+        return self._ctx is not None
+
+    def alloc(self, nbytes: int) -> Block:
+        if self._ctx is not None:
+            off = self._lib.pool_alloc(self._ctx, nbytes)
+            if off < 0:
+                raise PoolExhausted(
+                    f"pool exhausted allocating {nbytes} bytes "
+                    f"(capacity {self.capacity})"
+                )
+            return Block(self, off, self._arena[off:off + nbytes])
+        # fallback: ordinary numpy buffer, free() is a no-op
+        return Block(self, -1, np.empty(nbytes, np.uint8))
+
+    def _free(self, offset: int) -> None:
+        if self._ctx is not None and offset >= 0:
+            self._lib.pool_free(self._ctx, offset)
+
+    def stats(self) -> dict:
+        if self._ctx is None:
+            return {"native": False}
+        names = ("capacity", "high_water", "hits", "misses", "frees",
+                 "failed", "live")
+        return {
+            "native": True,
+            **{n: int(self._lib.pool_stat(self._ctx, i))
+               for i, n in enumerate(names)},
+        }
+
+    def close(self, force: bool = False) -> None:
+        if self._ctx is None:
+            return
+        live = int(self._lib.pool_stat(self._ctx, 6))
+        if live and not force:
+            # Outstanding Block.views point into the arena; destroying
+            # it under them is use-after-free.
+            raise OmpiTpuError(
+                f"pool close with {live} live allocations "
+                "(free them or close(force=True))"
+            )
+        self._arena = None
+        self._lib.pool_destroy(self._ctx)
+        self._ctx = None
+
+    def __del__(self) -> None:
+        try:
+            self.close(force=True)
+        except Exception:
+            pass
+
+
+_shared: Optional[HostPool] = None
+
+
+def shared_pool() -> HostPool:
+    global _shared
+    if _shared is None:
+        _shared = HostPool()
+    return _shared

@@ -1,0 +1,117 @@
+// Bucket allocator over a host arena: staging-buffer memory pool.
+//
+// TPU-native equivalent of opal/mca/allocator/bucket + mpool
+// (reference: allocator_bucket_alloc.c — power-of-two size-class
+// free lists over chunks obtained from the segment allocator;
+// mpool keeps pinned host memory reusable so the hot path never hits
+// malloc). On a TPU host the analog need is pinned/recycled staging
+// buffers for host<->device and DCN transfers: alloc is a free-list
+// pop, free is a push, and the arena never shrinks (reuse beats
+// munmap/mmap churn exactly as registration caching beats
+// re-registration on NICs).
+//
+// C API (ctypes): create/destroy a pool, alloc/free (offset-based so
+// Python can view into one shared buffer), and stats.
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <vector>
+
+namespace {
+
+struct Pool {
+  std::vector<char> arena;
+  size_t cursor = 0;  // bump pointer for fresh blocks
+  // size-class (power of two) -> free list of offsets
+  std::map<size_t, std::vector<size_t>> free_lists;
+  // live allocation -> rounded class size
+  std::map<size_t, size_t> live;
+  std::mutex mu;
+  // stats
+  int64_t hits = 0, misses = 0, frees = 0, failed = 0;
+};
+
+size_t round_class(size_t n) {
+  size_t c = 64;  // cacheline floor
+  while (c < n) c <<= 1;
+  return c;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* pool_create(long long capacity) {
+  Pool* p = new Pool();
+  p->arena.resize(capacity);
+  return p;
+}
+
+void pool_destroy(void* vp) { delete static_cast<Pool*>(vp); }
+
+char* pool_base(void* vp) {
+  return static_cast<Pool*>(vp)->arena.data();
+}
+
+// Returns byte offset into the arena, or -1 on exhaustion.
+long long pool_alloc(void* vp, long long nbytes) {
+  Pool* p = static_cast<Pool*>(vp);
+  if (nbytes <= 0) return -1;
+  size_t cls = round_class((size_t)nbytes);
+  std::lock_guard<std::mutex> g(p->mu);
+  auto it = p->free_lists.find(cls);
+  if (it != p->free_lists.end() && !it->second.empty()) {
+    size_t off = it->second.back();
+    it->second.pop_back();
+    p->live[off] = cls;
+    p->hits++;
+    return (long long)off;
+  }
+  if (p->cursor + cls > p->arena.size()) {
+    p->failed++;
+    return -1;
+  }
+  size_t off = p->cursor;
+  p->cursor += cls;
+  p->live[off] = cls;
+  p->misses++;
+  return (long long)off;
+}
+
+int pool_free(void* vp, long long offset) {
+  Pool* p = static_cast<Pool*>(vp);
+  std::lock_guard<std::mutex> g(p->mu);
+  auto it = p->live.find((size_t)offset);
+  if (it == p->live.end()) return -1;
+  p->free_lists[it->second].push_back(it->first);
+  p->live.erase(it);
+  p->frees++;
+  return 0;
+}
+
+long long pool_stat(void* vp, int what) {
+  Pool* p = static_cast<Pool*>(vp);
+  std::lock_guard<std::mutex> g(p->mu);
+  switch (what) {
+    case 0:
+      return (long long)p->arena.size();
+    case 1:
+      return (long long)p->cursor;  // high-water mark
+    case 2:
+      return p->hits;
+    case 3:
+      return p->misses;
+    case 4:
+      return p->frees;
+    case 5:
+      return p->failed;
+    case 6:
+      return (long long)p->live.size();
+    default:
+      return -1;
+  }
+}
+
+}  // extern "C"
