@@ -82,6 +82,9 @@ def init(
             len(procs),
             {p.platform for p in procs},
         )
+        from .hook import run_hooks
+
+        run_hooks("at_init_bottom", comm_world)
         return comm_world
 
 
@@ -96,7 +99,9 @@ def finalize() -> None:
         if _state is None:
             return
         from .communicator import live_comms
+        from .hook import run_hooks
 
+        run_hooks("at_finalize_top", _state.comm_world)
         try:
             from .io import fbtl as _fbtl
             from .io.file import live_files

@@ -184,6 +184,10 @@ class Communicator(HasAttributes, HasErrhandler):
             )
         component, fn = entry
         SPC.record(f"coll_{opname}_calls")
+        from .core import memchecker
+
+        if memchecker.enabled() and args:
+            memchecker.check_defined(args[0], f"{opname} buffer")
         from .monitoring import MONITOR
 
         if MONITOR.enabled:

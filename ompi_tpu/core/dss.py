@@ -1,0 +1,171 @@
+"""DSS — typed pack/unpack for runtime control messages.
+
+TPU-native equivalent of opal/dss (reference: dss_pack.c/dss_unpack.c —
+typed, length-prefixed buffers used for all runtime metadata exchange:
+modex entries, name-service records, tool messages). Unlike pickle,
+the format is explicit, versioned and cross-implementation-safe; the
+DCN control plane, name service and mpisync speak it on the wire.
+
+Wire format: [magic u32][version u8] then a stream of typed items:
+[type u8][payload]. Containers recurse. Integers are little-endian.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+import numpy as np
+
+from .errors import OmpiTpuError
+
+MAGIC = 0x4453531A  # "DSS\x1a"
+VERSION = 1
+
+_T_NONE = 0
+_T_BOOL = 1
+_T_INT = 2
+_T_FLOAT = 3
+_T_STR = 4
+_T_BYTES = 5
+_T_LIST = 6
+_T_DICT = 7
+_T_NDARRAY = 8
+_T_TUPLE = 9
+
+
+class DssError(OmpiTpuError):
+    errclass = "ERR_UNPACK"
+
+
+def _pack_item(out: bytearray, v: Any) -> None:
+    if v is None:
+        out.append(_T_NONE)
+    elif isinstance(v, bool):
+        out.append(_T_BOOL)
+        out.append(1 if v else 0)
+    elif isinstance(v, int):
+        out.append(_T_INT)
+        out += struct.pack("<q", v)
+    elif isinstance(v, float):
+        out.append(_T_FLOAT)
+        out += struct.pack("<d", v)
+    elif isinstance(v, str):
+        raw = v.encode()
+        out.append(_T_STR)
+        out += struct.pack("<q", len(raw))
+        out += raw
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        raw = bytes(v)
+        out.append(_T_BYTES)
+        out += struct.pack("<q", len(raw))
+        out += raw
+    elif isinstance(v, np.ndarray):
+        out.append(_T_NDARRAY)
+        dt = v.dtype.str.encode()
+        out += struct.pack("<q", len(dt))
+        out += dt
+        out += struct.pack("<q", v.ndim)
+        for d in v.shape:
+            out += struct.pack("<q", d)
+        raw = np.ascontiguousarray(v).tobytes()
+        out += struct.pack("<q", len(raw))
+        out += raw
+    elif isinstance(v, (list, tuple)):
+        out.append(_T_LIST if isinstance(v, list) else _T_TUPLE)
+        out += struct.pack("<q", len(v))
+        for item in v:
+            _pack_item(out, item)
+    elif isinstance(v, dict):
+        out.append(_T_DICT)
+        out += struct.pack("<q", len(v))
+        for k, item in v.items():
+            if not isinstance(k, str):
+                raise DssError(f"dict keys must be str, got {type(k)}")
+            _pack_item(out, k)
+            _pack_item(out, item)
+    else:
+        raise DssError(f"cannot pack type {type(v).__name__}")
+
+
+class _Reader:
+    def __init__(self, buf: bytes) -> None:
+        self.buf = buf
+        self.pos = 0
+
+    def take(self, n: int) -> bytes:
+        if self.pos + n > len(self.buf):
+            raise DssError("truncated buffer")
+        out = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return out
+
+    def i64(self) -> int:
+        return struct.unpack("<q", self.take(8))[0]
+
+    def u8(self) -> int:
+        return self.take(1)[0]
+
+
+def _unpack_item(r: _Reader) -> Any:
+    t = r.u8()
+    if t == _T_NONE:
+        return None
+    if t == _T_BOOL:
+        return bool(r.u8())
+    if t == _T_INT:
+        return r.i64()
+    if t == _T_FLOAT:
+        return struct.unpack("<d", r.take(8))[0]
+    if t == _T_STR:
+        return r.take(r.i64()).decode()
+    if t == _T_BYTES:
+        return r.take(r.i64())
+    if t == _T_NDARRAY:
+        dt = np.dtype(r.take(r.i64()).decode())
+        ndim = r.i64()
+        shape = tuple(r.i64() for _ in range(ndim))
+        raw = r.take(r.i64())
+        return np.frombuffer(raw, dt).reshape(shape).copy()
+    if t in (_T_LIST, _T_TUPLE):
+        n = r.i64()
+        items = [_unpack_item(r) for _ in range(n)]
+        return items if t == _T_LIST else tuple(items)
+    if t == _T_DICT:
+        n = r.i64()
+        out = {}
+        for _ in range(n):
+            k = _unpack_item(r)
+            out[k] = _unpack_item(r)
+        return out
+    raise DssError(f"unknown type tag {t}")
+
+
+def pack(*values: Any) -> bytes:
+    """Pack values into one self-describing buffer."""
+    out = bytearray(struct.pack("<IB", MAGIC, VERSION))
+    out += struct.pack("<q", len(values))
+    for v in values:
+        _pack_item(out, v)
+    return bytes(out)
+
+
+def unpack(buf: bytes) -> list[Any]:
+    r = _Reader(bytes(buf))
+    magic, version = struct.unpack("<IB", r.take(5))
+    if magic != MAGIC:
+        raise DssError(f"bad magic {magic:#x}")
+    if version != VERSION:
+        raise DssError(f"unsupported version {version}")
+    n = r.i64()
+    out = [_unpack_item(r) for _ in range(n)]
+    if r.pos != len(r.buf):
+        raise DssError(f"{len(r.buf) - r.pos} trailing bytes")
+    return out
+
+
+def unpack_one(buf: bytes) -> Any:
+    vals = unpack(buf)
+    if len(vals) != 1:
+        raise DssError(f"expected 1 value, buffer holds {len(vals)}")
+    return vals[0]

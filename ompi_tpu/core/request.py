@@ -71,6 +71,13 @@ class Request:
         if status is not None:
             self.status = status
         self.state = RequestState.COMPLETE
+        from . import peruse
+
+        peruse.fire(peruse.PeruseEvent.REQ_COMPLETE, request=self)
+        from . import memchecker
+
+        if result is not None:
+            memchecker.mark_defined(result)
         for cb in self._callbacks:
             cb(self)
 

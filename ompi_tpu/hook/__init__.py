@@ -1,0 +1,12 @@
+"""Hook framework: lifecycle interposition points.
+
+TPU-native equivalent of ompi/mca/hook (reference: hook framework with
+callbacks at mpi init/finalize; its one real component, comm_method,
+prints the per-peer transport selection matrix at init,
+hook_comm_method_fns.c:36-92).
+"""
+
+from . import comm_method  # noqa: F401 - registers hook/comm_method
+from .framework import HOOK, HookComponent, run_hooks
+
+__all__ = ["HOOK", "HookComponent", "comm_method", "run_hooks"]

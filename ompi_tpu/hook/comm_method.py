@@ -1,0 +1,82 @@
+"""hook/comm_method — print the transport/component selection tables.
+
+TPU-native equivalent of ompi/mca/hook/comm_method (reference:
+hook_comm_method_fns.c:36-92 — at init, rank 0 prints an N×N matrix of
+which transport each peer pair selected, so users can verify sm vs tcp
+vs self wiring at a glance). Here the matrix shows the BTL per rank
+pair plus the coll component chosen per operation.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import config
+from .framework import HOOK, HookComponent
+
+_enable = config.register(
+    "hook", "comm_method", "display", type=bool, default=False,
+    description="Print the transport selection matrix at init "
+    "(reference: --mca hook_comm_method_enable_mpi_init)",
+)
+
+_max = config.register(
+    "hook", "comm_method", "max", type=int, default=12,
+    description="Largest comm size rendered as a full matrix",
+)
+
+
+def transport_matrix(comm) -> list[list[str]]:
+    """matrix[src][dst] = btl component name."""
+    bml = comm.pml.bml(comm) if hasattr(comm.pml, "bml") else None
+    if bml is None:
+        host = getattr(comm.pml, "host", None)
+        if host is not None and hasattr(host, "bml"):
+            bml = host.bml(comm)
+    n = comm.size
+    out = []
+    for s in range(n):
+        row = []
+        for d in range(n):
+            if bml is None:
+                row.append("?")
+            else:
+                row.append(bml.btl_for(s, d).NAME)
+        out.append(row)
+    return out
+
+
+def render(comm) -> str:
+    n = comm.size
+    lines = [f"comm_method: {comm.name} (size {n})"]
+    if n <= _max.value:
+        mat = transport_matrix(comm)
+        width = max(4, max(len(x) for row in mat for x in row) + 1)
+        hdr = "      " + "".join(f"{d:>{width}}" for d in range(n))
+        lines.append(hdr)
+        for s, row in enumerate(mat):
+            lines.append(
+                f"{s:>5} " + "".join(f"{x:>{width}}" for x in row)
+            )
+    else:
+        # large comms: summarize like the reference's >max fallback
+        from collections import Counter
+
+        mat = transport_matrix(comm)
+        counts = Counter(x for row in mat for x in row)
+        lines.append(f"  transports: {dict(counts)}")
+    lines.append("  coll selection:")
+    for op, (comp, _) in sorted(comm._coll.items()):
+        lines.append(f"    {op:>22}: {comp.NAME}")
+    return "\n".join(lines)
+
+
+@HOOK.register
+class CommMethodHook(HookComponent):
+    NAME = "comm_method"
+    PRIORITY = 10
+    DESCRIPTION = "print per-peer transport selection at init"
+
+    def at_init_bottom(self, world) -> None:
+        if _enable.value:
+            print(render(world))

@@ -1,0 +1,36 @@
+"""Hook framework base (reference: ompi/mca/hook)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import component as mca
+from ..core.logging import get_logger
+
+logger = get_logger("hook")
+
+HOOK = mca.framework("hook", "lifecycle interposition hooks")
+
+
+class HookComponent(mca.Component):
+    """Override any of the lifecycle methods; all registered hooks run
+    (no winner selection — reference runs every hook component)."""
+
+    def at_init_bottom(self, world) -> None:
+        """After the world communicator is fully wired."""
+
+    def at_finalize_top(self, world) -> None:
+        """Before teardown begins."""
+
+
+def run_hooks(point: str, world) -> None:
+    for comp in HOOK.select_all():
+        fn = getattr(comp, point, None)
+        if fn is None:
+            continue
+        try:
+            fn(world)
+        except Exception:
+            logger.exception(
+                "hook %s.%s failed", comp.NAME, point
+            )

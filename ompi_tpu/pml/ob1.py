@@ -222,9 +222,17 @@ class Ob1Pml(PmlComponent):
             SPC.record("pml_eager_sends")
         else:
             SPC.record("pml_rndv_sends")
+        from ..core import memchecker, peruse
+
+        memchecker.check_defined(value, "send buffer")
+        peruse.fire(peruse.PeruseEvent.REQ_ACTIVATE, request=req,
+                    kind="send")
         # Try to match an already-posted recv (order: post order).
         if not self._match_posted(st, pending):
             st.unexpected.append(pending)
+            peruse.fire(
+                peruse.PeruseEvent.QUEUE_UNEXPECTED, env=env
+            )
         if eager:
             req._mark_sent(pending.transferred)
         return req
@@ -250,8 +258,13 @@ class Ob1Pml(PmlComponent):
         req = RecvRequest(source, dest, tag)
         st = self._state(comm)
         SPC.record("pml_irecv_calls")
+        from ..core import peruse
+
+        peruse.fire(peruse.PeruseEvent.REQ_ACTIVATE, request=req,
+                    kind="recv")
         if not self._match_unexpected(st, req):
             st.posted.append(req)
+            peruse.fire(peruse.PeruseEvent.QUEUE_POSTED, request=req)
         return req
 
     def recv(self, comm, source: int, tag: int,
@@ -277,8 +290,17 @@ class Ob1Pml(PmlComponent):
         return True
 
     def _deliver(self, pending: _PendingSend, req: RecvRequest) -> None:
+        from ..core import peruse
+
+        peruse.fire(
+            peruse.PeruseEvent.REQ_MATCH,
+            env=pending.env, recv=req,
+        )
         if pending.transferred is None:
             # Rendezvous: move the payload now that the recv is matched.
+            peruse.fire(
+                peruse.PeruseEvent.REQ_XFER_BEGIN, env=pending.env
+            )
             pending.transferred = pending.btl.transfer(
                 pending.payload_src, pending.src_proc, pending.dst_proc
             )

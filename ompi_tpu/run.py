@@ -1,0 +1,99 @@
+"""Launcher shim — the mpirun analog.
+
+The reference's mpirun IS the external PRRTE runtime (reference:
+ompi/tools/mpirun/Makefile.am:25-29 — a symlink to `prte`; SURVEY §3.5
+concludes the TPU build "needs only a thin launcher shim" because
+placement is the platform's job and wire-up is `jax.distributed`).
+This is that shim:
+
+    python -m ompi_tpu.run [options] prog.py [args...]
+
+Single-host: exec the program with auto-init. Multi-host: set the
+jax.distributed coordinator variables (the PMIx-server analog) so the
+program's `ompi_tpu.init(distributed=True)` wires every host; one
+invocation per host (GKE/SLURM index arithmetic supplied via flags or
+inherited env).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import runpy
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    ap = argparse.ArgumentParser(
+        prog="ompi_tpu.run",
+        description="Launch a program under the ompi_tpu runtime",
+    )
+    ap.add_argument(
+        "--coordinator", default=None,
+        help="host:port of the jax.distributed coordinator "
+        "(multi-host; process 0's address)",
+    )
+    ap.add_argument(
+        "--num-processes", type=int, default=None,
+        help="total controller processes in the job",
+    )
+    ap.add_argument(
+        "--process-id", type=int, default=None,
+        help="this controller's index (0-based)",
+    )
+    ap.add_argument(
+        "--mca", action="append", default=[], metavar="VAR=VALUE",
+        help="set a config var (reference: mpirun --mca), repeatable",
+    )
+    ap.add_argument(
+        "--display-comm-method", action="store_true",
+        help="print the transport selection table at init "
+        "(reference: hook/comm_method)",
+    )
+    ap.add_argument("--no-auto-init", action="store_true",
+                    help="do not call ompi_tpu.init() before the program")
+    ap.add_argument("prog", help="python program to run")
+    ap.add_argument("args", nargs=argparse.REMAINDER)
+    return ap
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    for spec in args.mca:
+        if "=" not in spec:
+            raise SystemExit(f"--mca expects VAR=VALUE, got {spec!r}")
+        var, val = spec.split("=", 1)
+        # env-source precedence, exactly like OMPI_MCA_* variables
+        os.environ[f"OMPITPU_MCA_{var}"] = val
+    if args.display_comm_method:
+        os.environ["OMPITPU_MCA_hook_comm_method_display"] = "1"
+
+    distributed = args.coordinator is not None
+    if distributed:
+        if args.num_processes is None or args.process_id is None:
+            raise SystemExit(
+                "--coordinator requires --num-processes and --process-id"
+            )
+
+    if not args.no_auto_init:
+        import ompi_tpu
+
+        ompi_tpu.init(
+            distributed=distributed,
+            coordinator_address=args.coordinator,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+
+    sys.argv = [args.prog] + args.args
+    runpy.run_path(args.prog, run_name="__main__")
+    if not args.no_auto_init:
+        import ompi_tpu
+
+        ompi_tpu.finalize()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,204 @@
+"""DPM — dynamic process management: publish/lookup, connect/accept,
+spawn, intercommunicators.
+
+TPU-native equivalent of ompi/dpm (reference: dpm.c:1836 —
+MPI_Comm_spawn / connect / accept over PMIx publish/lookup, plus
+MPI_Intercomm_create/merge). The driver model maps "process" to
+"device partition": spawning creates a new communicator over a device
+subset, and connect/accept rendezvous through a name service — an
+in-process registry that can spill to a filesystem directory so
+multiple controller processes on one network filesystem can find each
+other (the PMIx-server analog).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Optional
+
+from ..core import config, dss
+from ..core.errors import ArgumentError, CommError, OmpiTpuError
+from ..core.logging import get_logger
+from ..group import Group
+
+logger = get_logger("dpm")
+
+_ns_dir = config.register(
+    "dpm", "base", "nameservice_dir", type=str, default="",
+    description="Directory for cross-process publish/lookup records "
+    "(empty: in-process only)",
+)
+
+
+class NameServiceError(OmpiTpuError):
+    errclass = "ERR_NAME"
+
+
+_published: dict[str, bytes] = {}
+_ns_lock = threading.Lock()
+
+
+def publish_name(service: str, port: str | dict) -> None:
+    """MPI_Publish_name: record service -> port (reference: dpm.c's
+    PMIx_Publish path). `port` may be any dss-packable value."""
+    rec = dss.pack(port)
+    with _ns_lock:
+        if service in _published:
+            raise NameServiceError(f"service {service!r} already published")
+        _published[service] = rec
+    d = _ns_dir.value
+    if d:
+        os.makedirs(d, exist_ok=True)
+        tmp = os.path.join(d, f".{service}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(rec)
+        os.rename(tmp, os.path.join(d, service))
+
+
+def lookup_name(service: str, timeout: float = 0.0):
+    """MPI_Lookup_name; with timeout > 0 polls until published."""
+    deadline = time.monotonic() + timeout
+    while True:
+        with _ns_lock:
+            rec = _published.get(service)
+        if rec is None:
+            d = _ns_dir.value
+            p = os.path.join(d, service) if d else None
+            if p and os.path.exists(p):
+                with open(p, "rb") as f:
+                    rec = f.read()
+        if rec is not None:
+            return dss.unpack_one(rec)
+        if time.monotonic() >= deadline:
+            raise NameServiceError(f"service {service!r} not published")
+        time.sleep(0.01)
+
+
+def unpublish_name(service: str) -> None:
+    with _ns_lock:
+        _published.pop(service, None)
+    d = _ns_dir.value
+    if d:
+        try:
+            os.unlink(os.path.join(d, service))
+        except OSError:
+            pass
+
+
+class Intercomm:
+    """An intercommunicator: two disjoint groups with p2p across them
+    (reference: ompi's intercomm support in comm.c + dpm)."""
+
+    def __init__(self, local, remote, *, tag: int = 0) -> None:
+        if set(local.group.world_ranks) & set(remote.group.world_ranks):
+            raise ArgumentError(
+                "intercomm groups must be disjoint "
+                f"({local.name} vs {remote.name})"
+            )
+        self.local_comm = local
+        self.remote_comm = remote
+        self.tag = tag
+
+    @property
+    def local_size(self) -> int:
+        return self.local_comm.size
+
+    @property
+    def remote_size(self) -> int:
+        return self.remote_comm.size
+
+    def send(self, value, remote_rank: int, tag: int = 0, *,
+             local_rank: int = 0):
+        """Send from local_rank (in the local group) to remote_rank (in
+        the remote group) — addressing is always remote-group-relative
+        (MPI intercomm semantics)."""
+        merged = self._merged()
+        src = local_rank
+        dst = self.local_size + remote_rank
+        return merged.send(value, dst, tag, source=src)
+
+    def recv(self, remote_rank: int = -1, tag: int = -1, *,
+             local_rank: int = 0):
+        merged = self._merged()
+        src = (self.local_size + remote_rank) if remote_rank >= 0 else -1
+        return merged.recv(src, tag, dest=local_rank)
+
+    _merged_cache = None
+
+    def _merged(self):
+        if self._merged_cache is None:
+            self._merged_cache = self.merge()
+        return self._merged_cache
+
+    def merge(self, high: bool = False):
+        """MPI_Intercomm_merge: one intracommunicator over both groups;
+        `high=True` orders the remote group first."""
+        a, b = (self.remote_comm, self.local_comm) if high else (
+            self.local_comm, self.remote_comm)
+        ranks = list(a.group.world_ranks) + list(b.group.world_ranks)
+        from .. import api
+
+        world = api.world()
+        merged = world.create(Group(ranks))
+        merged.set_name(
+            f"merge({self.local_comm.name},{self.remote_comm.name})"
+        )
+        return merged
+
+
+def spawn(comm, n: int, *, name: str = "spawned") -> Intercomm:
+    """MPI_Comm_spawn, driver form: allocate `n` world devices that are
+    NOT in `comm` to a new child communicator; returns the parent-child
+    intercommunicator. Raises when the world has no free devices
+    (the reference fails the same way when the RM has no slots)."""
+    from .. import api
+
+    world = api.world()
+    used = set(comm.group.world_ranks)
+    free = [r for r in range(world.size) if r not in used]
+    if len(free) < n:
+        raise CommError(
+            f"spawn({n}): only {len(free)} free device slots in world "
+            f"(size {world.size}, parent uses {len(used)})"
+        )
+    child = world.create(Group(free[:n]))
+    child.set_name(name)
+    return Intercomm(comm, child)
+
+
+def connect(comm, service: str, *, timeout: float = 5.0) -> Intercomm:
+    """MPI_Comm_connect: rendezvous with an accepting communicator via
+    the name service."""
+    port = lookup_name(service, timeout=timeout)
+    if not isinstance(port, dict) or "world_ranks" not in port:
+        raise NameServiceError(f"service {service!r}: bad port record")
+    from .. import api
+
+    world = api.world()
+    remote = world.create(Group(port["world_ranks"]))
+    remote.set_name(f"{service}.acceptor")
+    return Intercomm(comm, remote)
+
+
+def accept(comm, service: str) -> "Acceptance":
+    """MPI_Comm_accept (returns immediately in driver mode: publishes
+    and hands back a handle to close)."""
+    publish_name(service, {"world_ranks": list(comm.group.world_ranks)})
+    return Acceptance(comm, service)
+
+
+class Acceptance:
+    def __init__(self, comm, service: str) -> None:
+        self.comm = comm
+        self.service = service
+
+    def close(self) -> None:
+        unpublish_name(self.service)
+
+    def __enter__(self) -> "Acceptance":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,329 @@
+"""Aux subsystems: dss, hook/comm_method, peruse, memchecker, dpm,
+mpisync, launcher."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core import config, dss, memchecker, peruse
+from ompi_tpu.core.errors import CommError
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+# -- dss -------------------------------------------------------------------
+
+def test_dss_roundtrip_scalars():
+    vals = [None, True, False, 42, -1, 3.5, "héllo", b"\x00\xff"]
+    assert dss.unpack(dss.pack(*vals)) == vals
+
+
+def test_dss_roundtrip_containers():
+    v = {"a": [1, 2.5, "x"], "b": {"c": (1, 2)}, "d": b"raw"}
+    (got,) = dss.unpack(dss.pack(v))
+    assert got == v
+
+
+def test_dss_ndarray():
+    arr = np.arange(12, dtype=np.float32).reshape(3, 4)
+    (got,) = dss.unpack(dss.pack(arr))
+    np.testing.assert_array_equal(got, arr)
+    assert got.dtype == arr.dtype
+
+
+def test_dss_rejects_garbage():
+    with pytest.raises(dss.DssError):
+        dss.unpack(b"not a dss buffer")
+    with pytest.raises(dss.DssError):
+        dss.unpack(dss.pack(1)[:-2])  # truncated
+    with pytest.raises(dss.DssError):
+        dss.pack(object())
+
+
+# -- hook / comm_method ----------------------------------------------------
+
+def test_comm_method_render(comm):
+    from ompi_tpu.hook import comm_method
+
+    text = comm_method.render(comm)
+    assert f"size {comm.size}" in text
+    assert "coll selection" in text
+    # rank pairs use self (diagonal) and ici (off-diagonal) transports
+    if comm.size > 1:
+        assert "ici" in text
+    assert "self" in text
+
+
+def test_hook_runs_at_init(capsys):
+    from ompi_tpu.hook import run_hooks
+
+    config.set("hook_comm_method_display", True)
+    try:
+        run_hooks("at_init_bottom", mt.world())
+        assert "comm_method" in capsys.readouterr().out
+    finally:
+        config.set("hook_comm_method_display", False)
+
+
+# -- peruse ----------------------------------------------------------------
+
+def test_peruse_lifecycle_events(comm):
+    seen = []
+    sids = [
+        peruse.subscribe(ev, lambda event, **kw: seen.append(event))
+        for ev in (
+            peruse.PeruseEvent.REQ_ACTIVATE,
+            peruse.PeruseEvent.REQ_MATCH,
+            peruse.PeruseEvent.REQ_COMPLETE,
+            peruse.PeruseEvent.QUEUE_UNEXPECTED,
+        )
+    ]
+    try:
+        c = comm.dup()
+        c.rank(0).isend(np.float32(1.0), dest=1, tag=2)
+        c.rank(1).recv(source=0, tag=2)
+        kinds = {e for e in seen}
+        assert peruse.PeruseEvent.REQ_ACTIVATE in kinds
+        assert peruse.PeruseEvent.REQ_MATCH in kinds
+        assert peruse.PeruseEvent.REQ_COMPLETE in kinds
+        assert peruse.PeruseEvent.QUEUE_UNEXPECTED in kinds
+    finally:
+        for sid in sids:
+            peruse.unsubscribe(sid)
+
+
+def test_peruse_unsubscribe_stops_events(comm):
+    seen = []
+    sid = peruse.subscribe(
+        peruse.PeruseEvent.REQ_COMPLETE,
+        lambda event, **kw: seen.append(1),
+    )
+    peruse.unsubscribe(sid)
+    c = comm.dup()
+    c.rank(0).isend(np.float32(1.0), dest=1, tag=2)
+    c.rank(1).recv(source=0, tag=2)
+    assert not seen
+
+
+# -- memchecker ------------------------------------------------------------
+
+def test_memchecker_nan_guard(comm):
+    config.set("memchecker_base_enable", True)
+    try:
+        c = comm.dup()
+        bad = np.array([1.0, np.nan], np.float32)
+        with pytest.raises(memchecker.MemcheckError):
+            c.rank(0).isend(bad, dest=1, tag=1)
+        with pytest.raises(memchecker.MemcheckError):
+            c.allreduce(
+                c.put_rank_major(
+                    np.full((c.size, 2), np.inf, np.float32)
+                )
+            )
+    finally:
+        config.set("memchecker_base_enable", False)
+        memchecker.reset()
+
+
+def test_memchecker_undefined_until_complete():
+    config.set("memchecker_base_enable", True)
+    try:
+        buf = np.zeros(4, np.float32)
+        memchecker.mark_undefined(buf, "pending recv test")
+        with pytest.raises(memchecker.MemcheckError):
+            memchecker.assert_accessible(buf)
+        memchecker.mark_defined(buf)
+        memchecker.assert_accessible(buf)  # no raise
+    finally:
+        config.set("memchecker_base_enable", False)
+        memchecker.reset()
+
+
+def test_memchecker_off_is_free(comm):
+    # disabled: NaNs flow through unchecked (no overhead path)
+    c = comm.dup()
+    bad = np.array([np.nan], np.float32)
+    c.rank(0).isend(bad, dest=1, tag=1)
+    out = c.rank(1).recv(source=0, tag=1)
+    assert np.isnan(np.asarray(out)).all()
+
+
+# -- dpm -------------------------------------------------------------------
+
+def test_publish_lookup_unpublish():
+    from ompi_tpu.runtime import dpm
+
+    dpm.publish_name("svc-a", {"world_ranks": [0, 1]})
+    got = dpm.lookup_name("svc-a")
+    assert got == {"world_ranks": [0, 1]}
+    with pytest.raises(dpm.NameServiceError):
+        dpm.publish_name("svc-a", {})  # duplicate
+    dpm.unpublish_name("svc-a")
+    with pytest.raises(dpm.NameServiceError):
+        dpm.lookup_name("svc-a")
+
+
+def test_spawn_creates_disjoint_child(comm):
+    from ompi_tpu.runtime import dpm
+
+    if comm.size < 4:
+        pytest.skip("needs >= 4 ranks")
+    parent = comm.create(mt.Group([0, 1]))
+    inter = dpm.spawn(parent, 2)
+    assert inter.local_size == 2 and inter.remote_size == 2
+    assert not (
+        set(inter.local_comm.group.world_ranks)
+        & set(inter.remote_comm.group.world_ranks)
+    )
+    # p2p across the bridge: local rank 0 -> remote rank 1, received on
+    # the remote side via the merged intracomm (remote rank 1 ==
+    # merged rank local_size + 1)
+    inter.send(np.float32(5.0), remote_rank=1, tag=3, local_rank=0)
+    merged = inter._merged()
+    assert merged.size == 4
+    got = merged.recv(source=0, tag=3, dest=inter.local_size + 1)
+    assert float(got) == 5.0
+    # reverse direction through the reversed intercomm view
+    rev = dpm.Intercomm(inter.remote_comm, inter.local_comm)
+    rev.send(np.float32(6.0), remote_rank=0, tag=4, local_rank=1)
+    got2 = rev._merged().recv(source=-1, tag=4, dest=rev.local_size)
+    assert float(got2) == 6.0
+
+
+def test_spawn_exhaustion(comm):
+    from ompi_tpu.runtime import dpm
+
+    with pytest.raises(CommError):
+        dpm.spawn(comm, 1)  # world comm uses every device
+
+
+def test_connect_accept(comm):
+    from ompi_tpu.runtime import dpm
+
+    if comm.size < 4:
+        pytest.skip("needs >= 4 ranks")
+    server = comm.create(mt.Group([0, 1]))
+    client = comm.create(mt.Group([2, 3]))
+    with dpm.accept(server, "svc-b"):
+        inter = dpm.connect(client, "svc-b")
+        assert inter.remote_size == 2
+        inter.send(np.float32(7.0), remote_rank=0, tag=1)
+        merged = inter._merged()
+        got = merged.recv(source=0, tag=1, dest=2)
+        assert float(got) == 7.0
+    with pytest.raises(dpm.NameServiceError):
+        dpm.lookup_name("svc-b")
+
+
+def test_intercomm_merge_high(comm):
+    from ompi_tpu.runtime import dpm
+
+    if comm.size < 4:
+        pytest.skip("needs >= 4 ranks")
+    a = comm.create(mt.Group([0, 1]))
+    b = comm.create(mt.Group([2, 3]))
+    inter = dpm.Intercomm(a, b)
+    low = inter.merge(high=False)
+    high = inter.merge(high=True)
+    assert list(low.group.world_ranks) == [0, 1, 2, 3]
+    assert list(high.group.world_ranks) == [2, 3, 0, 1]
+
+
+# -- mpisync ---------------------------------------------------------------
+
+def test_mpisync_devices(comm):
+    from ompi_tpu.tools import mpisync
+
+    lat = mpisync.measure_devices(comm, samples=3)
+    assert set(lat) == set(range(comm.size))
+    assert all(0 < v < 5.0 for v in lat.values())
+
+
+def test_mpisync_dcn_offset():
+    from ompi_tpu.native import build
+
+    if not build.available():
+        pytest.skip("native library unavailable")
+    import threading
+
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.tools import mpisync
+
+    a = dcn.DcnEndpoint()
+    b = dcn.DcnEndpoint()
+    try:
+        peer_b = a.connect(b.address[0], b.address[1], cookie=1)
+        t = threading.Thread(
+            target=mpisync.serve_dcn, args=(b, 8), daemon=True
+        )
+        t.start()
+        est = mpisync.measure_dcn(a, peer_b, samples=8)
+        t.join(timeout=30)
+        # same host, same clock: offset must be tiny, rtt sane
+        assert abs(est.offset_s) < 0.5
+        assert 0 < est.rtt_s < 1.0
+    finally:
+        a.close()
+        b.close()
+
+
+# -- launcher --------------------------------------------------------------
+
+def test_launcher_runs_program(tmp_path):
+    import subprocess
+    import sys
+
+    prog = tmp_path / "prog.py"
+    prog.write_text(
+        "import ompi_tpu\n"
+        "assert ompi_tpu.initialized()\n"
+        "print('RANKS', ompi_tpu.world().size)\n"
+    )
+    env = dict(
+        __import__("os").environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=4",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from ompi_tpu.run import main; main(['%s'])" % prog],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    assert "RANKS 4" in out.stdout
+
+
+def test_launcher_mca_flag(tmp_path):
+    import subprocess
+    import sys
+
+    prog = tmp_path / "prog2.py"
+    prog.write_text(
+        "from ompi_tpu.btl import BTL\n"
+        "print('EAGER', BTL.component('ici').eager_limit)\n"
+    )
+    env = dict(
+        __import__("os").environ,
+        XLA_FLAGS="--xla_force_host_platform_device_count=2",
+    )
+    out = subprocess.run(
+        [sys.executable, "-c",
+         "import jax; jax.config.update('jax_platforms','cpu');"
+         "from ompi_tpu.run import main;"
+         "main(['--mca', 'btl_ici_eager_limit=12345', '%s'])" % prog],
+        capture_output=True, text=True, timeout=120, env=env,
+        cwd="/root/repo",
+    )
+    assert out.returncode == 0, out.stderr
+    assert "EAGER 12345" in out.stdout
