@@ -271,6 +271,10 @@ class VarRegistry:
             )
         return out
 
+    def all_vars(self) -> list["Var"]:
+        """Registered Var objects, sorted by name (MPI_T cvar iter)."""
+        return [self._vars[n] for n in sorted(self._vars)]
+
     def __contains__(self, full_name: str) -> bool:
         return full_name in self._vars
 

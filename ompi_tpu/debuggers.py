@@ -1,0 +1,77 @@
+"""Parallel-debugger attach interface (MPIR analog).
+
+TPU-native equivalent of ompi/debuggers (reference:
+ompi_debuggers.c:84-129 — the MPIR spec's `MPIR_proctable` describing
+every rank for TotalView/DDT, plus the `MPIR_debug_gate` the launcher
+releases once the debugger attached). The driver analog: one process
+per host, ranks are devices — the proctable maps rank → (host pid,
+device, platform, coords) so a tools process can find everything, and
+the gate is an env-controlled barrier before init returns.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass, field
+
+from .core.logging import get_logger
+
+logger = get_logger("debuggers")
+
+#: set to "1" by an attaching tool to release the gate
+GATE_ENV = "OMPITPU_DEBUG_GATE"
+#: set to "1" in the job env to make init wait for an attach
+WAIT_ENV = "OMPITPU_WAIT_FOR_DEBUGGER"
+
+
+@dataclass
+class ProcEntry:
+    rank: int
+    pid: int
+    device: str
+    platform: str
+    process_index: int
+    coords: tuple = ()
+
+
+@dataclass
+class Proctable:
+    entries: list = field(default_factory=list)
+    being_debugged: bool = False
+
+
+def build_proctable(comm) -> Proctable:
+    """The MPIR_proctable analog for a communicator."""
+    pt = Proctable(being_debugged=os.environ.get(WAIT_ENV) == "1")
+    for r, proc in enumerate(comm.procs):
+        dev = proc.device
+        pt.entries.append(
+            ProcEntry(
+                rank=r,
+                pid=os.getpid(),
+                device=str(dev),
+                platform=getattr(proc, "platform", "?"),
+                process_index=proc.process_index,
+                coords=tuple(getattr(dev, "coords", ()) or ()),
+            )
+        )
+    return pt
+
+
+def wait_for_debugger(poll_s: float = 0.1, timeout: float = 600.0) -> bool:
+    """The MPIR_debug_gate: when WAIT_ENV is set, block until a tool
+    sets GATE_ENV (reference: debugger spins on MPIR_debug_gate,
+    ompi_debuggers.c:129). Returns True if gated."""
+    if os.environ.get(WAIT_ENV) != "1":
+        return False
+    logger.info(
+        "waiting for debugger (release: set %s=1 in this process)",
+        GATE_ENV,
+    )
+    deadline = time.monotonic() + timeout
+    while os.environ.get(GATE_ENV) != "1":
+        if time.monotonic() >= deadline:
+            raise TimeoutError("debugger gate never released")
+        time.sleep(poll_s)
+    return True

@@ -1,0 +1,122 @@
+// Vectorized host reduction kernels per (op x dtype).
+//
+// TPU-native equivalent of ompi/mca/op/avx (reference:
+// op_avx_functions.c:28-66 — macro-generated SSE/AVX2/AVX512 variants
+// per operator and type with runtime CPU-flag dispatch). The TPU build
+// reduces on the MXU/VPU for device buffers; these kernels serve the
+// host-side paths the reference serves with AVX: the coll/basic oracle,
+// DCN hierarchical reductions of staged buffers, and file-IO
+// aggregation. g++ -O3 auto-vectorizes the loops (the portable form of
+// the reference's hand-written intrinsics); dispatch is by (op, dtype)
+// enums across one C entry point.
+//
+// Semantics: inout[i] = op(inout[i], in[i]) — the reference's
+// two-buffer MPI_Op signature (ompi/op/op.h three-buffer form reduces
+// to this on the hot path).
+
+#include <cstdint>
+
+namespace {
+
+enum OpKind : int {
+  kSum = 0,
+  kProd = 1,
+  kMax = 2,
+  kMin = 3,
+  kBand = 4,
+  kBor = 5,
+  kBxor = 6,
+  kLand = 7,
+  kLor = 8,
+};
+
+enum DType : int {
+  kF32 = 0,
+  kF64 = 1,
+  kI32 = 2,
+  kI64 = 3,
+  kU8 = 4,
+  kI16 = 5,
+};
+
+template <typename T>
+void arith(int op, T* inout, const T* in, long long n) {
+  switch (op) {
+    case kSum:
+      for (long long i = 0; i < n; ++i) inout[i] += in[i];
+      break;
+    case kProd:
+      for (long long i = 0; i < n; ++i) inout[i] *= in[i];
+      break;
+    case kMax:
+      for (long long i = 0; i < n; ++i)
+        inout[i] = inout[i] > in[i] ? inout[i] : in[i];
+      break;
+    case kMin:
+      for (long long i = 0; i < n; ++i)
+        inout[i] = inout[i] < in[i] ? inout[i] : in[i];
+      break;
+    case kLand:
+      for (long long i = 0; i < n; ++i)
+        inout[i] = (T)((inout[i] != (T)0) && (in[i] != (T)0));
+      break;
+    case kLor:
+      for (long long i = 0; i < n; ++i)
+        inout[i] = (T)((inout[i] != (T)0) || (in[i] != (T)0));
+      break;
+    default:
+      break;
+  }
+}
+
+template <typename T>
+void bitwise(int op, T* inout, const T* in, long long n) {
+  switch (op) {
+    case kBand:
+      for (long long i = 0; i < n; ++i) inout[i] &= in[i];
+      break;
+    case kBor:
+      for (long long i = 0; i < n; ++i) inout[i] |= in[i];
+      break;
+    case kBxor:
+      for (long long i = 0; i < n; ++i) inout[i] ^= in[i];
+      break;
+    default:
+      arith<T>(op, inout, in, n);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+// Returns 0 on success, -1 for unsupported (op, dtype) combos.
+int op_reduce(int op, int dtype, void* inout, const void* in,
+              long long n) {
+  switch (dtype) {
+    case kF32:
+      if (op >= kBand && op <= kBxor) return -1;  // no float bitwise
+      arith<float>(op, (float*)inout, (const float*)in, n);
+      return 0;
+    case kF64:
+      if (op >= kBand && op <= kBxor) return -1;
+      arith<double>(op, (double*)inout, (const double*)in, n);
+      return 0;
+    case kI32:
+      bitwise<int32_t>(op, (int32_t*)inout, (const int32_t*)in, n);
+      return 0;
+    case kI64:
+      bitwise<int64_t>(op, (int64_t*)inout, (const int64_t*)in, n);
+      return 0;
+    case kU8:
+      bitwise<uint8_t>(op, (uint8_t*)inout, (const uint8_t*)in, n);
+      return 0;
+    case kI16:
+      bitwise<int16_t>(op, (int16_t*)inout, (const int16_t*)in, n);
+      return 0;
+    default:
+      return -1;
+  }
+}
+
+}  // extern "C"

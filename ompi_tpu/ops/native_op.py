@@ -1,0 +1,89 @@
+"""Native host reduction dispatch (the op/avx analog's Python face).
+
+Reference: ompi/op's 3-tier dispatch — base C loops, then SIMD variants
+selected by CPU flags (op_avx_functions.c:28-66). Here the tiers are:
+device (XLA on MXU/VPU — the primary TPU path, in ops.op), native C++
+vectorized loops (this module), then numpy (always available). `reduce`
+picks native when the (op, dtype) pair is supported and buffers are
+contiguous; callers never need to know which tier ran.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..core import config
+from ..native import build
+
+_enable = config.register(
+    "op", "native", "enable", type=bool, default=True,
+    description="Use native vectorized host reduction kernels",
+)
+
+_OPS = {
+    "sum": 0, "prod": 1, "max": 2, "min": 3,
+    "band": 4, "bor": 5, "bxor": 6, "land": 7, "lor": 8,
+}
+_DTYPES = {
+    np.dtype(np.float32): 0,
+    np.dtype(np.float64): 1,
+    np.dtype(np.int32): 2,
+    np.dtype(np.int64): 3,
+    np.dtype(np.uint8): 4,
+    np.dtype(np.int16): 5,
+}
+
+_declared = False
+
+
+def _lib():
+    global _declared
+    lib = build.get_lib()
+    if lib is None or not hasattr(lib, "op_reduce"):
+        return None
+    if not _declared:
+        import ctypes
+
+        lib.op_reduce.restype = ctypes.c_int
+        lib.op_reduce.argtypes = [
+            ctypes.c_int, ctypes.c_int, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_longlong,
+        ]
+        _declared = True
+    return lib
+
+
+def supported(op_name: str, dtype) -> bool:
+    if not _enable.value or op_name not in _OPS:
+        return False
+    dt = np.dtype(dtype)
+    if dt not in _DTYPES:
+        return False
+    if op_name in ("band", "bor", "bxor") and dt.kind == "f":
+        return False
+    return _lib() is not None
+
+
+def reduce(op_name: str, a: np.ndarray, b: np.ndarray
+           ) -> Optional[np.ndarray]:
+    """out = a op b elementwise via the native kernel, or None when the
+    combination is unsupported (caller falls back to numpy)."""
+    if a.shape != b.shape or a.dtype != b.dtype:
+        return None
+    if not supported(op_name, a.dtype):
+        return None
+    lib = _lib()
+    out = np.ascontiguousarray(a).copy()
+    bc = np.ascontiguousarray(b)
+    rc = lib.op_reduce(
+        _OPS[op_name], _DTYPES[a.dtype], out.ctypes.data,
+        bc.ctypes.data, out.size,
+    )
+    if rc != 0:
+        return None
+    from ..core.counters import SPC
+
+    SPC.record("op_native_reductions")
+    return out

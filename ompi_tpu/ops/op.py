@@ -83,8 +83,18 @@ class Op:
         return self._identity is not None
 
     def np_reduce(self, a: np.ndarray, b: np.ndarray) -> np.ndarray:
-        """Host-side (numpy) combine — used by the datatype engine's
-        reduce_local host path and by tests as the reference oracle."""
+        """Host-side combine — used by the datatype engine's
+        reduce_local host path, the coll/basic oracle and the DCN
+        staging path. Tiered like the reference's op dispatch
+        (op_avx_functions.c): native vectorized kernel when the
+        (op, dtype) pair supports it, else numpy."""
+        if self.predefined and isinstance(a, np.ndarray) \
+                and isinstance(b, np.ndarray):
+            from . import native_op
+
+            out = native_op.reduce(self.name, a, b)
+            if out is not None:
+                return out
         if self._np_combine is not None:
             return self._np_combine(a, b)
         return np.asarray(self._combine(a, b))

@@ -23,7 +23,7 @@ _registered = False
 def ensure_components() -> None:
     global _registered
     if not _registered:
-        from . import ob1  # noqa: F401 - self-registers
+        from . import mtl, ob1  # noqa: F401 - self-register
 
         _registered = True
 

@@ -1,0 +1,141 @@
+"""MTL framework + pml/cm — matching offloaded to the transport.
+
+TPU-native equivalent of ompi/mca/mtl + pml/cm (reference: mtl.h:418-421
+mtl_send/isend/irecv/iprobe for NICs with native MPI matching — ofi,
+psm2, portals4; pml/cm is the thin PML forwarding to the selected MTL;
+mutually exclusive with ob1, pml.h:40-47). The TPU analog of a
+"matching-capable fabric" is the XLA runtime itself: inside one driver
+program, issue order IS match order, so the mtl/fabric component's
+matching is the program order of device transfers — no unexpected
+queue, no rendezvous protocol, which is exactly why cm exists as a
+separate, thinner PML in the reference.
+
+Select with ``--mca pml cm`` (config: ``pml_select=cm``); ob1 remains
+the default because wildcard/out-of-order matching needs its queues.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..core import component as mca
+from ..core.counters import SPC
+from ..core.errors import CommError, RankError, TagError
+from ..core.request import CompletedRequest, Request, Status
+from .framework import PML, PmlComponent
+
+MTL = mca.framework("mtl", "matching transport layer")
+
+
+class MtlComponent(mca.Component):
+    """Interface: send/recv with transport-native matching."""
+
+    def send(self, comm, value, src: int, dst: int, tag: int) -> Any:
+        raise NotImplementedError
+
+
+@MTL.register
+class FabricMtl(MtlComponent):
+    """Matching by program order over the device fabric: the transfer
+    is dispatched immediately (XLA async), so 'matching' reduces to the
+    driver's issue order — the property hardware-matching NICs provide
+    and cm relies on."""
+
+    NAME = "fabric"
+    PRIORITY = 10
+    DESCRIPTION = "program-order matching over device transfers"
+
+    def send(self, comm, value, src: int, dst: int, tag: int) -> Any:
+        import jax
+
+        return jax.device_put(value, comm.devices[dst])
+
+
+@PML.register
+class CmPml(PmlComponent):
+    """Thin PML over the MTL (reference: pml/cm). In-order, no
+    wildcards: each recv completes the oldest same-(src,dst,tag) send.
+    """
+
+    NAME = "cm"
+    PRIORITY = 5  # ob1 (higher) wins unless explicitly selected
+    DESCRIPTION = "thin PML over matching transport (reference pml/cm)"
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        self._mtl: Optional[MtlComponent] = None
+        self._queues: dict[tuple, list] = {}
+
+    @property
+    def mtl(self) -> MtlComponent:
+        if self._mtl is None:
+            self._mtl = MTL.select_one()
+        return self._mtl
+
+    def _infer_source(self, comm, value, source):
+        if source is not None:
+            return comm.check_rank(source)
+        import jax
+
+        leaves = jax.tree.leaves(value)
+        if leaves and hasattr(leaves[0], "devices"):
+            devs = list(leaves[0].devices())
+            if len(devs) == 1 and devs[0] in comm.devices:
+                return comm.devices.index(devs[0])
+        return 0
+
+    def isend(self, comm, value, dest: int, tag: int,
+              source=None) -> Request:
+        if tag < 0:
+            raise TagError(f"send tag must be >= 0, got {tag}")
+        src = self._infer_source(comm, value, source)
+        moved = self.mtl.send(comm, value, src, dest, tag)
+        key = (comm.cid, src, dest, tag)
+        self._queues.setdefault(key, []).append(moved)
+        SPC.record("pml_cm_sends")
+        return CompletedRequest(
+            moved, Status(source=src, tag=tag)
+        )
+
+    def send(self, comm, value, dest: int, tag: int, source=None):
+        return self.isend(comm, value, dest, tag, source=source)
+
+    def irecv(self, comm, source: int, tag: int,
+              dest: Optional[int] = None) -> Request:
+        if dest is None:
+            raise RankError("driver-mode recv needs dest=")
+        if source < 0 or tag < 0:
+            raise CommError(
+                "pml/cm has no wildcard matching (the queues that "
+                "implement MPI_ANY_SOURCE live in ob1); select pml ob1"
+            )
+        key = (comm.cid, comm.check_rank(source),
+               comm.check_rank(dest), tag)
+        q = self._queues.get(key)
+        if not q:
+            raise CommError(
+                f"pml/cm: no in-flight send for {key}; cm matches "
+                "strictly in program order (send must precede recv)"
+            )
+        moved = q.pop(0)
+        SPC.record("pml_cm_recvs")
+        return CompletedRequest(moved, Status(source=source, tag=tag))
+
+    def recv(self, comm, source: int, tag: int, dest=None):
+        return self.irecv(comm, source, tag, dest=dest).result()
+
+    def probe(self, comm, source: int, tag: int, *, dest=None,
+              blocking: bool = True):
+        if source < 0 or tag < 0 or dest is None:
+            return None
+        key = (comm.cid, comm.check_rank(source),
+               comm.check_rank(dest), tag)
+        q = self._queues.get(key)
+        if q:
+            return Status(source=source, tag=tag)
+        return None
+
+    def comm_freed(self, comm) -> None:
+        self._queues = {
+            k: v for k, v in self._queues.items() if k[0] != comm.cid
+        }

@@ -1,0 +1,83 @@
+"""MPI_T-style tool interface: cvars, pvars, categories.
+
+TPU-native equivalent of ompi/mpi/tool (reference: the MPI_T API over
+the mca_base_var registry (cvars, mca_base_var.c) and SPC/monitoring
+pvars (mca_base_pvar.c); ompi_spc.c exports counters as pvars). Tools
+use this module instead of reaching into internals:
+
+    from ompi_tpu.tools import mpit
+    for cv in mpit.cvar_list(): ...
+    h = mpit.pvar_session(); ...; h.read()
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..core import config, counters
+
+
+@dataclass
+class CvarInfo:
+    name: str
+    value: Any
+    default: Any
+    type: str
+    source: str
+    description: str
+
+
+def cvar_list(prefix: str = "") -> list[CvarInfo]:
+    """Enumerate control variables (every registered config var)."""
+    out = []
+    for var in config.VARS.all_vars():
+        if prefix and not var.full_name.startswith(prefix):
+            continue
+        out.append(
+            CvarInfo(
+                name=var.full_name,
+                value=var.value,
+                default=var.default,
+                type=var.type.__name__,
+                source=var.source.name,
+                description=var.description,
+            )
+        )
+    return sorted(out, key=lambda c: c.name)
+
+
+def cvar_read(name: str) -> Any:
+    return config.get(name)
+
+
+def cvar_write(name: str, value: Any) -> None:
+    """MPI_T_cvar_write: runtime override (the OVERRIDE source)."""
+    config.set(name, value)
+
+
+def pvar_list(prefix: str = "") -> list[dict]:
+    """Enumerate performance variables (the SPC registry)."""
+    return [
+        d for d in counters.SPC.dump()
+        if not prefix or d["name"].startswith(prefix)
+    ]
+
+
+def pvar_read(name: str) -> float:
+    return counters.SPC.snapshot().get(name, 0.0)
+
+
+def pvar_session() -> counters.PvarSession:
+    """A pvar session: reads are deltas since session start (MPI_T
+    pvar handle semantics — each tool sees its own baseline)."""
+    return counters.PvarSession()
+
+
+def categories() -> dict[str, list[str]]:
+    """Group cvars by framework (MPI_T categories = MCA frameworks)."""
+    cats: dict[str, list[str]] = {}
+    for cv in cvar_list():
+        fw = cv.name.split("_", 1)[0]
+        cats.setdefault(fw, []).append(cv.name)
+    return cats

@@ -1,0 +1,181 @@
+"""Native op kernels, mtl/cm PML, debuggers (MPIR), MPI_T facade."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core import config
+from ompi_tpu.core.counters import SPC
+from ompi_tpu.core.errors import CommError
+from ompi_tpu.ops import lookup, native_op
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+# -- native op kernels -----------------------------------------------------
+
+@pytest.mark.parametrize("opname,dtype", [
+    ("sum", np.float32), ("prod", np.float64), ("max", np.int32),
+    ("min", np.int64), ("band", np.int32), ("bor", np.uint8),
+    ("bxor", np.int64), ("land", np.int32), ("lor", np.float32),
+])
+def test_native_matches_numpy(opname, dtype):
+    if not native_op.supported(opname, dtype):
+        pytest.skip(f"native {opname}/{dtype} unsupported")
+    rng = np.random.RandomState(1)
+    a = (rng.randint(0, 7, 64)).astype(dtype)
+    b = (rng.randint(0, 7, 64)).astype(dtype)
+    got = native_op.reduce(opname, a, b)
+    op = lookup(opname)
+    # oracle: the op's pure-numpy combine
+    want = op._np_combine(a.copy(), b)
+    np.testing.assert_array_equal(got, want)
+
+
+def test_native_rejects_float_bitwise():
+    assert not native_op.supported("band", np.float32)
+    assert native_op.reduce(
+        "band", np.ones(2, np.float32), np.ones(2, np.float32)
+    ) is None
+
+
+def test_np_reduce_uses_native_tier():
+    before = SPC.snapshot().get("op_native_reductions", 0)
+    out = lookup("sum").np_reduce(
+        np.arange(8, dtype=np.float32), np.ones(8, np.float32)
+    )
+    np.testing.assert_array_equal(out, np.arange(8) + 1)
+    assert SPC.snapshot().get("op_native_reductions", 0) > before
+
+
+def test_native_disable_falls_back():
+    config.set("op_native_enable", False)
+    try:
+        before = SPC.snapshot().get("op_native_reductions", 0)
+        lookup("sum").np_reduce(
+            np.ones(4, np.float32), np.ones(4, np.float32)
+        )
+        assert SPC.snapshot().get("op_native_reductions", 0) == before
+    finally:
+        config.set("op_native_enable", True)
+
+
+# -- mtl / pml cm ----------------------------------------------------------
+
+@pytest.fixture
+def cm_comm(comm):
+    from ompi_tpu.pml import framework as pml_fw
+
+    config.set("pml_select", "cm")
+    pml_fw.reset_selection()
+    c = comm.dup()
+    yield c
+    config.set("pml_select", "")
+    pml_fw.reset_selection()
+
+
+def test_cm_in_order_send_recv(cm_comm):
+    c = cm_comm
+    assert c.pml.NAME == "cm"
+    c.rank(0).send(np.float32(3.5), dest=1, tag=4)
+    got = c.rank(1).recv(source=0, tag=4)
+    assert float(got) == 3.5
+    assert list(got.devices())[0] == c.devices[1]
+
+
+def test_cm_fifo_per_channel(cm_comm):
+    c = cm_comm
+    for i in range(3):
+        c.rank(0).send(np.float32(i), dest=2, tag=9)
+    got = [float(c.rank(2).recv(source=0, tag=9)) for _ in range(3)]
+    assert got == [0.0, 1.0, 2.0]
+
+
+def test_cm_rejects_wildcards(cm_comm):
+    c = cm_comm
+    c.rank(0).send(np.float32(1.0), dest=1, tag=1)
+    with pytest.raises(CommError):
+        c.rank(1).recv(source=-1, tag=1)
+    with pytest.raises(CommError):
+        c.rank(1).recv(source=0, tag=2)  # nothing in flight on tag 2
+    c.rank(1).recv(source=0, tag=1)
+
+
+def test_cm_probe(cm_comm):
+    c = cm_comm
+    assert c.rank(1).iprobe(source=0, tag=5) is None
+    c.rank(0).send(np.float32(1.0), dest=1, tag=5)
+    st = c.rank(1).iprobe(source=0, tag=5)
+    assert st is not None and st.source == 0
+    c.rank(1).recv(source=0, tag=5)
+
+
+# -- debuggers (MPIR) ------------------------------------------------------
+
+def test_proctable(comm):
+    from ompi_tpu import debuggers
+
+    pt = debuggers.build_proctable(comm)
+    assert len(pt.entries) == comm.size
+    assert not pt.being_debugged
+    import os
+
+    for e in pt.entries:
+        assert e.pid == os.getpid()
+        assert e.platform in ("cpu", "tpu")
+
+
+def test_debug_gate(monkeypatch):
+    from ompi_tpu import debuggers
+
+    # not gated by default
+    assert debuggers.wait_for_debugger() is False
+    monkeypatch.setenv(debuggers.WAIT_ENV, "1")
+    monkeypatch.setenv(debuggers.GATE_ENV, "1")  # already released
+    assert debuggers.wait_for_debugger() is True
+
+
+# -- MPI_T facade ----------------------------------------------------------
+
+def test_cvar_enumeration_and_rw():
+    from ompi_tpu.tools import mpit
+
+    cvars = mpit.cvar_list("coll")
+    assert any(c.name == "coll_select" for c in cvars)
+    mpit.cvar_write("coll_select", "xla")
+    try:
+        assert mpit.cvar_read("coll_select") == "xla"
+        cv = [c for c in mpit.cvar_list("coll_select")][0]
+        assert cv.source == "API"
+    finally:
+        mpit.cvar_write("coll_select", "")
+
+
+def test_pvar_session_deltas(comm):
+    from ompi_tpu.tools import mpit
+
+    sess = mpit.pvar_session()
+    c = comm.dup()
+    c.rank(0).send(np.float32(1.0), dest=1, tag=1)
+    c.rank(1).recv(source=0, tag=1)
+    deltas = sess.read()
+    assert deltas.get("pml_isend_calls", 0) >= 1
+    assert mpit.pvar_read("pml_isend_calls") >= deltas["pml_isend_calls"]
+
+
+def test_categories():
+    from ompi_tpu.tools import mpit
+
+    cats = mpit.categories()
+    for fw in ("coll", "pml", "btl"):
+        assert fw in cats
