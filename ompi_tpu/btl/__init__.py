@@ -1,6 +1,6 @@
 """Inter-device transfer layer (reference: opal/mca/btl)."""
 
 from .framework import BTL, Bml, BtlComponent
-from . import dcn  # noqa: F401 - registers btl/dcn
+from . import dcn, template  # noqa: F401 - register btl/dcn, btl/template
 
-__all__ = ["BTL", "Bml", "BtlComponent", "dcn"]
+__all__ = ["BTL", "Bml", "BtlComponent", "dcn", "template"]

@@ -1,0 +1,52 @@
+"""btl/template — scaffold + test-double transport.
+
+TPU-native equivalent of opal/mca/btl/template (reference: the scaffold
+for writing a new BTL, 1,436 LoC of commented stubs) crossed with the
+reference test strategy of using scaffolds as mocks (SURVEY §4). Copy
+this file to start a new transport; registered but disabled by default
+(priority 0, available() False unless the test flag is set). When
+enabled it records every transfer so tests can assert on traffic.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import config
+from .framework import BTL, BtlComponent
+
+_enable = config.register(
+    "btl", "template", "enable", type=bool, default=False,
+    description="Enable the template/test-double BTL",
+)
+
+
+@BTL.register
+class TemplateBtl(BtlComponent):
+    NAME = "template"
+    PRIORITY = 0
+    EAGER_LIMIT = 4 * 1024
+    DESCRIPTION = "scaffold transport (test double)"
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        #: every transfer as (src_device, dst_device, nbytes)
+        self.transfers: list[tuple] = []
+
+    def available(self, **ctx: Any) -> bool:
+        return _enable.value
+
+    def can_reach(self, src_proc, dst_proc) -> bool:
+        # reach everything — tests drive exact routing through config
+        return True
+
+    def transfer(self, value, src_proc, dst_proc):
+        import jax
+
+        nbytes = sum(
+            getattr(l, "nbytes", 0) for l in jax.tree.leaves(value)
+        )
+        self.transfers.append(
+            (str(src_proc.device), str(dst_proc.device), nbytes)
+        )
+        return jax.device_put(value, dst_proc.device)

@@ -1,0 +1,52 @@
+"""coll/demo — scaffold + test-double collective component.
+
+TPU-native equivalent of ompi/mca/coll/demo (reference: a scaffold
+component that logs and forwards; the reference's test strategy uses
+such scaffolds as mocks, SURVEY §4). Disabled unless selected; when
+active it records each operation then delegates to the host-staged
+basic algorithms, letting tests observe the per-comm selection and
+call flow without faking a fabric.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import config
+from .basic import BasicColl
+from .framework import COLL
+
+_enable = config.register(
+    "coll", "demo", "enable", type=bool, default=False,
+    description="Enable the demo/test-double coll component",
+)
+
+
+@COLL.register
+class DemoColl(BasicColl):
+    NAME = "demo"
+    PRIORITY = 0
+    DESCRIPTION = "scaffold collective component (test double)"
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        #: (operation, comm name) per dispatched call
+        self.calls: list[tuple[str, str]] = []
+
+    def available(self, **ctx: Any) -> bool:
+        return _enable.value
+
+    def _record(self, opname: str, comm) -> None:
+        self.calls.append((opname, comm.name))
+
+    def allreduce(self, comm, x, op):
+        self._record("allreduce", comm)
+        return super().allreduce(comm, x, op)
+
+    def bcast(self, comm, x, root):
+        self._record("bcast", comm)
+        return super().bcast(comm, x, root)
+
+    def barrier(self, comm):
+        self._record("barrier", comm)
+        return super().barrier(comm)

@@ -237,7 +237,14 @@ class PersistentColl(Request):
 
 def register_components() -> None:
     """Import all in-tree coll components so they self-register."""
-    from . import basic, pallas_ring, selfcoll, tuned, xla  # noqa: F401
+    from . import (  # noqa: F401
+        basic,
+        demo,
+        pallas_ring,
+        selfcoll,
+        tuned,
+        xla,
+    )
 
 
 _registered = False

@@ -1,0 +1,48 @@
+"""Extensions — the mpiext mechanism's TPU-native forms.
+
+TPU-native equivalent of ompi/mpiext (reference: affinity — rank
+binding report; cuda — MPIX_Query_cuda_support; pcollreq — persistent
+collectives; shortfloat — half-precision types). Each extension maps to
+its platform-native answer:
+
+- `query_device_support()` ≈ MPIX_Query_cuda_support: are collectives
+  operating on device-resident (TPU/accelerator) buffers?
+- `affinity_str(comm)` ≈ MPIX_Affinity_str: per-rank placement report
+  (device, platform, host process, ICI coords).
+- persistent collectives (pcollreq) live on the communicator
+  (`allreduce_init` / `bcast_init`).
+- shortfloat ≈ bfloat16/float16 datatypes, first-class in the dtype
+  table (the MXU's native precision — better than the reference's
+  add-on short floats).
+"""
+
+from __future__ import annotations
+
+
+def query_device_support() -> bool:
+    """True when rank buffers live on accelerator devices (the
+    MPIX_Query_cuda_support analog: 'can I pass device pointers?' —
+    here device arrays are the native currency, so this is False only
+    on CPU-emulated meshes)."""
+    from . import api
+
+    comm = api.world()
+    return any(p.platform == "tpu" for p in comm.procs)
+
+
+def affinity_str(comm=None) -> str:
+    """Per-rank placement table (reference: mpiext/affinity's
+    OMPI_Affinity_str)."""
+    from . import api
+
+    comm = comm or api.world()
+    lines = []
+    for r, proc in enumerate(comm.procs):
+        dev = proc.device
+        coords = getattr(dev, "coords", None)
+        lines.append(
+            f"rank {r}: device={dev} platform={proc.platform} "
+            f"process={proc.process_index}"
+            + (f" coords={tuple(coords)}" if coords else "")
+        )
+    return "\n".join(lines)

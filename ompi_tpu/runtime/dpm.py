@@ -87,6 +87,17 @@ def unpublish_name(service: str) -> None:
             pass
 
 
+def _tile(value, n: int):
+    """Host-stage a single block into an n-rank rank-major buffer."""
+    import jax
+    import numpy as np
+
+    arr = np.asarray(jax.device_get(value))
+    return np.ascontiguousarray(
+        np.broadcast_to(arr, (n,) + arr.shape)
+    )
+
+
 class Intercomm:
     """An intercommunicator: two disjoint groups with p2p across them
     (reference: ompi's intercomm support in comm.c + dpm)."""
@@ -131,6 +142,63 @@ class Intercomm:
         if self._merged_cache is None:
             self._merged_cache = self.merge()
         return self._merged_cache
+
+    # -- inter-communicator collectives (reference: ompi/mca/coll/inter:
+    # each group's contribution goes to the OTHER group, MPI 3.1 §5.2.2)
+
+    def bcast(self, value, root: int = 0):
+        """Root in the local group broadcasts to every rank of the
+        remote group; returns the remote-side rank-major buffer."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        arr = np.asarray(jax.device_get(value))
+        out = np.broadcast_to(arr, (self.remote_size,) + arr.shape)
+        return self.remote_comm.put_rank_major(np.ascontiguousarray(out))
+
+    def allreduce(self, local_x, remote_x, op="sum"):
+        """Each group's rank-major buffer is reduced and delivered to
+        the other group: returns (local_result_of_remote_data,
+        remote_result_of_local_data)."""
+        import jax
+
+        red_local = self.local_comm.reduce(local_x, op=op, root=0)
+        red_remote = self.remote_comm.reduce(remote_x, op=op, root=0)
+        to_local = self.local_comm.bcast(
+            self.local_comm.put_rank_major(
+                _tile(red_remote, self.local_size)
+            ),
+            root=0,
+        )
+        to_remote = self.remote_comm.bcast(
+            self.remote_comm.put_rank_major(
+                _tile(red_local, self.remote_size)
+            ),
+            root=0,
+        )
+        return to_local, to_remote
+
+    def allgather(self, local_x, remote_x):
+        """Each side receives the concatenation of the OTHER side's
+        per-rank blocks (rank-major in the receiving comm)."""
+        import numpy as np
+
+        lh = np.asarray(local_x)
+        rh = np.asarray(remote_x)
+        to_local = np.broadcast_to(rh, (self.local_size,) + rh.shape)
+        to_remote = np.broadcast_to(lh, (self.remote_size,) + lh.shape)
+        return (
+            self.local_comm.put_rank_major(
+                np.ascontiguousarray(to_local)
+            ),
+            self.remote_comm.put_rank_major(
+                np.ascontiguousarray(to_remote)
+            ),
+        )
+
+    def barrier(self) -> None:
+        self._merged().barrier()
 
     def merge(self, high: bool = False):
         """MPI_Intercomm_merge: one intracommunicator over both groups;

@@ -1,0 +1,123 @@
+"""Intercomm collectives, scaffold components, mpiext analogs."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core import config
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+@pytest.fixture
+def inter(comm):
+    from ompi_tpu.runtime import dpm
+
+    if comm.size < 4:
+        pytest.skip("needs >= 4 ranks")
+    a = comm.create(mt.Group([0, 1]))
+    b = comm.create(mt.Group([2, 3]))
+    return dpm.Intercomm(a, b)
+
+
+def test_inter_bcast(inter):
+    out = inter.bcast(np.arange(3, dtype=np.float32), root=0)
+    arr = np.asarray(out)
+    assert arr.shape == (inter.remote_size, 3)
+    for r in range(inter.remote_size):
+        np.testing.assert_array_equal(arr[r], np.arange(3))
+
+
+def test_inter_allreduce_crosses_groups(inter):
+    lx = inter.local_comm.put_rank_major(
+        np.ones((inter.local_size, 2), np.float32)
+    )
+    rx = inter.remote_comm.put_rank_major(
+        np.full((inter.remote_size, 2), 10, np.float32)
+    )
+    to_local, to_remote = inter.allreduce(lx, rx)
+    # local group receives the REMOTE group's reduction and vice versa
+    np.testing.assert_array_equal(
+        np.asarray(to_local)[0], np.full(2, 10 * inter.remote_size)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(to_remote)[0], np.full(2, inter.local_size)
+    )
+
+
+def test_inter_allgather(inter):
+    lx = np.stack(
+        [np.full(2, r, np.float32) for r in range(inter.local_size)]
+    )
+    rx = np.stack(
+        [np.full(2, 100 + r, np.float32)
+         for r in range(inter.remote_size)]
+    )
+    to_local, to_remote = inter.allgather(lx, rx)
+    assert np.asarray(to_local).shape == (
+        inter.local_size, inter.remote_size, 2
+    )
+    np.testing.assert_array_equal(np.asarray(to_local)[0], rx)
+    np.testing.assert_array_equal(np.asarray(to_remote)[1], lx)
+    inter.barrier()
+
+
+# -- scaffolds as test doubles ---------------------------------------------
+
+def test_demo_coll_records_calls(comm):
+    config.set("coll_demo_enable", True)
+    config.set("coll_select", "demo")
+    try:
+        c = comm.dup()
+        demo = c._coll["allreduce"][0]
+        assert demo.NAME == "demo"
+        c.allreduce(c.put_rank_major(
+            np.ones((c.size, 2), np.float32)
+        ))
+        c.barrier()
+        ops = [op for op, _ in demo.calls]
+        assert "allreduce" in ops and "barrier" in ops
+    finally:
+        config.set("coll_select", "")
+        config.set("coll_demo_enable", False)
+
+
+def test_template_btl_records_transfers(comm):
+    import ompi_tpu.btl  # registers btl components + their config vars
+    from ompi_tpu.pml import framework as pml_fw
+
+    config.set("btl_template_enable", True)
+    config.set("btl_select", "template")
+    pml_fw.reset_selection()
+    try:
+        c = comm.dup()
+        c.rank(0).send(np.ones(4, np.float32), dest=1, tag=1)
+        c.rank(1).recv(source=0, tag=1)
+        tmpl = c.pml.bml(c).btl_for(0, 1)
+        assert tmpl.NAME == "template"
+        assert tmpl.transfers and tmpl.transfers[0][2] == 16
+    finally:
+        config.set("btl_select", "")
+        config.set("btl_template_enable", False)
+        pml_fw.reset_selection()
+
+
+# -- mpiext ----------------------------------------------------------------
+
+def test_mpiext(comm):
+    from ompi_tpu import mpiext
+
+    assert isinstance(mpiext.query_device_support(), bool)
+    text = mpiext.affinity_str(comm)
+    assert text.count("rank ") == comm.size
+    assert "platform=" in text
