@@ -1,0 +1,207 @@
+"""Hierarchical cross-slice collectives: ICI inside, DCN between.
+
+TPU-native equivalent of the reference's two-level pattern (reference:
+coll/sm intra-node + tuned inter-node selection, SURVEY §2.6
+"Hierarchical/topology-aware"; SURVEY §7 step 7: "hierarchical
+collectives (intra-slice ICI reduce → inter-slice exchange → ICI
+bcast)"). The three phases:
+
+1. **intra-slice reduce** on the slice's communicator — device-resident,
+   MXU/VPU combine (the coll/sm analog, but on the fabric);
+2. **inter-slice exchange** among slice leaders over DCN — staged
+   through the host pool, combined with the native op kernels
+   (ring or recursive-doubling schedule over the wire);
+3. **intra-slice bcast** of the global result back over ICI.
+
+`SliceHandle` carries one slice's view (its communicator + DCN endpoint
++ peer wiring). In production each controller process holds one handle;
+tests hold several in one process (the reference's
+multi-rank-over-loopback strategy, SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+from ..ops import lookup as op_lookup
+
+logger = get_logger("coll.hier")
+
+_HIER_TAG = 0x48494552  # "HIER"
+
+
+class HierError(OmpiTpuError):
+    errclass = "ERR_OTHER"
+
+
+@dataclass
+class SliceHandle:
+    """One slice's participation in a hierarchical collective."""
+
+    comm: object  # intra-slice communicator
+    endpoint: object  # DcnEndpoint (leader's listener)
+    slice_id: int
+    n_slices: int
+    peer_ids: dict  # slice_id -> DCN peer id (leader wiring)
+
+    def __post_init__(self):
+        # (src_slice, tag) -> payloads that arrived out of order: a
+        # fast peer's round-k+1 message can land before a slow peer's
+        # round-k one (the reason ob1 has matching queues)
+        self._reorder: dict = {}
+
+    def wire_check(self) -> None:
+        missing = [
+            s for s in range(self.n_slices)
+            if s != self.slice_id and s not in self.peer_ids
+        ]
+        if missing:
+            raise HierError(
+                f"slice {self.slice_id}: unwired peers {missing}"
+            )
+
+    def recv_from(self, src_slice: int, tag: int,
+                  timeout: float) -> bytes:
+        """Receive the message from `src_slice` with `tag`, buffering
+        any other traffic (wire convention: connect cookie is
+        slice_id+1, so a passive link's peer id is -(src_slice+1))."""
+        key = (src_slice, tag)
+        q = self._reorder.get(key)
+        if q:
+            return q.pop(0)
+        deadline = time.monotonic() + timeout
+        while True:
+            peer, got_tag, raw = self.endpoint.recv_bytes(
+                timeout=max(0.0, deadline - time.monotonic())
+            )
+            src = -peer - 1 if peer < 0 else None
+            if src is None:
+                raise HierError(
+                    f"slice {self.slice_id}: message on active link "
+                    f"(peer {peer}); hier traffic must arrive passively"
+                )
+            if (src, got_tag) == key:
+                return raw
+            self._reorder.setdefault((src, got_tag), []).append(raw)
+
+
+def _exchange_ring(h: SliceHandle, block: np.ndarray, op,
+                   timeout: float) -> np.ndarray:
+    """Inter-slice reduce via a ring over DCN: n-1 rounds, each slice
+    forwards the partial to the next slice (reference:
+    allreduce_intra_ring's structure, over the wire). Used when the
+    slice count is not a power of two."""
+    acc = block.copy()
+    right = (h.slice_id + 1) % h.n_slices
+    left = (h.slice_id - 1) % h.n_slices
+    for rnd in range(h.n_slices - 1):
+        h.endpoint.send_bytes(
+            h.peer_ids[right], _HIER_TAG + rnd, acc.tobytes()
+        )
+        raw = h.recv_from(left, _HIER_TAG + rnd, timeout)
+        incoming = np.frombuffer(raw, block.dtype).reshape(block.shape)
+        acc = op.np_reduce(acc, incoming)
+    return acc
+
+
+def _exchange_rd(h: SliceHandle, block: np.ndarray, op,
+                 timeout: float) -> np.ndarray:
+    """Recursive doubling over DCN (reference:
+    allreduce_intra_recursivedoubling) — log2(n) rounds for
+    power-of-two slice counts."""
+    acc = block.copy()
+    dist = 1
+    rnd = 0
+    while dist < h.n_slices:
+        partner = h.slice_id ^ dist
+        h.endpoint.send_bytes(
+            h.peer_ids[partner], _HIER_TAG + rnd, acc.tobytes()
+        )
+        raw = h.recv_from(partner, _HIER_TAG + rnd, timeout)
+        incoming = np.frombuffer(raw, block.dtype).reshape(block.shape)
+        acc = op.np_reduce(acc, incoming)
+        dist <<= 1
+        rnd += 1
+    return acc
+
+
+def allreduce(h: SliceHandle, x, op="sum", *, timeout: float = 30.0,
+              schedule: Optional[str] = None):
+    """Hierarchical allreduce of a rank-major intra-slice buffer. In
+    production each controller process drives its own handle; tests
+    drive several handles on threads (endpoints are thread-safe)."""
+    partial = phase1_local_reduce(h, x, op)
+    global_block = phase2_exchange(
+        h, partial, op, timeout=timeout, schedule=schedule
+    )
+    return phase3_local_bcast(h, global_block)
+
+
+def phase1_local_reduce(h: SliceHandle, x, op="sum") -> np.ndarray:
+    op = op_lookup(op)
+    red = h.comm.reduce(x, op=op.name if op.predefined else op, root=0)
+    import jax
+
+    SPC.record("hier_local_reduce")
+    return np.asarray(jax.device_get(red))
+
+
+def phase2_exchange(h: SliceHandle, partial: np.ndarray, op="sum", *,
+                    timeout: float = 30.0,
+                    schedule: Optional[str] = None) -> np.ndarray:
+    """Inter-slice combine. Schedule: recursive doubling for
+    power-of-two slice counts, ring otherwise (the tuned-style
+    decision), overridable via `schedule` ('rd'|'ring')."""
+    op = op_lookup(op)
+    if h.n_slices == 1:
+        return partial
+    h.wire_check()
+    if schedule is None:
+        schedule = (
+            "rd" if h.n_slices & (h.n_slices - 1) == 0 else "ring"
+        )
+    if schedule == "rd":
+        if h.n_slices & (h.n_slices - 1):
+            raise HierError(
+                "recursive doubling needs a power-of-two slice count"
+            )
+        out = _exchange_rd(h, partial, op, timeout)
+    elif schedule == "ring":
+        out = _exchange_ring(h, partial, op, timeout)
+    else:
+        raise HierError(f"unknown schedule {schedule!r}")
+    SPC.record("hier_dcn_exchanges")
+    return out
+
+
+def phase3_local_bcast(h: SliceHandle, global_block: np.ndarray):
+    buf = h.comm.put_rank_major(
+        np.ascontiguousarray(
+            np.broadcast_to(
+                global_block, (h.comm.size,) + global_block.shape
+            )
+        )
+    )
+    SPC.record("hier_local_bcast")
+    return h.comm.bcast(buf, root=0)
+
+
+def wire_slices(handles: list[SliceHandle], *, nlinks: int = 1) -> None:
+    """Test/loopback wiring: connect every handle's endpoint to every
+    other (production uses modex.exchange_dcn_addresses + connect)."""
+    for a in handles:
+        for b in handles:
+            if a.slice_id == b.slice_id:
+                continue
+            if b.slice_id not in a.peer_ids:
+                a.peer_ids[b.slice_id] = a.endpoint.connect(
+                    b.endpoint.address[0], b.endpoint.address[1],
+                    cookie=a.slice_id + 1, nlinks=nlinks,
+                )

@@ -1,0 +1,111 @@
+"""Modex — business-card exchange between controller processes.
+
+TPU-native equivalent of the PMIx modex (reference:
+ompi_mpi_init.c:642-686 — PMIx_Commit + PMIx_Fence publishes each
+proc's transport addresses to the whole job before add_procs). Here
+each controller publishes its DCN listener address (and any other
+endpoint info) and reads its peers'. Backends:
+
+- jax.distributed's coordinator KV store when the job was initialized
+  multi-host (the PMIx-server analog; same process that wired the mesh),
+- an in-process table otherwise (single controller, tests).
+
+Values are dss-packed (`core/dss.py`), so the wire format matches the
+rest of the control plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ..core import dss
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+
+logger = get_logger("modex")
+
+_local: dict[str, bytes] = {}
+_lock = threading.Lock()
+
+_PREFIX = "ompi_tpu/modex/"
+
+
+class ModexError(OmpiTpuError):
+    errclass = "ERR_INTERN"
+
+
+def _kv_client():
+    """The jax.distributed coordinator KV client, or None."""
+    try:
+        from jax._src import distributed
+
+        state = distributed.global_state
+        return getattr(state, "client", None)
+    except Exception:
+        return None
+
+
+def put(key: str, value: Any) -> None:
+    """Publish this process's entry (PMIx_Put + Commit)."""
+    rec = dss.pack(value)
+    with _lock:
+        _local[key] = rec
+    client = _kv_client()
+    if client is not None:
+        # KV values must be strings; dss bytes hex-encode
+        client.key_value_set(_PREFIX + key, rec.hex())
+
+
+def get(key: str, timeout_s: float = 60.0) -> Any:
+    """Read an entry, blocking until the owner publishes it
+    (PMIx_Get semantics: the fence is implicit in the blocking get)."""
+    client = _kv_client()
+    if client is not None:
+        try:
+            raw = client.blocking_key_value_get(
+                _PREFIX + key, int(timeout_s * 1000)
+            )
+            return dss.unpack_one(bytes.fromhex(raw))
+        except Exception as exc:
+            raise ModexError(f"modex get({key!r}) failed: {exc}") from exc
+    with _lock:
+        rec = _local.get(key)
+    if rec is None:
+        raise ModexError(f"modex key {key!r} not published")
+    return dss.unpack_one(rec)
+
+
+def publish_dcn_address(endpoint, process_index: int) -> None:
+    """PMIx_Put + Commit of this process's DCN listener."""
+    put(f"dcn/{process_index}", {
+        "ip": endpoint.address[0], "port": endpoint.address[1],
+    })
+
+
+def collect_dcn_addresses(num_processes: int, timeout_s: float = 60.0
+                          ) -> dict[int, tuple[str, int]]:
+    """The fence+get side: everyone's listener addresses."""
+    out = {}
+    for idx in range(num_processes):
+        rec = get(f"dcn/{idx}", timeout_s=timeout_s)
+        out[idx] = (rec["ip"], rec["port"])
+    return out
+
+
+def exchange_dcn_addresses(endpoint, process_index: int,
+                           num_processes: int,
+                           timeout_s: float = 60.0
+                           ) -> dict[int, tuple[str, int]]:
+    """The btl/tcp modex (reference: PMIx_Commit + Fence,
+    ompi_mpi_init.c:642): publish our listener, collect everyone's.
+    With the coordinator KV backend the collect blocks until every
+    peer has published; the in-process backend requires all endpoints
+    published first (use publish + collect explicitly in tests)."""
+    publish_dcn_address(endpoint, process_index)
+    return collect_dcn_addresses(num_processes, timeout_s=timeout_s)
+
+
+def clear_local() -> None:
+    with _lock:
+        _local.clear()

@@ -1,0 +1,208 @@
+"""Hierarchical ICI+DCN collectives and the modex exchange."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.native import build
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+def test_modex_inprocess_roundtrip():
+    from ompi_tpu.runtime import modex
+
+    modex.clear_local()
+    modex.put("dcn/0", {"ip": "127.0.0.1", "port": 1234})
+    got = modex.get("dcn/0")
+    assert got == {"ip": "127.0.0.1", "port": 1234}
+    with pytest.raises(modex.ModexError):
+        modex.get("dcn/99")
+    modex.clear_local()
+
+
+@pytest.mark.skipif(not build.available(), reason="no native library")
+def test_modex_dcn_exchange():
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.runtime import modex
+
+    modex.clear_local()
+    eps = [dcn.DcnEndpoint() for _ in range(3)]
+    try:
+        for i, ep in enumerate(eps):
+            modex.publish_dcn_address(ep, i)
+        tables = [modex.collect_dcn_addresses(3) for _ in eps]
+        for t in tables:
+            assert set(t) == {0, 1, 2}
+            for i, ep in enumerate(eps):
+                assert t[i] == ep.address
+    finally:
+        for ep in eps:
+            ep.close()
+        modex.clear_local()
+
+
+def _make_slices(comm, n_slices):
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.coll import hier
+
+    per = comm.size // n_slices
+    handles = []
+    for s in range(n_slices):
+        sub = comm.create(
+            mt.Group(range(s * per, (s + 1) * per))
+        )
+        handles.append(
+            hier.SliceHandle(
+                comm=sub,
+                endpoint=dcn.DcnEndpoint(),
+                slice_id=s,
+                n_slices=n_slices,
+                peer_ids={},
+            )
+        )
+    hier.wire_slices(handles)
+    return handles
+
+
+@pytest.mark.skipif(not build.available(), reason="no native library")
+@pytest.mark.parametrize("n_slices", [2, 4])
+def test_hier_allreduce_power_of_two(comm, n_slices):
+    from ompi_tpu.coll import hier
+
+    if comm.size % n_slices or comm.size < 2 * n_slices:
+        pytest.skip("rank count unsuitable")
+    handles = _make_slices(comm, n_slices)
+    try:
+        per = comm.size // n_slices
+        datas = [
+            np.stack([
+                np.full(4, s * per + r + 1, np.float32)
+                for r in range(per)
+            ])
+            for s in range(n_slices)
+        ]
+        expect = sum(d.sum(axis=0) for d in datas)
+        results = [None] * n_slices
+        errs = []
+
+        def run(i):
+            try:
+                h = handles[i]
+                x = h.comm.put_rank_major(datas[i])
+                results[i] = np.asarray(hier.allreduce(h, x))
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        threads = [
+            threading.Thread(target=run, args=(i,))
+            for i in range(n_slices)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errs, errs
+        for s in range(n_slices):
+            out = results[s]
+            assert out.shape == (comm.size // n_slices, 4)
+            for r in range(out.shape[0]):
+                np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+    finally:
+        for h in handles:
+            h.endpoint.close()
+
+
+@pytest.mark.skipif(not build.available(), reason="no native library")
+def test_hier_allreduce_ring_schedule(comm):
+    """The ring exchange path (used for non-power-of-two slice counts),
+    forced via schedule= on a 2-slice layout."""
+    from ompi_tpu.coll import hier
+
+    if comm.size % 2:
+        pytest.skip("needs even rank count")
+    handles = _make_slices(comm, 2)
+    try:
+        per = comm.size // 2
+        datas = [
+            np.stack([
+                np.full(3, 10 * s + r, np.float32) for r in range(per)
+            ])
+            for s in range(2)
+        ]
+        expect = sum(d.sum(axis=0) for d in datas)
+        results = [None] * 2
+        errs = []
+
+        def run(i):
+            try:
+                h = handles[i]
+                x = h.comm.put_rank_major(datas[i])
+                results[i] = np.asarray(
+                    hier.allreduce(h, x, schedule="ring")
+                )
+            except Exception as e:  # pragma: no cover
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=60)
+        assert not errs, errs
+        for s in range(2):
+            np.testing.assert_allclose(results[s][0], expect, rtol=1e-5)
+    finally:
+        for h in handles:
+            h.endpoint.close()
+
+
+@pytest.mark.skipif(not build.available(), reason="no native library")
+def test_hier_single_slice_no_wire(comm):
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.coll import hier
+
+    h = hier.SliceHandle(
+        comm=comm.dup(), endpoint=dcn.DcnEndpoint(),
+        slice_id=0, n_slices=1, peer_ids={},
+    )
+    try:
+        x = h.comm.put_rank_major(
+            np.ones((comm.size, 3), np.float32)
+        )
+        out = np.asarray(hier.allreduce(h, x))
+        np.testing.assert_allclose(
+            out[0], np.full(3, comm.size, np.float32)
+        )
+    finally:
+        h.endpoint.close()
+
+
+@pytest.mark.skipif(not build.available(), reason="no native library")
+def test_hier_unwired_raises(comm):
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.coll import hier
+
+    h = hier.SliceHandle(
+        comm=comm.dup(), endpoint=dcn.DcnEndpoint(),
+        slice_id=0, n_slices=2, peer_ids={},
+    )
+    try:
+        with pytest.raises(hier.HierError):
+            hier.phase2_exchange(
+                h, np.ones(2, np.float32), "sum", timeout=0.5
+            )
+    finally:
+        h.endpoint.close()
