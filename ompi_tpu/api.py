@@ -103,6 +103,12 @@ def finalize() -> None:
 
         run_hooks("at_finalize_top", _state.comm_world)
         try:
+            from .monitoring.monitoring import maybe_dump_at_finalize
+
+            maybe_dump_at_finalize()
+        except ImportError:
+            pass
+        try:
             from .io import fbtl as _fbtl
             from .io.file import live_files
 

@@ -242,6 +242,7 @@ def register_components() -> None:
         demo,
         pallas_ring,
         selfcoll,
+        sync,
         tuned,
         xla,
     )

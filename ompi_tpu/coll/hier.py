@@ -98,16 +98,20 @@ def _exchange_ring(h: SliceHandle, block: np.ndarray, op,
     forwards the partial to the next slice (reference:
     allreduce_intra_ring's structure, over the wire). Used when the
     slice count is not a power of two."""
+    # Circulate each slice's ORIGINAL block around the ring while
+    # accumulating separately — forwarding the accumulator instead
+    # double-counts contributions for n >= 3.
     acc = block.copy()
+    cur = block
     right = (h.slice_id + 1) % h.n_slices
     left = (h.slice_id - 1) % h.n_slices
     for rnd in range(h.n_slices - 1):
         h.endpoint.send_bytes(
-            h.peer_ids[right], _HIER_TAG + rnd, acc.tobytes()
+            h.peer_ids[right], _HIER_TAG + rnd, cur.tobytes()
         )
         raw = h.recv_from(left, _HIER_TAG + rnd, timeout)
-        incoming = np.frombuffer(raw, block.dtype).reshape(block.shape)
-        acc = op.np_reduce(acc, incoming)
+        cur = np.frombuffer(raw, block.dtype).reshape(block.shape)
+        acc = op.np_reduce(acc, cur)
     return acc
 
 

@@ -1,0 +1,77 @@
+"""coll/sync — periodic barrier injection for flow control.
+
+TPU-native equivalent of ompi/mca/coll/sync (reference: interposes on
+rooted collectives and injects a barrier every N calls so one-sided
+producers can't run unbounded ahead of consumers — the classic
+bcast-flood flow-control fix). On TPU the analog hazard is the async
+dispatch queue running far ahead of completion, ballooning live HBM
+buffers; the injected barrier bounds the pipeline depth.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..core import config
+from ..core.counters import SPC
+from .framework import COLL
+from .xla import XlaColl
+
+_enable = config.register(
+    "coll", "sync", "enable", type=bool, default=False,
+    description="Enable periodic-barrier flow control",
+)
+_period = config.register(
+    "coll", "sync", "barrier_before_nops", type=int, default=100,
+    description="Inject a barrier every N rooted collectives "
+    "(reference: coll_sync's barrier_before_nops)",
+)
+
+
+@COLL.register
+class SyncColl(XlaColl):
+    """XlaColl plus an injected barrier every N rooted ops. Selected
+    only when enabled; priority must top every data component (tuned
+    is 80) or the per-op merge silently bypasses the interposition."""
+
+    NAME = "sync"
+    PRIORITY = 90
+    DESCRIPTION = "periodic barrier injection (reference coll/sync)"
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        self._counts: dict[int, int] = {}
+
+    def available(self, **ctx: Any) -> bool:
+        return _enable.value
+
+    def _maybe_barrier(self, comm) -> None:
+        n = self._counts.get(comm.cid, 0) + 1
+        period = max(1, _period.value)
+        if n >= period:
+            n = 0
+            token = super().barrier(comm)
+            if token is not None:
+                import jax
+
+                jax.block_until_ready(token)
+            SPC.record("coll_sync_barriers")
+        self._counts[comm.cid] = n
+
+    # the reference interposes on the rooted ops (bcast/reduce/
+    # gather/scatter) — the ones that let a root run ahead
+    def bcast(self, comm, x, root):
+        self._maybe_barrier(comm)
+        return super().bcast(comm, x, root)
+
+    def reduce(self, comm, x, op, root):
+        self._maybe_barrier(comm)
+        return super().reduce(comm, x, op, root)
+
+    def gather(self, comm, x, root):
+        self._maybe_barrier(comm)
+        return super().gather(comm, x, root)
+
+    def scatter(self, comm, x, root):
+        self._maybe_barrier(comm)
+        return super().scatter(comm, x, root)

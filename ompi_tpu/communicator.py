@@ -333,6 +333,17 @@ class Communicator(HasAttributes, HasErrhandler):
 
         return PersistentColl(self, "bcast", (self.check_rank(root),), x)
 
+    # Persistent p2p (MPI_Send_init / MPI_Recv_init, reference pml.h:292
+    # `pml_isend_init`): binds the envelope once; each start() re-issues
+    # through the selected PML against the currently bound buffer.
+    def send_init(self, value, dest: int, tag: int = 0, *, source=None):
+        return PersistentSend(
+            self, value, self.check_rank(dest), tag, source
+        )
+
+    def recv_init(self, source: int = -1, tag: int = -1, *, dest: int):
+        return PersistentRecv(self, source, tag, dest)
+
     # -- p2p (delegated to the selected PML) ------------------------------
 
     @property
@@ -452,6 +463,77 @@ class Communicator(HasAttributes, HasErrhandler):
         return (
             f"<Communicator {self.name} cid={self.cid} size={self.size}>"
         )
+
+
+class _PersistentP2P:
+    """Shared machinery: a persistent request owning an inner active
+    request per start() (reference: ob1 persistent requests re-enter
+    the start path, pml_ob1_start.c)."""
+
+    def _poll(self) -> bool:
+        if self.done:
+            return True
+        inner = self._inner
+        if inner is not None and inner._poll():
+            self._complete(inner._result, inner.status)
+        return self.done
+
+    def wait(self, timeout: float | None = None):
+        from .core.request import RequestState
+
+        inner = self._inner
+        if inner is None or self.state == RequestState.INACTIVE:
+            # base wait: raises on inactive persistent requests
+            return _Request.wait(self, timeout)
+        if not self.done:
+            inner.wait(timeout)
+            self._poll()
+        if self.status.error is not None:
+            raise self.status.error
+        return self.status
+
+
+from .core.request import Request as _Request  # noqa: E402
+
+
+class PersistentSend(_PersistentP2P, _Request):
+    def __init__(self, comm, value, dest, tag, source) -> None:
+        super().__init__(persistent=True)
+        self._comm = comm
+        self.buffer = value
+        self._dest = dest
+        self._tag = tag
+        self._source = source
+        self._inner = None
+
+    def bind(self, value) -> None:
+        """Rebind the send buffer for the next start()."""
+        self.buffer = value
+
+    def _start(self) -> None:
+        self._inner = self._comm.isend(
+            self.buffer, self._dest, self._tag, source=self._source
+        )
+
+
+class PersistentRecv(_PersistentP2P, _Request):
+    def __init__(self, comm, source, tag, dest) -> None:
+        super().__init__(persistent=True)
+        self._comm = comm
+        self._source = source
+        self._tag = tag
+        self._dest = dest
+        self._inner = None
+
+    def _start(self) -> None:
+        self._inner = self._comm.irecv(
+            self._source, self._tag, dest=self._dest
+        )
+
+
+def start_all(requests) -> list:
+    """MPI_Startall."""
+    return [r.start() for r in requests]
 
 
 class RankEndpoint:

@@ -86,6 +86,31 @@ class AbortError(OmpiTpuError):
     errclass = "ERR_OTHER"
 
 
+# -- error classes/strings (MPI_Error_class / MPI_Error_string) ----------
+
+def error_class(exc: BaseException) -> str:
+    """MPI_Error_class analog: the ERR_* family of an exception."""
+    return getattr(exc, "errclass", "ERR_OTHER")
+
+
+def error_string(exc: BaseException) -> str:
+    """MPI_Error_string analog."""
+    return f"[{error_class(exc)}] {exc}"
+
+
+def known_error_classes() -> list[str]:
+    """Every ERR_* class used by framework exceptions."""
+    seen = set()
+
+    def walk(cls):
+        seen.add(cls.errclass)
+        for sub in cls.__subclasses__():
+            walk(sub)
+
+    walk(OmpiTpuError)
+    return sorted(seen)
+
+
 # -- errhandlers ---------------------------------------------------------
 
 ErrhandlerFn = Callable[[object, BaseException], None]

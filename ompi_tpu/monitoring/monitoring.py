@@ -28,6 +28,21 @@ _enabled = config.register(
     "monitoring", "base", "enable", type=bool, default=False,
     description="Record per-peer p2p/coll/osc traffic matrices",
 )
+_dump_at_finalize = config.register(
+    "monitoring", "base", "dump_at_finalize", type=bool, default=False,
+    description="Print the traffic summary at finalize (reference: "
+    "common_monitoring dumps at MPI_Finalize)",
+)
+
+
+def maybe_dump_at_finalize() -> None:
+    if _dump_at_finalize.value and MONITOR.enabled:
+        import json
+
+        print(
+            "ompi_tpu monitoring summary:\n"
+            + json.dumps(MONITOR.flush(), indent=2)
+        )
 
 
 class Monitoring:

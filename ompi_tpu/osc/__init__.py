@@ -3,14 +3,17 @@
 from .window import (
     LOCK_EXCLUSIVE,
     LOCK_SHARED,
+    DynamicWindow,
     SyncType,
     Window,
     WindowResult,
     allocate_window,
+    create_dynamic_window,
     create_window,
 )
 
 __all__ = [
-    "LOCK_EXCLUSIVE", "LOCK_SHARED", "SyncType", "Window",
-    "WindowResult", "allocate_window", "create_window",
+    "DynamicWindow", "LOCK_EXCLUSIVE", "LOCK_SHARED", "SyncType",
+    "Window", "WindowResult", "allocate_window",
+    "create_dynamic_window", "create_window",
 ]

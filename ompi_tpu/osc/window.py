@@ -351,9 +351,105 @@ class WindowResult:
         return self._slot[0]
 
 
+class DynamicWindow:
+    """MPI_Win_create_dynamic (reference: osc_rdma_dynamic.c — a window
+    with no initial memory; regions attach/detach at runtime and RMA
+    targets name a region). Each attached region is its own rank-major
+    Window sharing this handle's epoch calls; the region handle plays
+    the role the attached base address plays in the reference."""
+
+    def __init__(self, comm, *, name: str = "") -> None:
+        self.comm = comm
+        self.name = name or f"dynwin{comm.cid}"
+        self._regions: dict[int, Window] = {}
+        self._next_region = 0
+        self._epoch: Optional[str] = None  # None | "fence" | "lock_all"
+        self._freed = False
+
+    def attach(self, buffer) -> int:
+        """Attach a rank-major buffer; returns the region handle.
+        Legal at any time (MPI_Win_attach): a region attached inside an
+        open epoch joins it."""
+        if self._freed:
+            raise WinError(f"{self.name} has been freed")
+        rid = self._next_region
+        self._next_region += 1
+        win = Window(self.comm, buffer, name=f"{self.name}.r{rid}")
+        if self._epoch == "fence":
+            win.fence()
+        elif self._epoch == "lock_all":
+            win.lock_all()
+        self._regions[rid] = win
+        SPC.record("osc_dynamic_attaches")
+        return rid
+
+    def detach(self, region: int) -> None:
+        win = self._regions.get(region)
+        if win is None:
+            raise WinError(
+                f"{self.name}: region {region} is not attached"
+            )
+        # free first: if it raises (pending RMA ops), the region stays
+        # attached so the caller can close the epoch and retry
+        win.free()
+        del self._regions[region]
+
+    def region(self, region: int) -> Window:
+        win = self._regions.get(region)
+        if win is None:
+            raise WinError(
+                f"{self.name}: RMA on unattached region {region} "
+                "(the reference segfaults; we raise)"
+            )
+        return win
+
+    # epoch calls fan out to every attached region; the dynamic window
+    # remembers the epoch so late attaches join it
+    def fence(self) -> None:
+        self._epoch = "fence"
+        for win in self._regions.values():
+            win.fence()
+
+    def fence_end(self) -> None:
+        for win in self._regions.values():
+            win.fence_end()
+        self._epoch = None
+
+    def lock_all(self) -> None:
+        self._epoch = "lock_all"
+        for win in self._regions.values():
+            win.lock_all()
+
+    def unlock_all(self) -> None:
+        for win in self._regions.values():
+            win.unlock_all()
+        self._epoch = None
+
+    def put(self, value, target: int, *, region: int, index=None) -> None:
+        self.region(region).put(value, target, index)
+
+    def get(self, target: int, *, region: int, index=None):
+        return self.region(region).get(target, index)
+
+    def accumulate(self, value, target: int, *, region: int, op="sum",
+                   index=None) -> None:
+        self.region(region).accumulate(value, target, op, index)
+
+    def free(self) -> None:
+        for win in self._regions.values():
+            win.free()
+        self._regions.clear()
+        self._freed = True
+
+
 def create_window(comm, buffer, *, name: str = "") -> Window:
     """MPI_Win_create equivalent (collective over comm)."""
     return Window(comm, buffer, name=name)
+
+
+def create_dynamic_window(comm, *, name: str = "") -> DynamicWindow:
+    """MPI_Win_create_dynamic equivalent."""
+    return DynamicWindow(comm, name=name)
 
 
 def allocate_window(comm, block_shape, dtype="float32", *, name: str = ""

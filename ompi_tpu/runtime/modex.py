@@ -59,7 +59,13 @@ def put(key: str, value: Any) -> None:
 
 def get(key: str, timeout_s: float = 60.0) -> Any:
     """Read an entry, blocking until the owner publishes it
-    (PMIx_Get semantics: the fence is implicit in the blocking get)."""
+    (PMIx_Get semantics: the fence is implicit in the blocking get).
+    Both backends honor timeout_s — the in-process table polls, so
+    multi-threaded loopback tests get the same rendezvous behavior as
+    the coordinator KV store. Pass timeout_s=0 for an immediate probe.
+    """
+    import time
+
     client = _kv_client()
     if client is not None:
         try:
@@ -69,11 +75,15 @@ def get(key: str, timeout_s: float = 60.0) -> Any:
             return dss.unpack_one(bytes.fromhex(raw))
         except Exception as exc:
             raise ModexError(f"modex get({key!r}) failed: {exc}") from exc
-    with _lock:
-        rec = _local.get(key)
-    if rec is None:
-        raise ModexError(f"modex key {key!r} not published")
-    return dss.unpack_one(rec)
+    deadline = time.monotonic() + timeout_s
+    while True:
+        with _lock:
+            rec = _local.get(key)
+        if rec is not None:
+            return dss.unpack_one(rec)
+        if time.monotonic() >= deadline:
+            raise ModexError(f"modex key {key!r} not published")
+        time.sleep(0.005)
 
 
 def publish_dcn_address(endpoint, process_index: int) -> None:

@@ -1,0 +1,61 @@
+"""Dynamic RMA windows (MPI_Win_create_dynamic analog)."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core.errors import WinError
+from ompi_tpu.osc import create_dynamic_window
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+def test_attach_put_get_detach(comm):
+    win = create_dynamic_window(comm)
+    n = comm.size
+    r1 = win.attach(np.zeros((n, 4), np.float32))
+    r2 = win.attach(np.zeros((n, 2), np.int32))
+    win.fence()
+    win.put(np.full(4, 7, np.float32), target=1, region=r1)
+    win.put(np.full(2, 3, np.int32), target=0, region=r2)
+    got = win.get(target=1, region=r1)
+    win.fence()
+    np.testing.assert_array_equal(
+        np.asarray(got.value()), np.full(4, 7, np.float32)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(win.region(r2).array[0]), np.full(2, 3, np.int32)
+    )
+    win.detach(r1)
+    with pytest.raises(WinError):
+        win.put(np.zeros(4, np.float32), target=0, region=r1)
+    win.free()
+
+
+def test_detach_unattached_raises(comm):
+    win = create_dynamic_window(comm)
+    with pytest.raises(WinError):
+        win.detach(99)
+    win.free()
+
+
+def test_accumulate_in_region(comm):
+    win = create_dynamic_window(comm)
+    rid = win.attach(np.ones((comm.size, 3), np.float32))
+    win.lock_all()
+    win.accumulate(np.full(3, 2, np.float32), target=2, region=rid)
+    win.unlock_all()
+    np.testing.assert_array_equal(
+        np.asarray(win.region(rid).array[2]), np.full(3, 3, np.float32)
+    )
+    win.free()

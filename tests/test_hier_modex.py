@@ -29,7 +29,7 @@ def test_modex_inprocess_roundtrip():
     got = modex.get("dcn/0")
     assert got == {"ip": "127.0.0.1", "port": 1234}
     with pytest.raises(modex.ModexError):
-        modex.get("dcn/99")
+        modex.get("dcn/99", timeout_s=0)
     modex.clear_local()
 
 
@@ -127,23 +127,25 @@ def test_hier_allreduce_power_of_two(comm, n_slices):
 
 @pytest.mark.skipif(not build.available(), reason="no native library")
 def test_hier_allreduce_ring_schedule(comm):
-    """The ring exchange path (used for non-power-of-two slice counts),
-    forced via schedule= on a 2-slice layout."""
+    """The ring exchange path (default for non-power-of-two slice
+    counts), forced on a 4-slice layout: >= 3 rounds catches the
+    accumulator-forwarding double-count regression."""
     from ompi_tpu.coll import hier
 
-    if comm.size % 2:
-        pytest.skip("needs even rank count")
-    handles = _make_slices(comm, 2)
+    n_slices = 4
+    if comm.size % n_slices:
+        pytest.skip("needs rank count divisible by 4")
+    handles = _make_slices(comm, n_slices)
     try:
-        per = comm.size // 2
+        per = comm.size // n_slices
         datas = [
             np.stack([
                 np.full(3, 10 * s + r, np.float32) for r in range(per)
             ])
-            for s in range(2)
+            for s in range(n_slices)
         ]
         expect = sum(d.sum(axis=0) for d in datas)
-        results = [None] * 2
+        results = [None] * n_slices
         errs = []
 
         def run(i):
@@ -156,13 +158,14 @@ def test_hier_allreduce_ring_schedule(comm):
             except Exception as e:  # pragma: no cover
                 errs.append(e)
 
-        ts = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+        ts = [threading.Thread(target=run, args=(i,))
+              for i in range(n_slices)]
         for t in ts:
             t.start()
         for t in ts:
             t.join(timeout=60)
         assert not errs, errs
-        for s in range(2):
+        for s in range(n_slices):
             np.testing.assert_allclose(results[s][0], expect, rtol=1e-5)
     finally:
         for h in handles:

@@ -1,0 +1,81 @@
+"""Config profiles (AMCA param sets), error classes, monitoring dump."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core import config, errors
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+def test_profile_files_load():
+    import os
+
+    import ompi_tpu.ft  # registers ft/vprotocol vars
+
+    # profiles apply at FILE precedence: an API-set value wins, a
+    # default loses (reference precedence, mca_base_var.h:119-132)
+    assert config.get("ft_manager_keep") == 3
+    config.VARS.load_param_file(
+        os.path.join(os.path.dirname(mt.__file__), "..", "profiles",
+                     "ft.conf")
+    )
+    try:
+        assert config.get("ft_manager_keep") == 10
+        assert config.get("vprotocol_pessimist_enable") is True
+    finally:
+        config.set("ft_manager_keep", 3)
+        config.set("vprotocol_pessimist_enable", False)
+
+
+def test_profile_latency_parses():
+    import os
+
+    from ompi_tpu.btl import BTL
+
+    BTL.component("dcn")  # instantiation registers btl_dcn_* vars
+    config.VARS.load_param_file(
+        os.path.join(os.path.dirname(mt.__file__), "..", "profiles",
+                     "latency.conf")
+    )
+    try:
+        assert config.get("btl_dcn_eager_limit") == 8192
+    finally:
+        config.set("btl_dcn_eager_limit", 64 * 1024)
+
+
+def test_error_class_and_string():
+    exc = errors.TruncationError("message too long")
+    assert errors.error_class(exc) == "ERR_TRUNCATE"
+    assert "ERR_TRUNCATE" in errors.error_string(exc)
+    classes = errors.known_error_classes()
+    for want in ("ERR_COMM", "ERR_IO", "ERR_TYPE", "ERR_RMA_SYNC"):
+        assert want in classes
+    # foreign exceptions map to ERR_OTHER
+    assert errors.error_class(ValueError("x")) == "ERR_OTHER"
+
+
+def test_monitoring_dump_at_finalize(capsys):
+    from ompi_tpu.monitoring import MONITOR
+    from ompi_tpu.monitoring.monitoring import maybe_dump_at_finalize
+
+    config.set("monitoring_base_enable", True)
+    config.set("monitoring_base_dump_at_finalize", True)
+    try:
+        comm = mt.world().dup()
+        comm.rank(0).send(np.float32(1.0), dest=1, tag=1)
+        comm.rank(1).recv(source=0, tag=1)
+        maybe_dump_at_finalize()
+        out = capsys.readouterr().out
+        assert "monitoring summary" in out
+        assert "p2p" in out
+    finally:
+        config.set("monitoring_base_enable", False)
+        config.set("monitoring_base_dump_at_finalize", False)
+        MONITOR.reset()
