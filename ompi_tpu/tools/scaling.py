@@ -1,0 +1,73 @@
+"""Launch-scaling probe: init time + memory footprint vs rank count.
+
+TPU-native equivalent of contrib/scaling (reference: scaling.pl +
+mpi_no_op.c + mpi_memprobe.c — measure launch wall time and per-proc
+memory at increasing scale, SURVEY §4 "Scale/launch tests"). Driver
+form: subprocesses with growing virtual device counts measure
+init→world→barrier→finalize wall time and peak RSS.
+
+    python -m ompi_tpu.tools.scaling --ranks 1,2,4,8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import subprocess
+import sys
+
+_PROBE = r"""
+import os, resource, time, sys
+n = int(sys.argv[1])
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + f" --xla_force_host_platform_device_count={n}"
+)
+import jax
+jax.config.update("jax_platforms", "cpu")
+t0 = time.perf_counter()
+import ompi_tpu
+comm = ompi_tpu.init()
+t_init = time.perf_counter() - t0
+assert comm.size == n, (comm.size, n)
+t1 = time.perf_counter()
+comm.barrier()
+t_barrier = time.perf_counter() - t1
+ompi_tpu.finalize()
+rss_mb = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024
+print(__import__("json").dumps(
+    {"ranks": n, "init_s": round(t_init, 3),
+     "first_barrier_s": round(t_barrier, 3),
+     "peak_rss_mb": round(rss_mb, 1)}
+))
+"""
+
+
+def probe(n: int, timeout: float = 300.0) -> dict:
+    out = subprocess.run(
+        [sys.executable, "-c", _PROBE, str(n)],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"scaling probe n={n} failed:\n{out.stderr[-2000:]}"
+        )
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu.tools.scaling")
+    ap.add_argument("--ranks", default="1,2,4,8")
+    args = ap.parse_args(argv)
+    print(f"{'ranks':>6} {'init s':>8} {'barrier s':>10} {'rss MB':>8}")
+    for n in (int(x) for x in args.ranks.split(",")):
+        r = probe(n)
+        print(
+            f"{r['ranks']:>6} {r['init_s']:>8.3f} "
+            f"{r['first_barrier_s']:>10.3f} {r['peak_rss_mb']:>8.1f}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

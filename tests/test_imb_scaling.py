@@ -1,0 +1,55 @@
+"""IMB-style harness and scaling probe."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+def test_imb_sweep_rows():
+    from ompi_tpu.tools import imb
+
+    comm = mt.world()
+    rows = imb.sweep(
+        comm, ["allreduce", "barrier"], min_bytes=64, max_bytes=1024,
+        iters=2,
+    )
+    ars = [r for r in rows if r.op == "allreduce"]
+    assert [r.nbytes for r in ars] == [64, 256, 1024]
+    for r in ars:
+        assert r.min_us > 0 and r.p50_us >= r.min_us
+        assert r.gbps > 0
+    bar = [r for r in rows if r.op == "barrier"]
+    assert len(bar) == 1 and bar[0].gbps == 0.0
+    text = imb.render(rows)
+    assert "allreduce" in text and "GB/s" in text
+
+
+def test_imb_alltoall_buffer_shape():
+    from ompi_tpu.tools import imb
+
+    comm = mt.world()
+    row = imb.run_one(comm, "alltoall", 4096, iters=1)
+    assert row.op == "alltoall" and row.min_us > 0
+
+
+def test_imb_cli_rejects_bad_op():
+    from ompi_tpu.tools import imb
+
+    with pytest.raises(SystemExit):
+        imb.main(["--ops", "frobnicate"])
+
+
+def test_scaling_probe_subprocess():
+    from ompi_tpu.tools import scaling
+
+    r = scaling.probe(2)
+    assert r["ranks"] == 2
+    assert r["init_s"] > 0 and r["peak_rss_mb"] > 0
