@@ -319,6 +319,61 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: Any = "sum"
     return out.reshape((n,) + shape)
 
 
+def _alltoall_kernel(axis_name: str, n: int, x_ref, out_ref,
+                     send_sem, recv_sem):
+    """Pairwise-exchange alltoall (reference: coll_base_alltoall.c's
+    pairwise variant): at step s every rank RDMA-writes block
+    (me+s) directly into rank (me+s)'s out[me] — no intermediate
+    buffering, each byte crosses ICI exactly once. The EP/Ulysses
+    primitive (SURVEY §2.6, §5.7)."""
+    me = jax.lax.axis_index(axis_name)
+    out_ref[me] = x_ref[me]
+    for step in range(1, n):
+        dst = jax.lax.rem(me + step, n)
+        slot = step % 2
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[dst],
+            dst_ref=out_ref.at[me],
+            send_sem=send_sem.at[slot],
+            recv_sem=recv_sem.at[slot],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+
+def ring_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
+    """Inside shard_map: local (n, chunk) send blocks -> (n, chunk)
+    received blocks (row s = block from rank s)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    shape = x.shape[1:]
+    flat = x.reshape(n, -1)
+    pad = (-flat.shape[1]) % 128
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    kernel = functools.partial(_alltoall_kernel, axis_name, n)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=4,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape((n,) + shape)
+
+
 def ppermute_shift(x: jax.Array, axis_name: str, shift: int = 1
                    ) -> jax.Array:
     """One ring hop as a Pallas remote DMA — the building block for
@@ -434,6 +489,23 @@ class PallasColl(CollComponent):
                str(x.dtype))
         plan = compile_plan(
             comm, key, lambda b: ring_reduce_scatter(b, "ranks", op),
+            check_vma=False,
+        )
+        return plan(x)
+
+    def alltoall(self, comm, x):
+        x = rank_major_check(comm, x, min_ndim=2)
+        if x.shape[1] != comm.size:
+            from ..core.errors import ArgumentError
+
+            raise ArgumentError(
+                f"alltoall needs (size, size, ...) buffer, got {x.shape}"
+            )
+        if comm.size == 1:
+            return x
+        key = ("alltoall", "pallas", x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: ring_alltoall(b, "ranks"),
             check_vma=False,
         )
         return plan(x)

@@ -20,6 +20,10 @@ def collect(include_internal: bool = False) -> dict:
     from ..coll import framework as coll_fw
     from ..pml import framework as pml_fw
     from ..btl import framework as btl_fw  # noqa: F401
+    from ..io import fbtl, fcoll, fs, sharedfp  # noqa: F401
+    from ..ft import crs  # noqa: F401
+    from ..hook import framework as hook_fw  # noqa: F401
+    from ..pml import mtl  # noqa: F401
     from ..core import config
     from ..core.component import MCA
     from ..core.counters import SPC

@@ -61,6 +61,19 @@ def test_ring_allreduce_ops(mesh):
             np.testing.assert_allclose(out[r], ref, rtol=1e-4, atol=1e-5)
 
 
+def test_ring_alltoall(mesh):
+    n = 8
+    # blocks[s][d]: distinct value per (src, dst) pair
+    blocks = np.arange(n * n * 5, dtype=np.float32).reshape(n, n, 5)
+    f = shard_map(
+        lambda x: pr.ring_alltoall(x[0], "x")[None],
+        mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+    )
+    out = np.asarray(jax.jit(f)(jnp.asarray(blocks)))
+    # out[d][s] must equal blocks[s][d]
+    np.testing.assert_allclose(out, blocks.swapaxes(0, 1), rtol=1e-6)
+
+
 def test_ppermute_shift(mesh):
     n = 8
     data = np.random.default_rng(3).standard_normal((n, 13)).astype(np.float32)
@@ -109,3 +122,11 @@ def test_vtable_allgather_reduce_scatter(pallas_world):
     out = np.asarray(comm.reduce_scatter_block(comm.put_rank_major(blocks),
                                                "sum"))
     np.testing.assert_allclose(out, blocks.sum(0), rtol=1e-4, atol=1e-5)
+
+
+def test_vtable_alltoall(pallas_world):
+    comm = pallas_world
+    n = comm.size
+    blocks = np.arange(n * n * 3, dtype=np.float32).reshape(n, n, 3)
+    out = np.asarray(comm.alltoall(comm.put_rank_major(blocks)))
+    np.testing.assert_allclose(out, blocks.swapaxes(0, 1), rtol=1e-6)
