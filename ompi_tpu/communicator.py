@@ -384,6 +384,19 @@ class Communicator(HasAttributes, HasErrhandler):
         self._check_alive()
         return self.pml.probe(self, source, tag, dest=dest, blocking=False)
 
+    def improbe(self, source: int = -1, tag: int = -1, *, dest: int):
+        """MPI_Improbe: match-and-remove; returns a Message or None."""
+        self._check_alive()
+        pml = self.pml
+        base = pml
+        while not hasattr(base, "improbe") and hasattr(base, "host"):
+            base = base.host
+        if not hasattr(base, "improbe"):
+            raise CommError(
+                f"selected pml {pml.NAME} has no matched-probe support"
+            )
+        return base.improbe(self, source, tag, dest=dest)
+
     def rank(self, rank: int) -> "RankEndpoint":
         """A rank's-eye view with the MPI-faithful call signatures."""
         return RankEndpoint(self, self.check_rank(rank))

@@ -371,6 +371,29 @@ class File(HasErrhandler):
                 self._pointers[r] = offs[r] + count
         return out
 
+    # nonblocking collectives (MPI 3.1 iwrite_at_all/iread_at_all):
+    # the aggregation runs on the fbtl IO thread pool; completion
+    # through the request machinery like every other nonblocking op
+    def iwrite_at_all(self, offsets: Sequence[int], value) -> Request:
+        self._check(writing=True)
+        from . import fbtl as fbtl_mod_
+
+        return fbtl_mod_.FutureRequest(
+            fbtl_mod_._executor().submit(
+                self.write_at_all, list(offsets), value
+            )
+        )
+
+    def iread_at_all(self, offsets: Sequence[int], count: int) -> Request:
+        self._check(writing=False)
+        from . import fbtl as fbtl_mod_
+
+        return fbtl_mod_.FutureRequest(
+            fbtl_mod_._executor().submit(
+                self.read_at_all, list(offsets), count
+            )
+        )
+
     # split collectives (MPI_File_*_all_begin/_end)
     def write_at_all_begin(self, offsets, value) -> None:
         self.write_at_all(offsets, value)

@@ -29,6 +29,13 @@ _enable = config.register(
     description="Build/use the native C++ kernels (fallback: pure Python)",
 )
 
+_sanitize = config.register(
+    "native", "base", "sanitize", type=str, default="",
+    description="Build native code with a sanitizer: 'address' or "
+    "'thread' (reference analog: ASan/TSan configs for the C pieces, "
+    "SURVEY §5.2); changes the build digest so both variants coexist",
+)
+
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
@@ -38,6 +45,7 @@ def _source_digest(sources: list[Path]) -> str:
     h = hashlib.sha256()
     for s in sorted(sources):
         h.update(s.read_bytes())
+    h.update(_sanitize.value.encode())
     return h.hexdigest()[:16]
 
 
@@ -53,7 +61,11 @@ def _build() -> Optional[Path]:
     cmd = [
         "g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
         "-o", str(out),
-    ] + [str(s) for s in sources]
+    ]
+    if _sanitize.value in ("address", "thread"):
+        cmd += [f"-fsanitize={_sanitize.value}", "-g",
+                "-fno-omit-frame-pointer"]
+    cmd += [str(s) for s in sources]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, text=True, timeout=120

@@ -29,7 +29,7 @@ from dataclasses import dataclass
 from typing import Any, Optional
 
 from ..core.counters import SPC
-from ..core.errors import CommError, RankError, TagError
+from ..core.errors import CommError, RankError, RequestError, TagError
 from ..core.request import ANY_SOURCE, ANY_TAG, Request, Status
 from ..btl.framework import Bml
 from .framework import PML, PmlComponent
@@ -351,3 +351,58 @@ class Ob1Pml(PmlComponent):
                 "driver controls all sends; use iprobe"
             )
         return None
+
+    # -- matched probe (MPI_Mprobe/Mrecv; reference: ompi/message +
+    # the mprobe entry in the pml module struct, pml.h:134-358) -------
+
+    def improbe(self, comm, source: int, tag: int, *,
+                dest: Optional[int] = None) -> Optional["Message"]:
+        """Atomically match-and-remove an unexpected message; the
+        returned handle can only be received via mrecv (no other recv
+        can steal it — the matched-probe guarantee)."""
+        if dest is None:
+            raise RankError("driver-mode improbe needs dest=")
+        st = self._state(comm)
+        probe_req = RecvRequest(
+            source if source == ANY_SOURCE else comm.check_rank(source),
+            comm.check_rank(dest),
+            tag,
+        )
+        for i, pending in enumerate(st.unexpected):
+            if self._compatible(probe_req, pending.env):
+                st.unexpected.pop(i)
+                SPC.record("pml_improbe_hits")
+                return Message(self, comm, pending, dest)
+        return None
+
+
+class Message:
+    """A matched-but-unreceived message (ompi_message_t analog)."""
+
+    def __init__(self, pml, comm, pending, dest: int) -> None:
+        self._pml = pml
+        self._comm = comm
+        self._pending = pending
+        self._dest = dest
+        self._received = False
+
+    @property
+    def status(self) -> Status:
+        env = self._pending.env
+        return Status(source=env.src, tag=env.tag, count=env.nbytes)
+
+    def imrecv(self) -> RecvRequest:
+        """MPI_Imrecv: receive exactly this message."""
+        if self._received:
+            raise RequestError("message already received")
+        self._received = True
+        env = self._pending.env
+        req = RecvRequest(env.src, self._dest, env.tag)
+        self._pml._deliver(self._pending, req)
+        return req
+
+    def mrecv(self):
+        """MPI_Mrecv."""
+        req = self.imrecv()
+        req.wait()
+        return req.result()

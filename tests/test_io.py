@@ -281,6 +281,20 @@ def test_split_collective(tmp_path, comm):
         np.testing.assert_array_equal(out[r], np.full(4, r, np.float32))
 
 
+def test_nonblocking_collective(tmp_path, comm):
+    n = comm.size
+    p = str(tmp_path / "icoll.bin")
+    with io_mod.open(comm, p, "w+") as fh:
+        fh.set_view(0, dt.FLOAT32)
+        offs = [r * 8 for r in range(n)]
+        wreq = fh.iwrite_at_all(offs, _rank_major(comm, 8))
+        wreq.wait()
+        rreq = fh.iread_at_all(offs, 8)
+        out = np.asarray(rreq.result())
+    for r in range(n):
+        np.testing.assert_array_equal(out[r], np.full(8, r, np.float32))
+
+
 # -- shared pointer --------------------------------------------------------
 
 def test_shared_pointer_appends(tmp_path, comm):

@@ -191,3 +191,39 @@ def test_cancelled_recv_does_not_steal_message(world):
     out = r1.recv(source=0, tag=555)  # real recv gets the payload
     assert float(out) == 42.0
     assert req._result is None  # payload was not stolen
+
+
+# -- matched probe (MPI_Mprobe/Mrecv) --------------------------------------
+
+def test_improbe_removes_from_matching(world):
+    import numpy as np
+
+    c = world.dup()
+    c.rank(0).isend(np.float32(42.0), dest=1, tag=7)
+    msg = c.improbe(source=0, tag=7, dest=1)
+    assert msg is not None
+    assert msg.status.source == 0 and msg.status.tag == 7
+    # the message is REMOVED: a wildcard probe no longer sees it
+    assert c.iprobe(source=-1, tag=-1, dest=1) is None
+    assert float(msg.mrecv()) == 42.0
+    import pytest as _pytest
+
+    from ompi_tpu.core.errors import RequestError
+
+    with _pytest.raises(RequestError):
+        msg.imrecv()  # double receive
+
+
+def test_improbe_none_when_no_match(world):
+    c = world.dup()
+    assert c.improbe(source=0, tag=99, dest=1) is None
+
+
+def test_improbe_wildcard(world):
+    import numpy as np
+
+    c = world.dup()
+    c.rank(2).isend(np.float32(5.0), dest=3, tag=11)
+    msg = c.improbe(source=-1, tag=-1, dest=3)
+    assert msg is not None and msg.status.source == 2
+    assert float(msg.mrecv()) == 5.0
