@@ -283,3 +283,79 @@ def test_to_self_noncontiguous_through_p2p():
     expected = np.zeros(30, np.float32)
     expected.reshape(3, 10)[:, :2] = arr.reshape(3, 10)[:, :2]
     np.testing.assert_array_equal(np.asarray(out), expected)
+
+
+class TestOutOfOrderUnpack:
+    """The reference's unpack_ooo.c scenario: packed segments arrive in
+    arbitrary order (multi-rail fragments race); the convertor's
+    set_position makes unpack order-independent."""
+
+    def test_shuffled_segments(self):
+        rng = np.random.RandomState(7)
+        arr = np.arange(60, dtype=np.float32)
+        v = dt.vector(6, 3, 10, dt.FLOAT32)
+        packed = dt.pack(arr, v, 1)
+        # split into uneven segments with their packed offsets
+        cuts = sorted(rng.choice(np.arange(4, len(packed), 4),
+                                 size=4, replace=False).tolist())
+        bounds = [0] + cuts + [len(packed)]
+        segs = [
+            (bounds[i], packed[bounds[i]:bounds[i + 1]])
+            for i in range(len(bounds) - 1)
+        ]
+        rng.shuffle(segs)
+
+        out = np.zeros_like(arr)
+        conv = dt.Convertor(v, 1).prepare_for_recv(out)
+        for off, seg in segs:
+            conv.set_position(off)
+            assert conv.unpack(seg) == len(seg)
+        sel = np.zeros(60, bool)
+        sel.reshape(6, 10)[:, :3] = True
+        np.testing.assert_array_equal(out[sel], arr[sel])
+        assert (out[~sel] == 0).all()
+
+    def test_fuzz_roundtrip_random_types(self):
+        """Property-style: random derived types x random chunkings
+        round-trip exactly (the ddt_test.c battery)."""
+        rng = np.random.RandomState(11)
+        for trial in range(20):
+            kind = trial % 4
+            if kind == 0:
+                count = rng.randint(1, 5)
+                bl = rng.randint(1, 4)
+                stride = bl + rng.randint(0, 4)
+                ty = dt.vector(rng.randint(1, 5), bl, stride, dt.INT32)
+            elif kind == 1:
+                n = rng.randint(1, 5)
+                disps = sorted(
+                    rng.choice(np.arange(0, 20), size=n,
+                               replace=False).tolist()
+                )
+                bls = [int(rng.randint(1, 3)) for _ in range(n)]
+                ty = dt.indexed(bls, disps, dt.FLOAT32)
+            elif kind == 2:
+                ty = dt.subarray(
+                    (6, 8), (rng.randint(1, 6), rng.randint(1, 8)),
+                    (0, 0), dt.FLOAT64,
+                )
+            else:
+                ty = dt.struct(
+                    [1, 2], [0, 8], [dt.INT32, dt.FLOAT32]
+                )
+            count = rng.randint(1, 3)
+            total = (ty.extent * count + ty.size) // 4 + 16
+            arr = rng.randint(0, 1000, total).astype(np.int32).view(
+                np.float32
+            ) if kind in (1, 2) else rng.randint(
+                0, 1000, total
+            ).astype(np.int32)
+            if kind == 2:
+                arr = rng.standard_normal(total).astype(np.float64)
+            chunk = [int(rng.randint(1, 40)) for _ in range(3)]
+            out = roundtrip(arr, ty, count, chunk_sizes=chunk)
+            packed_a = dt.pack(arr, ty, count)
+            packed_b = dt.pack(out, ty, count)
+            assert packed_a == packed_b, (
+                f"trial {trial}: {ty} count {count} chunks {chunk}"
+            )
