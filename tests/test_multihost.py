@@ -1,0 +1,117 @@
+"""Two-controller-process integration: jax.distributed coordinator as
+the PMIx server, modex over its KV store, DCN between the processes.
+
+This is the production multi-host shape (SURVEY §3.1's wire-up call
+stack): each subprocess = one host's controller driving its own device
+set; the coordinator wires the mesh, the modex exchanges DCN listener
+addresses, and a cross-process hierarchical allreduce runs intra-
+"slice" on devices + inter-slice over the TCP engine.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ompi_tpu.native import build
+
+pytestmark = pytest.mark.skipif(
+    not build.available(), reason="native library unavailable"
+)
+
+_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.coll import hier
+    from ompi_tpu.runtime import modex
+
+    # jax.distributed: the coordinator plays the PMIx-server role.
+    # On CPU each process keeps its OWN local mesh (no cross-process
+    # device fusion) — which is exactly the hier two-level shape.
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    # Two-level shape: this controller's communicator spans its LOCAL
+    # devices (the slice); the inter-slice hop is DCN. (A single global
+    # comm over jax.devices() is the flat SPMD alternative, exercised
+    # by the driver's dryrun_multichip.)
+    comm = ompi_tpu.init(devices=jax.local_devices())
+
+    ep = dcn.DcnEndpoint()
+    modex.publish_dcn_address(ep, pid)
+    table = modex.collect_dcn_addresses(nprocs, timeout_s=60)
+    peer_ids = {}
+    for idx, (ip, port) in table.items():
+        if idx != pid:
+            peer_ids[idx] = ep.connect(ip, port, cookie=pid + 1)
+
+    h = hier.SliceHandle(
+        comm=comm, endpoint=ep, slice_id=pid, n_slices=nprocs,
+        peer_ids=peer_ids,
+    )
+    local = np.stack([
+        np.full(3, 10 * pid + r + 1, np.float32)
+        for r in range(comm.size)
+    ])
+    x = comm.put_rank_major(local)
+    out = np.asarray(hier.allreduce(h, x))
+    # oracle: sum over both processes' all-rank contributions
+    expect = sum(
+        sum(10 * p + r + 1 for r in range(comm.size))
+        for p in range(nprocs)
+    )
+    assert out.shape == (comm.size, 3), out.shape
+    assert np.allclose(out, expect), (out[0], expect)
+    ep.close()
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_hier_allreduce(tmp_path):
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "OK" in out
