@@ -98,11 +98,8 @@ class DcnEndpoint:
         if msgid < 0:
             raise DcnError(f"send to unknown peer {peer}")
         SPC.record("dcn_send_bytes", buf.nbytes)
-        # Opportunistically drain the send-completion queue so the
-        # engine's inflight_out bookkeeping (rndv payload copies) is
-        # reclaimed without requiring callers to poll.
-        while self._lib.dcn_poll_send(self._ctx):
-            pass
+        # Payload copies are reclaimed by the engine at completion;
+        # the completion queue is left for explicit pollers.
         return int(msgid)
 
     def poll_recv(self) -> Optional[tuple[int, int, bytes]]:
@@ -151,6 +148,28 @@ class DcnEndpoint:
     def poll_send_complete(self) -> Optional[int]:
         msgid = self._lib.dcn_poll_send(self._ctx)
         return int(msgid) if msgid else None
+
+    def peer_links(self, peer: int) -> int:
+        """Live TCP links to a peer; 0 means the peer is unreachable
+        (every link died — the btl_tcp endpoint-failed state)."""
+        return int(self._lib.dcn_peer_links(self._ctx, peer))
+
+    def peer_alive(self, peer: int) -> bool:
+        return self.peer_links(peer) > 0
+
+    def check_peer(self, peer: int, *, what: str = "peer") -> None:
+        """Raise (and report a failure event) if the peer is dead."""
+        if not self.peer_alive(peer):
+            from ..ft import events
+
+            events.raise_event(
+                events.EventClass.DEVICE_ERROR,
+                transport="dcn", peer=peer,
+            )
+            raise DcnError(
+                f"{what} {peer}: all DCN links are down "
+                "(connection lost)"
+            )
 
     def stats(self) -> dict:
         names = ("bytes_sent", "bytes_recv", "eager_sends", "rndv_sends",

@@ -77,10 +77,26 @@ class SliceHandle:
         if q:
             return q.pop(0)
         deadline = time.monotonic() + timeout
+        passive_peer = -(src_slice + 1)
         while True:
-            peer, got_tag, raw = self.endpoint.recv_bytes(
-                timeout=max(0.0, deadline - time.monotonic())
-            )
+            got = self.endpoint.poll_recv()
+            if got is None:
+                # fail fast when the source slice's links are all gone
+                # instead of burning the whole timeout (peer_links is
+                # -1 while the handshake is still in flight — only a
+                # known-then-died peer trips this)
+                if self.endpoint.peer_links(passive_peer) == 0:
+                    self.endpoint.check_peer(
+                        passive_peer, what=f"slice {src_slice}"
+                    )
+                if time.monotonic() >= deadline:
+                    raise HierError(
+                        f"slice {self.slice_id}: timeout waiting for "
+                        f"{key}"
+                    )
+                time.sleep(0.0002)
+                continue
+            peer, got_tag, raw = got
             src = -peer - 1 if peer < 0 else None
             if src is None:
                 raise HierError(

@@ -144,6 +144,8 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
     lib.dcn_set_eager.argtypes = [P, LL]
     lib.dcn_port.restype = ctypes.c_int
     lib.dcn_port.argtypes = [P]
+    lib.dcn_peer_links.restype = ctypes.c_int
+    lib.dcn_peer_links.argtypes = [P, ctypes.c_int]
     lib.dcn_stat.restype = LL
     lib.dcn_stat.argtypes = [P, ctypes.c_int]
     lib.dcn_destroy.restype = None

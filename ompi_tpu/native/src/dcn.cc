@@ -274,6 +274,25 @@ void handle_frame(Ctx* c, Link& l) {
   }
 }
 
+
+// mu held. Drop a link: close the fd and remove it from its peer's
+// live set so liveness queries see the loss (reference: btl_tcp's
+// endpoint FSM marks the endpoint failed when its connection dies).
+void drop_link(Ctx* c, int fd) {
+  epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
+  close(fd);
+  auto it = c->links.find(fd);
+  if (it != c->links.end()) {
+    int peer = it->second.peer;
+    auto pit = c->peers.find(peer);
+    if (pit != c->peers.end()) {
+      auto& v = pit->second.link_fds;
+      v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+    }
+    c->links.erase(it);
+  }
+}
+
 void do_read(Ctx* c, int fd) {
   std::lock_guard<std::mutex> g(c->mu);
   auto lit = c->links.find(fd);
@@ -287,17 +306,13 @@ void do_read(Ctx* c, int fd) {
       if (n <= 0) {
         if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
         // connection closed/error: drop the link
-        epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
-        close(fd);
-        c->links.erase(fd);
+        drop_link(c, fd);
         return;
       }
       l.need -= n;
       if (l.need == 0) {
         if (l.cur.magic != kMagic) {  // protocol desync: drop link
-          epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
-          close(fd);
-          c->links.erase(fd);
+          drop_link(c, fd);
           return;
         }
         l.in_header = false;
@@ -315,9 +330,7 @@ void do_read(Ctx* c, int fd) {
       ssize_t n = read(fd, l.inbuf.data() + have, l.need);
       if (n <= 0) {
         if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-        epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
-        close(fd);
-        c->links.erase(fd);
+        drop_link(c, fd);
         return;
       }
       l.need -= n;
@@ -343,9 +356,7 @@ void do_write(Ctx* c, int fd) {
       ssize_t n = write(fd, hdr + f.sent, hdr_n - f.sent);
       if (n <= 0) {
         if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-        epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
-        close(fd);
-        c->links.erase(fd);
+        drop_link(c, fd);
         return;
       }
       f.sent += n;
@@ -356,9 +367,7 @@ void do_write(Ctx* c, int fd) {
                         f.payload.size() - off);
       if (n <= 0) {
         if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-        epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
-        close(fd);
-        c->links.erase(fd);
+        drop_link(c, fd);
         return;
       }
       f.sent += n;
@@ -373,6 +382,10 @@ void do_write(Ctx* c, int fd) {
         it->second.bytes_written += f.hdr.payload_len;
         if (it->second.bytes_written >= it->second.total_len) {
           it->second.done = true;
+          // reclaim the rndv payload copy NOW; the (tiny) entry stays
+          // until dcn_poll_send so completion ids are never lost
+          it->second.data.clear();
+          it->second.data.shrink_to_fit();
           c->send_done.push_back(f.hdr.msgid);
         }
       }
@@ -428,9 +441,7 @@ void loop_fn(Ctx* c) {
       }
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
         std::lock_guard<std::mutex> g(c->mu);
-        epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
-        close(fd);
-        c->links.erase(fd);
+        drop_link(c, fd);
         continue;
       }
       if (evs[i].events & EPOLLIN) do_read(c, fd);
@@ -636,6 +647,15 @@ void dcn_set_eager(void* vc, long long limit) {
 }
 
 int dcn_port(void* vc) { return static_cast<Ctx*>(vc)->port; }
+
+// Live link count to a peer (0 = peer unreachable/dead); -1 unknown.
+int dcn_peer_links(void* vc, int peer) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->peers.find(peer);
+  if (it == c->peers.end()) return -1;
+  return (int)it->second.link_fds.size();
+}
 
 long long dcn_stat(void* vc, int what) {
   Ctx* c = static_cast<Ctx*>(vc);

@@ -238,3 +238,64 @@ def test_pool_context_manager():
         assert pool.stats()["frees"] == 1
     finally:
         pool.close()
+
+
+def test_peer_death_detected():
+    """When every link to a peer dies, liveness flips and waiting
+    receivers fail fast instead of burning their timeout (the
+    btl_tcp endpoint-failed analog)."""
+    import time
+
+    a = dcn_mod.DcnEndpoint()
+    b = dcn_mod.DcnEndpoint()
+    try:
+        peer_b = a.connect(b.address[0], b.address[1], cookie=9)
+        a.send_bytes(peer_b, 1, b"hello")
+        b.recv_bytes()  # handshake + message processed; links grouped
+        assert a.peer_alive(peer_b)
+        assert b.peer_links(-9) > 0  # passive peer (cookie 9)
+        b.close()  # peer vanishes
+        deadline = time.time() + 10
+        while a.peer_links(peer_b) > 0 and time.time() < deadline:
+            # a send makes the engine touch the dead sockets
+            try:
+                a.send_bytes(peer_b, 2, b"probe")
+            except dcn_mod.DcnError:
+                break
+            time.sleep(0.05)
+        assert a.peer_links(peer_b) == 0
+        with pytest.raises(dcn_mod.DcnError):
+            a.check_peer(peer_b)
+    finally:
+        a.close()
+
+
+def test_hier_recv_fails_fast_on_dead_slice():
+    from ompi_tpu.coll import hier
+    import ompi_tpu as mt
+
+    if not mt.initialized():
+        mt.init()
+    comm = mt.world()
+    h0 = hier.SliceHandle(
+        comm=comm.dup(), endpoint=dcn_mod.DcnEndpoint(),
+        slice_id=0, n_slices=2, peer_ids={},
+    )
+    h1 = hier.SliceHandle(
+        comm=comm.dup(), endpoint=dcn_mod.DcnEndpoint(),
+        slice_id=1, n_slices=2, peer_ids={},
+    )
+    try:
+        hier.wire_slices([h0, h1])
+        # slice 1 announces itself to slice 0 then dies
+        h1.endpoint.send_bytes(h1.peer_ids[0], 0x48494552, b"x" * 4)
+        h0.recv_from(1, 0x48494552, timeout=10)
+        h1.endpoint.close()
+        import time
+
+        t0 = time.time()
+        with pytest.raises((hier.HierError, dcn_mod.DcnError)):
+            h0.recv_from(1, 0x48494553, timeout=30)
+        assert time.time() - t0 < 15  # failed fast, not full timeout
+    finally:
+        h0.endpoint.close()
