@@ -6,12 +6,13 @@ PMIx failure events → `events`; opal/mca/crs → `crs`; crcp/bkmrk →
 opal-checkpoint tooling → `manager`.
 """
 
-from . import crcp, crs, events, manager, vprotocol
+from . import crcp, crs, elastic, events, manager, vprotocol
 from .crs import CheckpointError
 from .events import Event, EventClass, ProcFailedError
 from .manager import CheckpointManager
 
 __all__ = [
     "CheckpointError", "CheckpointManager", "Event", "EventClass",
-    "ProcFailedError", "crcp", "crs", "events", "manager", "vprotocol",
+    "ProcFailedError", "crcp", "crs", "elastic", "events", "manager",
+    "vprotocol",
 ]

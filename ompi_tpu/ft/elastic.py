@@ -1,0 +1,153 @@
+"""Elastic recovery: shrink, agreement, respawn.
+
+The reference snapshot predates ULFM (SURVEY §5.3: "No ULFM
+(comm revoke/shrink) in this snapshot"); its recovery story is
+checkpoint/restart only. The TPU driver model makes the ULFM trio
+cheap, so this module provides it — going past reference parity:
+
+- **shrink(comm)**: a new communicator over the surviving ranks
+  (MPI_Comm_shrink). Failures come from the ft.events registry
+  (`ft/events.py` probes or injection).
+- **agree(comm, values)**: fault-tolerant agreement (MPIX_Comm_agree's
+  role): the controller sees every surviving rank's flag, so agreement
+  is a reduction over survivors.
+- **respawn(comm, manager)**: shrink + restore the latest checkpoint
+  resharded onto the surviving devices — the "re-initialize mesh on
+  respawn" loop (SURVEY §5.3) in one call.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from ..core.counters import SPC
+from ..core.errors import CommError
+from ..core.logging import get_logger
+from ..group import Group
+from . import events
+
+logger = get_logger("ft.elastic")
+
+_failed: set[int] = set()  # world ranks reported dead
+_lock = threading.Lock()
+_handler_id: Optional[int] = None
+
+
+def _on_failure(ev: events.Event) -> None:
+    wr = ev.info.get("world_rank")
+    if wr is not None:
+        with _lock:
+            _failed.add(wr)
+
+
+def enable() -> None:
+    """Start tracking PROC_FAILED events (idempotent)."""
+    global _handler_id
+    with _lock:
+        if _handler_id is None:
+            _handler_id = events.register(
+                events.EventClass.PROC_FAILED, _on_failure
+            )
+
+
+def reset() -> None:
+    """Forget recorded failures (after a successful recovery)."""
+    global _handler_id
+    with _lock:
+        _failed.clear()
+        if _handler_id is not None:
+            events.deregister(_handler_id)
+            _handler_id = None
+
+
+def failed_ranks() -> set[int]:
+    with _lock:
+        return set(_failed)
+
+
+def shrink(comm) -> Any:
+    """MPI_Comm_shrink: a new communicator over the ranks of `comm`
+    whose world ranks are not known-failed."""
+    dead = failed_ranks()
+    survivors = [
+        wr for wr in comm.group.world_ranks if wr not in dead
+    ]
+    if not survivors:
+        raise CommError(f"{comm.name}: no surviving ranks")
+    if len(survivors) == comm.size:
+        return comm.dup()
+    from .. import api
+
+    world = api.world()
+    new = world.create(Group(survivors))
+    new.set_name(f"{comm.name}.shrunk")
+    SPC.record("ft_shrinks")
+    logger.info(
+        "shrink %s: %d -> %d ranks (failed: %s)",
+        comm.name, comm.size, new.size, sorted(dead),
+    )
+    return new
+
+
+def agree(comm, flags) -> bool:
+    """MPIX_Comm_agree's role: logical AND over the SURVIVING ranks'
+    flags (failed ranks cannot veto)."""
+    dead = failed_ranks()
+    vals = [
+        bool(flags[r])
+        for r, wr in enumerate(comm.group.world_ranks)
+        if wr not in dead
+    ]
+    if not vals:
+        raise CommError(f"{comm.name}: no survivors to agree")
+    return all(vals)
+
+
+def respawn(comm, manager, *, like: Any = None) -> tuple[Any, Any, dict]:
+    """Recovery loop: shrink to survivors, restore the latest snapshot
+    placed for the shrunken communicator. Returns (new_comm, state,
+    meta). `like` is the state template; leading-axis rank-major leaves
+    are resharded onto the surviving devices automatically."""
+    new_comm = shrink(comm)
+    if like is not None:
+        import jax
+
+        def replace(leaf):
+            # rank-major leaves follow the new comm's size/sharding
+            if (hasattr(leaf, "shape") and leaf.ndim >= 1
+                    and leaf.shape[0] == comm.size):
+                import numpy as np
+
+                return np.zeros(
+                    (new_comm.size,) + tuple(leaf.shape[1:]),
+                    getattr(leaf, "dtype", np.float32),
+                )
+            return leaf
+
+        like = jax.tree.map(replace, like)
+    state, meta = manager.restore(like=None)
+    # re-place restored host arrays: rank-major entries shrink to the
+    # survivor count by dropping failed ranks' blocks
+    dead = failed_ranks()
+    keep = [
+        i for i, wr in enumerate(comm.group.world_ranks)
+        if wr not in dead
+    ]
+
+    def reshard(key, value):
+        import numpy as np
+
+        arr = np.asarray(value)
+        if arr.ndim >= 1 and arr.shape[0] == comm.size:
+            return new_comm.put_rank_major(arr[keep])
+        return value
+
+    if isinstance(state, dict):
+        state = {k: reshard(k, v) for k, v in state.items()}
+    SPC.record("ft_respawns")
+    events.raise_event(
+        events.EventClass.RESTART, recovered=True,
+        survivors=new_comm.size,
+    )
+    return new_comm, state, meta

@@ -262,3 +262,82 @@ def test_ckpt_cli(tmp_path, capsys):
     assert '"step": 2' in doc
     assert cli.main(["prune", d, "--keep", "1"]) == 0
     assert m.steps() == [2]
+
+
+# -- elastic recovery (shrink/agree/respawn; past-reference: no ULFM
+# in the snapshot, SURVEY §5.3) --------------------------------------------
+
+def test_shrink_excludes_failed(comm):
+    from ompi_tpu.ft import elastic
+
+    elastic.enable()
+    try:
+        events.inject(world_rank=1)
+        assert 1 in elastic.failed_ranks()
+        new = elastic.shrink(comm)
+        assert new.size == comm.size - 1
+        assert 1 not in new.group.world_ranks
+        # the shrunken comm is fully operational
+        out = np.asarray(
+            new.allreduce(
+                new.put_rank_major(np.ones((new.size, 2), np.float32))
+            )
+        )
+        np.testing.assert_array_equal(out[0], [new.size, new.size])
+    finally:
+        elastic.reset()
+
+
+def test_shrink_noop_without_failures(comm):
+    from ompi_tpu.ft import elastic
+
+    elastic.enable()
+    try:
+        new = elastic.shrink(comm)
+        assert new.size == comm.size
+    finally:
+        elastic.reset()
+
+
+def test_agree_ignores_failed_votes(comm):
+    from ompi_tpu.ft import elastic
+
+    elastic.enable()
+    try:
+        flags = [True] * comm.size
+        flags[2] = False  # rank 2 votes no...
+        assert elastic.agree(comm, flags) is False
+        events.inject(world_rank=2)  # ...then dies: its veto vanishes
+        assert elastic.agree(comm, flags) is True
+    finally:
+        elastic.reset()
+
+
+def test_respawn_restores_and_reshards(tmp_path, comm):
+    from ompi_tpu.ft import elastic
+    from ompi_tpu.ft.manager import CheckpointManager
+
+    elastic.enable()
+    try:
+        m = CheckpointManager(str(tmp_path / "el"))
+        state = {
+            "w": np.stack([
+                np.full(3, r, np.float32) for r in range(comm.size)
+            ]),
+            "step_count": np.int32(9),
+        }
+        m.save(1, state, comm=comm)
+        events.inject(world_rank=0)
+        new_comm, restored, meta = elastic.respawn(comm, m)
+        assert meta["step"] == 1
+        assert new_comm.size == comm.size - 1
+        w = np.asarray(restored["['w']"])
+        # rank 0's block dropped; survivors keep theirs in order
+        np.testing.assert_array_equal(
+            w, np.stack([
+                np.full(3, r, np.float32)
+                for r in range(1, comm.size)
+            ])
+        )
+    finally:
+        elastic.reset()
