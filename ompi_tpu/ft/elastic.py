@@ -51,14 +51,26 @@ def enable() -> None:
             )
 
 
-def reset() -> None:
-    """Forget recorded failures (after a successful recovery)."""
-    global _handler_id
+def clear_failures() -> None:
+    """Forget recorded failures after a successful recovery; tracking
+    STAYS enabled so the next failure is still caught."""
     with _lock:
         _failed.clear()
+
+
+def disable() -> None:
+    """Stop tracking entirely (test teardown)."""
+    global _handler_id
+    with _lock:
         if _handler_id is not None:
             events.deregister(_handler_id)
             _handler_id = None
+
+
+def reset() -> None:
+    """Full teardown: forget failures AND stop tracking."""
+    clear_failures()
+    disable()
 
 
 def failed_ranks() -> set[int]:
@@ -66,10 +78,12 @@ def failed_ranks() -> set[int]:
         return set(_failed)
 
 
-def shrink(comm) -> Any:
+def shrink(comm, *, dead: Optional[set] = None) -> Any:
     """MPI_Comm_shrink: a new communicator over the ranks of `comm`
-    whose world ranks are not known-failed."""
-    dead = failed_ranks()
+    whose world ranks are not known-failed. `dead` lets callers pin
+    one failure snapshot across several derived computations."""
+    if dead is None:
+        dead = failed_ranks()
     survivors = [
         wr for wr in comm.group.world_ranks if wr not in dead
     ]
@@ -105,37 +119,26 @@ def agree(comm, flags) -> bool:
 
 
 def respawn(comm, manager, *, like: Any = None) -> tuple[Any, Any, dict]:
-    """Recovery loop: shrink to survivors, restore the latest snapshot
-    placed for the shrunken communicator. Returns (new_comm, state,
-    meta). `like` is the state template; leading-axis rank-major leaves
-    are resharded onto the surviving devices automatically."""
-    new_comm = shrink(comm)
-    if like is not None:
-        import jax
+    """Recovery loop: shrink to survivors and restore the latest
+    snapshot with every rank-major leaf resharded onto the surviving
+    devices (failed ranks' blocks dropped). Returns (new_comm, state,
+    meta). `like` is the ORIGINAL state template (as saved) and gives
+    the restored state its pytree structure; without it the arrays-CRS
+    flat {keypath: array} dict is resharded in place. The failure set
+    is snapshotted once so a failure arriving mid-recovery cannot
+    desynchronize the survivor list from the resharding."""
+    import jax
 
-        def replace(leaf):
-            # rank-major leaves follow the new comm's size/sharding
-            if (hasattr(leaf, "shape") and leaf.ndim >= 1
-                    and leaf.shape[0] == comm.size):
-                import numpy as np
-
-                return np.zeros(
-                    (new_comm.size,) + tuple(leaf.shape[1:]),
-                    getattr(leaf, "dtype", np.float32),
-                )
-            return leaf
-
-        like = jax.tree.map(replace, like)
-    state, meta = manager.restore(like=None)
-    # re-place restored host arrays: rank-major entries shrink to the
-    # survivor count by dropping failed ranks' blocks
     dead = failed_ranks()
+    new_comm = shrink(comm, dead=dead)
     keep = [
         i for i, wr in enumerate(comm.group.world_ranks)
         if wr not in dead
     ]
+    # manager.restore raises the RESTART event itself
+    state, meta = manager.restore(like=like)
 
-    def reshard(key, value):
+    def reshard(value):
         import numpy as np
 
         arr = np.asarray(value)
@@ -143,11 +146,8 @@ def respawn(comm, manager, *, like: Any = None) -> tuple[Any, Any, dict]:
             return new_comm.put_rank_major(arr[keep])
         return value
 
-    if isinstance(state, dict):
-        state = {k: reshard(k, v) for k, v in state.items()}
+    # works for any pytree: the caller's structure (like=...) or the
+    # arrays-CRS flat dict (dicts are pytrees)
+    state = jax.tree.map(reshard, state)
     SPC.record("ft_respawns")
-    events.raise_event(
-        events.EventClass.RESTART, recovered=True,
-        survivors=new_comm.size,
-    )
     return new_comm, state, meta

@@ -341,3 +341,50 @@ def test_respawn_restores_and_reshards(tmp_path, comm):
         )
     finally:
         elastic.reset()
+
+
+def test_clear_failures_keeps_tracking(comm):
+    from ompi_tpu.ft import elastic
+
+    elastic.enable()
+    try:
+        events.inject(world_rank=3)
+        assert 3 in elastic.failed_ranks()
+        elastic.clear_failures()
+        assert not elastic.failed_ranks()
+        # tracking must survive the clear: the NEXT failure is caught
+        events.inject(world_rank=4)
+        assert 4 in elastic.failed_ranks()
+    finally:
+        elastic.reset()
+
+
+def test_respawn_with_pytree_template(tmp_path, comm):
+    from ompi_tpu.ft import elastic
+    from ompi_tpu.ft.manager import CheckpointManager
+
+    elastic.enable()
+    try:
+        m = CheckpointManager(str(tmp_path / "el2"))
+        state = {
+            "params": {
+                "w": np.stack([
+                    np.full(2, r, np.float32) for r in range(comm.size)
+                ]),
+            },
+            "lr": np.float32(0.1),
+        }
+        m.save(1, state, comm=comm)
+        events.inject(world_rank=comm.size - 1)
+        restarts = []
+        events.register(events.EventClass.RESTART,
+                        lambda ev: restarts.append(ev))
+        new_comm, restored, meta = elastic.respawn(comm, m, like=state)
+        # original pytree structure back, rank-major leaf resharded
+        w = np.asarray(restored["params"]["w"])
+        assert w.shape == (comm.size - 1, 2)
+        np.testing.assert_array_equal(w[:, 0], np.arange(comm.size - 1))
+        assert float(restored["lr"]) == np.float32(0.1)
+        assert len(restarts) == 1  # exactly one RESTART per respawn
+    finally:
+        elastic.reset()
