@@ -54,16 +54,20 @@ def _device_seconds_per_iter(make_chained, iters: int = K_BASE,
     return float(np.median(diffs))
 
 
-def _cpu_reduce_gbps(n_ranks: int, elems: int) -> float:
-    """The reference's op path: CPU loop-of-SIMD-adds over rank blocks."""
+def _cpu_reduce_gbps(n_ranks: int, elems: int, repeats: int = 3) -> float:
+    """The reference's op path: CPU loop-of-SIMD-adds over rank blocks.
+    Best of `repeats` (first run pays page-fault/cache warmup, which
+    would flatter vs_baseline — take the reference at its fastest)."""
     host = np.ones((n_ranks, elems), np.float32)
-    t0 = time.perf_counter()
-    acc = host[0].copy()
-    for i in range(1, n_ranks):
-        acc += host[i]
-    cpu_t = time.perf_counter() - t0
     read_bytes = n_ranks * elems * 4
-    return read_bytes / cpu_t / 1e9
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        acc = host[0].copy()
+        for i in range(1, n_ranks):
+            acc += host[i]
+        best = min(best, time.perf_counter() - t0)
+    return read_bytes / best / 1e9
 
 
 def bench_single_chip() -> dict:
