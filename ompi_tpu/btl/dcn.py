@@ -231,18 +231,15 @@ class DcnBtl(BtlComponent):
             )
 
     def transfer(self, value, src_proc, dst_proc):
-        import jax
-
-        ep = self.endpoint()
-        peer = self._peer_ids.get(dst_proc.process_index)
-        if peer is None:
-            raise CommError(
-                f"no DCN wiring to process {dst_proc.process_index}"
-            )
-        leaves = jax.tree.leaves(value)
-        for leaf in leaves:
-            host = np.asarray(leaf)
-            ep.send_bytes(peer, 0, host.tobytes())
-        # Cross-process delivery completes on the remote side; the local
-        # return value mirrors the reference's send-side completion.
-        return value
+        # Cross-process delivery needs the full MPI envelope + matching
+        # on the receiving controller — that is pml/fabric's job (it
+        # serializes treedef/dtypes/shapes and reassembles remotely).
+        # A bare BTL transfer cannot return the remote value locally,
+        # so rather than silently returning the un-transferred input
+        # (round-1 behavior), fail with the right pointer.
+        raise CommError(
+            f"DcnBtl.transfer cannot deliver to process "
+            f"{dst_proc.process_index} directly: cross-process p2p goes "
+            "through the PML fabric (ompi_tpu.pml.fabric.wire_up); "
+            "byte-level DCN sends are available via DcnEndpoint"
+        )

@@ -89,14 +89,31 @@ class ArraysCrs(CrsComponent):
         }
         with open(os.path.join(tmp, "meta.json"), "w") as f:
             json.dump(doc, f)
+        # Crash-safe replacement: the previous snapshot is moved aside
+        # (not deleted) before the new one lands, so at every instant
+        # either `path` or `path + ".old"` holds a complete snapshot —
+        # including when recovering from a crash that left only `.old`
+        # (then `.old` must survive until the new snapshot is in place).
+        old = path + ".old"
         if os.path.exists(path):
-            shutil.rmtree(path)
+            if os.path.exists(old):
+                shutil.rmtree(old)
+            os.rename(path, old)
         os.rename(tmp, path)
+        shutil.rmtree(old, ignore_errors=True)
         SPC.record("ft_checkpoints_saved")
 
     def load(self, path: str, like: Any = None) -> tuple[Any, dict]:
         import jax
 
+        # save() guarantees that at every instant either `path` or
+        # `path + ".old"` holds a complete snapshot — consume that
+        # guarantee: fall back to .old when a crash landed between the
+        # two renames.
+        if not os.path.exists(os.path.join(path, "meta.json")):
+            old = path + ".old"
+            if os.path.exists(os.path.join(old, "meta.json")):
+                path = old
         with open(os.path.join(path, "meta.json")) as f:
             doc = json.load(f)
         if doc.get("format") != "ompi_tpu.crs.arrays.v1":

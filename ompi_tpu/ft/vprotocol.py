@@ -89,21 +89,33 @@ class PessimistPml(PmlComponent):
 
     # -- send side ---------------------------------------------------------
 
-    def _log_send(self, comm, value, dest, tag, source, req) -> None:
+    def isend(self, comm, value, dest, tag, source=None):
         import jax
 
+        # Sender-based logging MUST precede the host send: when a
+        # matching recv is already posted, ob1 delivers synchronously
+        # inside host.isend and the delivery callback must find the
+        # send already in the log (else it records seq=-1 and replay
+        # fails for the recv-before-send pattern).
+        infer = getattr(self.host, "_infer_source", None)
+        src = infer(comm, value, source) if infer is not None else source
         host_copy = jax.tree.map(lambda l: np.asarray(l), value)
         with self._lock:
             seq = next(self._seq)
-            self.log.sends.append(
-                SendEvent(seq, req.env.src, dest, tag, host_copy)
-            )
-            self._req_seq[id(req)] = seq
+            ev = SendEvent(seq, src, dest, tag, host_copy)
+            self.log.sends.append(ev)
         SPC.record("vprotocol_sends_logged")
-
-    def isend(self, comm, value, dest, tag, source=None):
-        req = self.host.isend(comm, value, dest, tag, source=source)
-        self._log_send(comm, value, dest, tag, source, req)
+        try:
+            req = self.host.isend(comm, value, dest, tag, source=source)
+        except Exception:
+            with self._lock:
+                try:
+                    self.log.sends.remove(ev)
+                except ValueError:
+                    pass
+            raise
+        with self._lock:
+            self._req_seq[id(req)] = seq
         return req
 
     def send(self, comm, value, dest, tag, source=None):

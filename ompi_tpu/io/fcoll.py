@@ -262,24 +262,19 @@ class DynamicFcoll(TwoPhaseFcoll):
         n = _num_aggr.value or max(1, n_ranks // 4)
         per = -(-total // n)
         domains, acc = [], 0
-        start = merged[0][0]
+        start = None
         for off, ln in merged:
+            if start is None:
+                start = off
             acc += ln
             if acc >= per:
                 domains.append((start, off + ln))
                 start = None
                 acc = 0
-        if start is not None and merged:
+        if start is not None:
+            # tail runs that never reached the per-aggregator quota
             domains.append((start, merged[-1][0] + merged[-1][1]))
-        # re-anchor starts at the next interval after each cut
-        fixed = []
-        prev_end = None
-        for lo, hi in domains:
-            if lo is None or (prev_end is not None and lo < prev_end):
-                lo = prev_end
-            fixed.append((lo, hi))
-            prev_end = hi
-        return [(lo, hi) for lo, hi in fixed if lo is not None and lo < hi]
+        return [(lo, hi) for lo, hi in domains if lo < hi]
 
     def write_all(self, fh, accesses, buffers) -> None:
         domains = self._domains_by_volume(accesses, len(accesses))

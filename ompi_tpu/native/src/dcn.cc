@@ -576,6 +576,7 @@ long long dcn_send(void* vc, int peer, long long tag, const void* buf,
   OutMsg m;
   m.peer = peer;
   m.tag = tag;
+  m.total_len = len;
   m.data.assign(static_cast<const char*>(buf),
                 static_cast<const char*>(buf) + len);
   if (len <= c->eager_limit.load()) {

@@ -49,6 +49,10 @@ class SendRequest(Request):
         self.env = env
         self.status = Status(source=env.src, tag=env.tag, count=env.nbytes)
         self._payload_dst: Any = None
+        #: True when a remote controller can complete this request
+        #: (cross-process rendezvous): blocking waits then pump the
+        #: progress engine instead of failing fast.
+        self.block_on_progress = False
 
     def _mark_sent(self, payload_dst: Any) -> None:
         self._payload_dst = payload_dst
@@ -59,6 +63,12 @@ class SendRequest(Request):
 
     def wait(self, timeout: float | None = None) -> Status:
         if not self.done:
+            if self.block_on_progress:
+                from . import fabric as _fabric
+
+                to = timeout if timeout is not None \
+                    else _fabric.default_timeout()
+                return super().wait(to)
             # A rendezvous send completes only when a recv matches it. In
             # the single-controller model every recv is issued by this
             # same driver thread, so an unmatched blocking wait can never
@@ -77,6 +87,11 @@ class RecvRequest(Request):
         self.want_src = src
         self.dst = dst
         self.want_tag = tag
+        #: True when the matching send may arrive from another
+        #: controller process (comm spans processes): blocking waits
+        #: pump the progress engine (which drains the fabric) instead
+        #: of failing fast.
+        self.block_on_progress = False
 
     def _matched(self, env: _Envelope, payload: Any) -> None:
         self.status = Status(source=env.src, tag=env.tag, count=env.nbytes)
@@ -87,14 +102,23 @@ class RecvRequest(Request):
 
     def wait(self, timeout: float | None = None) -> Status:
         if not self.done:
-            # Same single-controller deadlock guard as SendRequest.wait:
-            # no concurrent sender exists to match this recv later.
-            raise CommError(
-                f"recv (src={self.want_src}, dst={self.dst}, "
-                f"tag={self.want_tag}) has no matching send: blocking wait "
-                "would deadlock (issue the send first)"
-            )
-        st = super().wait(timeout)
+            if self.block_on_progress:
+                from . import fabric as _fabric
+
+                to = timeout if timeout is not None \
+                    else _fabric.default_timeout()
+                st = super().wait(to)
+            else:
+                # Same single-controller deadlock guard as
+                # SendRequest.wait: no concurrent sender exists to match
+                # this recv later.
+                raise CommError(
+                    f"recv (src={self.want_src}, dst={self.dst}, "
+                    f"tag={self.want_tag}) has no matching send: blocking "
+                    "wait would deadlock (issue the send first)"
+                )
+        else:
+            st = super().wait(timeout)
         # Data completion: block until the transferred arrays are ready.
         import jax
 
@@ -109,10 +133,18 @@ class _PendingSend:
     payload_src: Any  # value still on source device (rndv) or dest (eager)
     eager: bool
     transferred: Any  # destination-device value once moved
-    request: SendRequest
+    request: Optional[SendRequest]  # None for remote arrivals (the
+    # SendRequest lives on the sending controller)
     src_proc: Any
     dst_proc: Any
     btl: Any
+    # -- cross-process arrivals (pml/fabric) --
+    remote: bool = False
+    fabric: Any = None
+    src_idx: int = -1  # sending controller's process index
+    seq: int = -1      # fabric stream sequence number
+    payload_bytes: Any = None  # packed eager payload (unpacked at match)
+    comm_cid: int = -1
 
 
 class _CommP2P:
@@ -148,8 +180,23 @@ class Ob1Pml(PmlComponent):
         super().__init__(framework)
         self._comm_state: dict[int, _CommP2P] = {}
         self._bml: dict[int, Bml] = {}
+        self._fabric = None  # cross-process engine (pml/fabric)
 
     # -- infrastructure ---------------------------------------------------
+
+    def attach_fabric(self, engine) -> None:
+        """Arm cross-process p2p (called by fabric.wire_up)."""
+        self._fabric = engine
+
+    @staticmethod
+    def _my_index() -> int:
+        import jax
+
+        return jax.process_index()
+
+    def _spans_processes(self, comm) -> bool:
+        mine = self._my_index()
+        return any(p.process_index != mine for p in comm.procs)
 
     def _state(self, comm) -> _CommP2P:
         st = self._comm_state.get(comm.cid)
@@ -198,6 +245,33 @@ class Ob1Pml(PmlComponent):
             raise TagError(f"send tag must be >= 0, got {tag}")
         src = self._infer_source(comm, value, source)
         st = self._state(comm)
+        mine = self._my_index()
+        dst_proc = comm.procs[dest]
+        if dst_proc.process_index != mine:
+            # Destination rank lives on another controller: the MPI
+            # envelope crosses the process boundary via the fabric and
+            # matching runs on the receiving controller.
+            if comm.procs[src].process_index != mine:
+                raise RankError(
+                    f"send from rank {src} must be issued by its owning "
+                    f"process {comm.procs[src].process_index}, not {mine}"
+                )
+            if self._fabric is None:
+                raise CommError(
+                    f"rank {dest} is owned by process "
+                    f"{dst_proc.process_index} but cross-process p2p is "
+                    "not wired; call ompi_tpu.pml.fabric.wire_up() on "
+                    "every controller"
+                )
+            SPC.record("pml_isend_calls")
+            SPC.record("pml_send_bytes", _nbytes_of(value))
+            from ..monitoring import MONITOR
+
+            MONITOR.record_p2p(comm.cid, src, dest, _nbytes_of(value))
+            from ..core import memchecker
+
+            memchecker.check_defined(value, "send buffer")
+            return self._fabric.isend_remote(comm, src, dest, tag, value)
         env = _Envelope(
             src=src, dst=dest, tag=tag, nbytes=_nbytes_of(value)
         )
@@ -255,7 +329,17 @@ class Ob1Pml(PmlComponent):
         dest = comm.check_rank(dest)
         if source != ANY_SOURCE:
             source = comm.check_rank(source)
+        mine = self._my_index()
+        if comm.procs[dest].process_index != mine:
+            raise RankError(
+                f"recv for rank {dest} must be posted on its owning "
+                f"process {comm.procs[dest].process_index}, not {mine}"
+            )
         req = RecvRequest(source, dest, tag)
+        if self._fabric is not None and self._spans_processes(comm):
+            # The matching send may arrive from another controller —
+            # blocking waits pump the fabric instead of failing fast.
+            req.block_on_progress = True
         st = self._state(comm)
         SPC.record("pml_irecv_calls")
         from ..core import peruse
@@ -296,6 +380,22 @@ class Ob1Pml(PmlComponent):
             peruse.PeruseEvent.REQ_MATCH,
             env=pending.env, recv=req,
         )
+        if pending.remote:
+            if pending.payload_bytes is not None:
+                # Remote eager: the packed payload arrived with the
+                # envelope; it lands on the destination device now.
+                value = pending.fabric.place(
+                    pending.payload_bytes, pending.dst_proc
+                )
+                req._matched(pending.env, value)
+            else:
+                # Remote rendezvous: answer CTS; the recv completes when
+                # the DATA message lands (pulled by fabric.progress).
+                peruse.fire(
+                    peruse.PeruseEvent.REQ_XFER_BEGIN, env=pending.env
+                )
+                pending.fabric.request_payload(pending, req)
+            return
         if pending.transferred is None:
             # Rendezvous: move the payload now that the recv is matched.
             peruse.fire(
@@ -306,6 +406,28 @@ class Ob1Pml(PmlComponent):
             )
             pending.request._mark_sent(pending.transferred)
         req._matched(pending.env, pending.transferred)
+
+    def _remote_arrival(self, comm, env: _Envelope, *, fabric, src_idx: int,
+                        seq: int, payload_bytes) -> None:
+        """An MPI envelope arrived from another controller (called by
+        fabric.progress in stream order): run receive-side matching
+        exactly as the reference does on the target process
+        (pml_ob1_recvfrag.c:323 — match_one against posted recvs, park
+        in the unexpected queue otherwise)."""
+        st = self._state(comm)
+        pending = _PendingSend(
+            env=env, payload_src=None, eager=payload_bytes is not None,
+            transferred=None, request=None,
+            src_proc=comm.procs[env.src], dst_proc=comm.procs[env.dst],
+            btl=None, remote=True, fabric=fabric, src_idx=src_idx,
+            seq=seq, payload_bytes=payload_bytes, comm_cid=comm.cid,
+        )
+        SPC.record("pml_remote_arrivals")
+        from ..core import peruse
+
+        if not self._match_posted(st, pending):
+            st.unexpected.append(pending)
+            peruse.fire(peruse.PeruseEvent.QUEUE_UNEXPECTED, env=env)
 
     def _match_posted(self, st: _CommP2P, pending: _PendingSend) -> bool:
         from ..core.request import RequestState
@@ -332,25 +454,63 @@ class Ob1Pml(PmlComponent):
               blocking: bool = True) -> Optional[Status]:
         if dest is None:
             raise RankError("driver-mode probe needs dest=")
+        mine = self._my_index()
+        if comm.procs[comm.check_rank(dest)].process_index != mine:
+            raise RankError(
+                f"probe for rank {dest} must run on its owning process "
+                f"{comm.procs[dest].process_index}, not {mine}"
+            )
         st = self._state(comm)
         probe_req = RecvRequest(
             source if source == ANY_SOURCE else comm.check_rank(source),
             comm.check_rank(dest),
             tag,
         )
-        for pending in st.unexpected:
-            if self._compatible(probe_req, pending.env):
-                return Status(
-                    source=pending.env.src,
-                    tag=pending.env.tag,
-                    count=pending.env.nbytes,
-                )
-        if blocking:
-            raise TagError(
-                "blocking probe would deadlock: no matching message and the "
-                "driver controls all sends; use iprobe"
+
+        def scan() -> Optional[Status]:
+            for pending in st.unexpected:
+                if self._compatible(probe_req, pending.env):
+                    return Status(
+                        source=pending.env.src,
+                        tag=pending.env.tag,
+                        count=pending.env.nbytes,
+                    )
+            return None
+
+        fabric_armed = (
+            self._fabric is not None and self._spans_processes(comm)
+        )
+        if fabric_armed:
+            # Remote envelopes surface via the progress engine.
+            from ..core import progress as _prog
+
+            _prog.progress()
+        found = scan()
+        if found is not None or not blocking:
+            return found
+        if fabric_armed:
+            # A matching envelope can still arrive from another
+            # controller: block on the progress engine (MPI_Probe).
+            from . import fabric as _fabric
+            from ..core import progress as _prog
+
+            box: list[Optional[Status]] = [None]
+
+            def check() -> bool:
+                box[0] = scan()
+                return box[0] is not None
+
+            if _prog.ENGINE.progress_until(check,
+                                           _fabric.default_timeout()):
+                return box[0]
+            raise CommError(
+                f"probe (src={source}, dst={dest}, tag={tag}) timed out "
+                "waiting for a cross-process message"
             )
-        return None
+        raise TagError(
+            "blocking probe would deadlock: no matching message and the "
+            "driver controls all sends; use iprobe"
+        )
 
     # -- matched probe (MPI_Mprobe/Mrecv; reference: ompi/message +
     # the mprobe entry in the pml module struct, pml.h:134-358) -------
@@ -362,6 +522,16 @@ class Ob1Pml(PmlComponent):
         can steal it — the matched-probe guarantee)."""
         if dest is None:
             raise RankError("driver-mode improbe needs dest=")
+        mine = self._my_index()
+        if comm.procs[comm.check_rank(dest)].process_index != mine:
+            raise RankError(
+                f"improbe for rank {dest} must run on its owning process "
+                f"{comm.procs[dest].process_index}, not {mine}"
+            )
+        if self._fabric is not None and self._spans_processes(comm):
+            from ..core import progress as _prog
+
+            _prog.progress()
         st = self._state(comm)
         probe_req = RecvRequest(
             source if source == ANY_SOURCE else comm.check_rank(source),

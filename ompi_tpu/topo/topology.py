@@ -5,9 +5,9 @@ topo_base_cart_create.c and friends; treematch rank reordering in
 ompi/mca/topo/treematch). Topologies attach to a communicator and give
 rank↔coordinate mapping, neighbor enumeration (the substrate for halo
 exchange / neighbor collectives, reference coll_base_functions.h:62-66),
-and hardware-aware reordering: `reorder=True` maps the requested
-neighbor structure onto ICI-adjacent devices using the runtime's
-coordinates (the treematch analog, via runtime.mesh.ring_order).
+and hardware-aware reordering: `reorder=True` runs the real treematch
+algorithm (topo/treematch.py) — the requested neighbor structure is
+matched onto the ICI coordinates, minimizing weighted hop distance.
 """
 
 from __future__ import annotations
@@ -17,7 +17,6 @@ from typing import Optional, Sequence
 import numpy as np
 
 from ..core.errors import ArgumentError, TopologyError
-from ..runtime import mesh as mesh_mod
 
 
 class CartTopology:
@@ -193,22 +192,41 @@ def dims_create(nnodes: int, ndims: int) -> tuple[int, ...]:
     return tuple(sorted(dims, reverse=True))
 
 
+def _reordered(comm, topo_of) -> Optional[object]:
+    """Treematch reorder: build the requested topology's comm graph on
+    the ORIGINAL rank order, match it to the ICI coordinates, and return
+    a comm whose rank order realizes the matching (reference:
+    ompi/mca/topo/treematch tm_mapping.c; None = identity was optimal).
+    """
+    from . import treematch as tm
+
+    probe = topo_of(comm)  # neighbor structure only; not attached
+    W = tm.comm_graph_weights(comm, topo=probe)
+    if not W.any():
+        return None
+    order = tm.reorder_ranks(comm, W=W)
+    if order == list(comm.group.world_ranks):
+        return None
+    from ..group import Group
+
+    return comm.create(Group(order))
+
+
 def cart_create(comm, dims: Sequence[int],
                 periods: Optional[Sequence[bool]] = None,
                 reorder: bool = False):
     """MPI_Cart_create: returns a new communicator with `.topo` set.
 
-    reorder=True permutes ranks so that walking the cartesian row-major
-    order follows ICI-adjacent devices (treematch analog)."""
+    reorder=True runs treematch: ranks are permuted so the cartesian
+    neighbor graph maps onto ICI-close devices (weighted-hop-distance
+    minimizing; topo/treematch.py)."""
     if periods is None:
         periods = [False] * len(dims)
     new = None
     if reorder:
-        order = mesh_mod.ring_order(comm.procs)
-        if order != [p.rank for p in comm.procs]:
-            from ..group import Group
-
-            new = comm.create(Group(order))
+        new = _reordered(
+            comm, lambda c: CartTopology(c, dims, periods)
+        )
     if new is None:
         new = comm.dup()
     new.topo = CartTopology(new, dims, periods)
@@ -218,16 +236,31 @@ def cart_create(comm, dims: Sequence[int],
 
 def graph_create(comm, index: Sequence[int], edges: Sequence[int],
                  reorder: bool = False):
-    # reorder is advisory in MPI; no graph-aware reorder is implemented
-    # (the reference's treematch analog only drives cart_create), so an
-    # unreordered communicator is returned either way.
-    new = comm.dup()
+    """MPI_Graph_create; reorder=True treematches the explicit adjacency
+    onto the ICI coordinates (the reference's treematch consumes exactly
+    this graph form)."""
+    new = None
+    if reorder:
+        new = _reordered(
+            comm, lambda c: GraphTopology(c, index, edges)
+        )
+    if new is None:
+        new = comm.dup()
     new.topo = GraphTopology(new, index, edges)
     return new
 
 
-def dist_graph_create(comm, sources: dict, destinations: dict):
-    new = comm.dup()
+def dist_graph_create(comm, sources: dict, destinations: dict,
+                      reorder: bool = False):
+    """MPI_Dist_graph_create — the reference treematch's actual entry
+    point (mca_topo_treematch_dist_graph_create)."""
+    new = None
+    if reorder:
+        new = _reordered(
+            comm, lambda c: DistGraphTopology(c, sources, destinations)
+        )
+    if new is None:
+        new = comm.dup()
     new.topo = DistGraphTopology(new, sources, destinations)
     return new
 

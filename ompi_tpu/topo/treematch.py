@@ -1,0 +1,248 @@
+"""Treematch — rank reordering matching the comm graph to the ICI mesh.
+
+TPU-native equivalent of ompi/mca/topo/treematch (reference:
+treematch/tm_tree.h — hierarchical grouping of the communication matrix;
+tm_mapping.c — mapping grouped ranks onto the hardware tree, with
+exchange-based refinement). The reference builds an affinity tree over
+the comm matrix and matches it level-by-level against the hardware
+topology tree; this module does the same with TPU geometry:
+
+1. **hardware tree**: recursive bisection of the device slots along the
+   widest ICI coordinate dimension — the natural hierarchy of a TPU
+   mesh/torus (slice > plane > row > chip), standing in for the
+   hwloc tree treematch consumes.
+2. **affinity grouping**: at each tree node, ranks are partitioned to
+   the children's capacities maximizing intra-group communication
+   weight (greedy seeding + Kernighan-Lin-style swap refinement — the
+   tm_grouping analog with arity fixed by the hardware split).
+3. **refinement**: a final pairwise-exchange hill-climb on the exact
+   objective sum_ij W[i,j] * hop(slot_i, slot_j) (tm_mapping's exchange
+   pass).
+
+The objective is weighted hop distance over the ICI mesh (Manhattan,
+with per-dimension wraparound for torus links), i.e. congestion-free
+nearest-neighbor cost — the right first-order model for ICI, where each
+hop adds a store-and-forward latency and shares link bandwidth.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..core.counters import SPC
+
+
+def hop_distance(a: Sequence[int], b: Sequence[int],
+                 wrap_dims: Optional[Sequence[int]] = None) -> int:
+    """Manhattan hop count between ICI coordinates; `wrap_dims[d]` > 0
+    enables torus wraparound with that dimension size."""
+    total = 0
+    for d, (x, y) in enumerate(zip(a, b)):
+        diff = abs(int(x) - int(y))
+        if wrap_dims is not None and d < len(wrap_dims) and wrap_dims[d]:
+            diff = min(diff, int(wrap_dims[d]) - diff)
+        total += diff
+    return total
+
+
+def _distance_matrix(coords: Sequence[Sequence[int]],
+                     wrap_dims: Optional[Sequence[int]]) -> np.ndarray:
+    n = len(coords)
+    D = np.zeros((n, n), np.int64)
+    for i in range(n):
+        for j in range(i + 1, n):
+            D[i, j] = D[j, i] = hop_distance(
+                coords[i], coords[j], wrap_dims
+            )
+    return D
+
+
+def total_hop_weight(W: np.ndarray, D: np.ndarray,
+                     perm: Sequence[int]) -> float:
+    """Objective: sum of comm weight x hop distance under `perm`
+    (perm[rank] = hardware slot)."""
+    p = np.asarray(perm)
+    return float((W * D[np.ix_(p, p)]).sum()) / 2.0
+
+
+def _bisect_slots(slots: list[int], coords) -> tuple[list[int], list[int]]:
+    """Split slots into two halves along the widest coordinate spread —
+    one level of the hardware tree."""
+    cs = np.asarray([coords[s] for s in slots])
+    spread = cs.max(axis=0) - cs.min(axis=0)
+    dim = int(np.argmax(spread))
+    order = sorted(slots, key=lambda s: (coords[s][dim], tuple(coords[s])))
+    half = len(order) // 2
+    return order[:half], order[half:]
+
+
+def _partition_ranks(W: np.ndarray, ranks: list[int], size_a: int
+                     ) -> tuple[list[int], list[int]]:
+    """Partition `ranks` into (A of size_a, B) maximizing intra-group
+    weight: greedy affinity seeding + swap refinement (tm_grouping)."""
+    if size_a == 0:
+        return [], list(ranks)
+    ranks = list(ranks)
+    sub = W[np.ix_(ranks, ranks)].astype(np.float64)
+    # seed A with the heaviest-communicating pair's endpoint, then grow
+    # by max attraction to A minus attraction to the remainder
+    n = len(ranks)
+    in_a = np.zeros(n, bool)
+    seed = int(np.argmax(sub.sum(axis=1)))
+    in_a[seed] = True
+    while in_a.sum() < size_a:
+        gain = np.where(
+            in_a, -np.inf,
+            sub[:, in_a].sum(axis=1) - sub[:, ~in_a].sum(axis=1),
+        )
+        in_a[int(np.argmax(gain))] = True
+    # KL-style refinement: swap (a, b) pairs while intra-weight improves
+    improved = True
+    while improved:
+        improved = False
+        a_idx = np.where(in_a)[0]
+        b_idx = np.where(~in_a)[0]
+        # connection of each vertex to A and B
+        to_a = sub[:, in_a].sum(axis=1)
+        to_b = sub[:, ~in_a].sum(axis=1)
+        best_gain, best_pair = 0.0, None
+        for a in a_idx:
+            for b in b_idx:
+                # gain of swapping a<->b for intra-group weight
+                gain = (to_a[b] - to_b[b]) + (to_b[a] - to_a[a]) \
+                    - 2 * sub[a, b]
+                if gain > best_gain + 1e-12:
+                    best_gain, best_pair = gain, (a, b)
+        if best_pair is not None:
+            a, b = best_pair
+            in_a[a], in_a[b] = False, True
+            improved = True
+    A = [ranks[i] for i in np.where(in_a)[0]]
+    B = [ranks[i] for i in np.where(~in_a)[0]]
+    return A, B
+
+
+def _map_recursive(W: np.ndarray, ranks: list[int], slots: list[int],
+                   coords, assign: dict[int, int]) -> None:
+    if len(slots) <= 1 or len(set(map(tuple, (coords[s] for s in slots)))) == 1:
+        for r, s in zip(ranks, slots):
+            assign[r] = s
+        return
+    slots_a, slots_b = _bisect_slots(slots, coords)
+    ranks_a, ranks_b = _partition_ranks(W, ranks, len(slots_a))
+    _map_recursive(W, ranks_a, slots_a, coords, assign)
+    _map_recursive(W, ranks_b, slots_b, coords, assign)
+
+
+def _refine(W: np.ndarray, D: np.ndarray, perm: list[int],
+            max_rounds: int = 8) -> list[int]:
+    """Exchange hill climb on the exact objective (tm_mapping.c's
+    exchange refinement): pairwise swaps, plus 3-cycle rotations on
+    small comms to escape swap-stable local minima (a single swap
+    cannot unwind a rotated triangle; three-rank cycles can)."""
+    import itertools
+
+    n = len(perm)
+    perm = list(perm)
+
+    def swap_delta(i: int, j: int) -> float:
+        # O(n) exact cost change of swapping slots of ranks i and j:
+        # sum_{k != i,j} (W[i,k] - W[j,k]) (D[pj,pk] - D[pi,pk]);
+        # the (i,j) pair's own distance is unchanged by the swap.
+        p = np.asarray(perm)
+        vec = (W[i] - W[j]) * (D[perm[j], p] - D[perm[i], p])
+        return float(vec.sum() - vec[i] - vec[j])
+
+    for _ in range(max_rounds):
+        improved = False
+        for i in range(n):
+            for j in range(i + 1, n):
+                if swap_delta(i, j) < -1e-12:
+                    perm[i], perm[j] = perm[j], perm[i]
+                    improved = True
+        if not improved and n <= 32:
+            base = total_hop_weight(W, D, perm)
+            for i, j, k in itertools.permutations(range(n), 3):
+                cand = list(perm)
+                cand[i], cand[j], cand[k] = perm[j], perm[k], perm[i]
+                cost = total_hop_weight(W, D, cand)
+                if cost < base - 1e-12:
+                    perm = cand
+                    improved = True
+                    break
+        if not improved:
+            break
+    return perm
+
+
+def treematch_permutation(
+    W: np.ndarray,
+    coords: Sequence[Sequence[int]],
+    wrap_dims: Optional[Sequence[int]] = None,
+) -> list[int]:
+    """Compute perm[rank] = hardware slot minimizing weighted hop
+    distance. W is the (n, n) symmetric comm-weight matrix; coords[s]
+    the ICI coordinates of slot s."""
+    W = np.asarray(W, np.float64)
+    n = W.shape[0]
+    if W.shape != (n, n) or len(coords) != n:
+        raise ValueError(
+            f"need square W and one coord per slot: W{W.shape}, "
+            f"{len(coords)} coords"
+        )
+    W = (W + W.T) / 2.0  # symmetrize: hops are undirected
+    np.fill_diagonal(W, 0.0)  # self-traffic never crosses a link
+    assign: dict[int, int] = {}
+    _map_recursive(W, list(range(n)), list(range(n)), coords, assign)
+    perm = [assign[r] for r in range(n)]
+    D = _distance_matrix(coords, wrap_dims)
+    perm = _refine(W, D, perm)
+    SPC.record("topo_treematch_reorders")
+    return perm
+
+
+def comm_graph_weights(comm, topo=None) -> np.ndarray:
+    """Comm-weight matrix from an attached topology's neighbor lists
+    (unit weight per neighbor edge — the cart/graph creation case; the
+    monitoring matrix can be passed to treematch_permutation directly
+    for measured-traffic reordering)."""
+    n = comm.size
+    W = np.zeros((n, n), np.float64)
+    src = topo if topo is not None else comm.topo
+    if src is None:
+        return W
+    if hasattr(src, "neighbors"):
+        for r in range(n):
+            for nb in src.neighbors(r):
+                W[r, nb] += 1.0
+    else:  # DistGraphTopology: directed out-edges
+        for r in range(n):
+            for nb in src.out_neighbors(r):
+                W[r, nb] += 1.0
+    return W
+
+
+def proc_coords(procs) -> tuple[list[tuple[int, ...]], None]:
+    """Coordinates for a proc list; linear positions when the platform
+    exposes none (CPU test meshes) so distance degrades to rank
+    distance."""
+    if procs and procs[0].coords is not None:
+        return [tuple(p.coords) for p in procs], None
+    return [(i,) for i in range(len(procs))], None
+
+
+def reorder_ranks(comm, W: Optional[np.ndarray] = None,
+                  wrap_dims: Optional[Sequence[int]] = None) -> list[int]:
+    """World-rank order for a reordered communicator: rank i of the new
+    comm is placed on the slot treematch assigns it (reference entry:
+    mca_topo_treematch_dist_graph_create)."""
+    coords, _ = proc_coords(comm.procs)
+    if W is None:
+        W = comm_graph_weights(comm)
+    perm = treematch_permutation(W, coords, wrap_dims)
+    # perm[rank] = slot. The reordered communicator's rank r must sit on
+    # the device currently at parent slot perm[r], so the new Group
+    # lists, in new-rank order, the world rank owning that slot.
+    return [comm.group.world_rank(perm[r]) for r in range(comm.size)]

@@ -1,0 +1,227 @@
+"""Cross-process MPI p2p over the DCN fabric.
+
+Matches VERDICT round-1 item 3: tagged send/recv + wildcard probe
+across controller processes, with the MPI envelope (cid,src,dst,tag,seq)
+on the wire and matching on the receiving controller (reference:
+pml_ob1_recvfrag.c:323-412 over btl_tcp).
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+from ompi_tpu.native import build
+
+pytestmark = pytest.mark.skipif(
+    not build.available(), reason="native library unavailable"
+)
+
+
+# -- unit: payload wire format ---------------------------------------------
+
+def test_pack_unpack_roundtrip_pytree():
+    from ompi_tpu.pml import fabric
+
+    value = {
+        "w": np.arange(6, dtype=np.float32).reshape(2, 3),
+        "nested": [np.int32(3), (np.ones(2, np.int8), None)],
+        "scalar": 2.5,
+        "flag": True,
+    }
+    out = fabric.unpack_value(fabric.pack_value(value))
+    np.testing.assert_array_equal(out["w"], value["w"])
+    assert out["scalar"] == 2.5 and out["flag"] is True
+    np.testing.assert_array_equal(out["nested"][1][0], [1, 1])
+    assert out["nested"][1][1] is None
+
+
+def test_unpack_places_on_device():
+    import jax
+
+    from ompi_tpu.pml import fabric
+
+    dev = jax.devices()[-1]
+    raw = fabric.pack_value({"x": np.ones(4, np.float32)})
+    out = fabric.unpack_value(raw, device=dev)
+    assert out["x"].devices() == {dev}
+
+
+# -- unit: ordered-stream reassembly ---------------------------------------
+
+class _StubPml:
+    def __init__(self):
+        self.arrivals = []
+
+    def _remote_arrival(self, comm, env, *, fabric, src_idx, seq,
+                        payload_bytes):
+        self.arrivals.append((seq, env.tag))
+
+
+def _make_engine():
+    from ompi_tpu.pml.fabric import FabricEngine
+
+    ep = SimpleNamespace(poll_recv=lambda: None,
+                         poll_send_complete=lambda: None)
+    eng = FabricEngine(ep, my_index=0, n_processes=2)
+    eng._pml = _StubPml()
+    eng._comm_of = lambda cid: None  # stub pml ignores the comm
+    return eng
+
+
+def test_out_of_order_arrivals_held_until_gap_fills():
+    """Early sequence numbers park (frags_cant_match) and release in
+    order once the gap fills (expected_sequence semantics)."""
+    from ompi_tpu.pml.fabric import K_EAGER
+
+    eng = _make_engine()
+
+    def msg(seq):
+        return {"k": K_EAGER, "cid": 0, "src": 2, "dst": 0,
+                "tag": 100 + seq, "seq": seq, "nb": 0, "pay": b""}
+
+    eng._dispatch(1, msg(2))
+    eng._dispatch(1, msg(1))
+    assert eng._pml.arrivals == []  # both early: seq 0 missing
+    eng._dispatch(1, msg(0))
+    assert [s for s, _ in eng._pml.arrivals] == [0, 1, 2]
+
+
+def test_duplicate_seq_rejected():
+    from ompi_tpu.pml.fabric import FabricError, K_EAGER
+
+    eng = _make_engine()
+    m = {"k": K_EAGER, "cid": 0, "src": 1, "dst": 0, "tag": 0,
+         "seq": 0, "nb": 0, "pay": b""}
+    eng._dispatch(1, dict(m))
+    with pytest.raises(FabricError):
+        eng._dispatch(1, dict(m))
+
+
+# -- integration: two controller processes ---------------------------------
+
+_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.core.request import ANY_SOURCE, ANY_TAG
+    from ompi_tpu.pml import fabric
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    # Global world: 2 local CPU devices per process -> 4 ranks; ranks
+    # 0,1 owned by process 0, ranks 2,3 by process 1.
+    world = ompi_tpu.init()
+    assert world.size == 2 * nprocs, world.size
+    eng = fabric.wire_up()
+
+    big = np.arange(64 * 1024, dtype=np.float32)  # 256 KiB > eager
+
+    if pid == 0:
+        # eager tagged send across the boundary
+        world.rank(0).send(np.float32(42.0), dest=2, tag=7)
+        # rendezvous: payload must not ship until P1's recv matches
+        req = world.rank(1).isend(big, dest=3, tag=9)
+        req.wait(timeout=60)
+        # reverse direction: receive P1's eager reply on rank 0
+        back = world.rank(0).recv(source=3, tag=11)
+        assert float(np.asarray(back)) == 99.0
+        # wildcard recv completes from remote sender
+        wc = world.rank(1).recv(source=ANY_SOURCE, tag=ANY_TAG)
+        np.testing.assert_array_equal(np.asarray(wc), [5, 6])
+    else:
+        # blocking probe sees the eager envelope without consuming it
+        st = world.rank(2).probe(source=ANY_SOURCE, tag=ANY_TAG)
+        assert st.source == 0 and st.tag == 7, (st.source, st.tag)
+        got = world.rank(2).recv(source=0, tag=7)
+        assert float(np.asarray(got)) == 42.0
+        # rendezvous recv: value lands on rank 3's local device
+        r = world.rank(3).irecv(source=1, tag=9)
+        out = r.result(timeout=60)
+        arr = np.asarray(out)
+        np.testing.assert_array_equal(arr, big)
+        (dev,) = out.devices()
+        assert dev == world.devices[3], (dev, world.devices[3])
+        assert dev.process_index == 1
+        # reply eagerly to P0
+        world.rank(3).send(np.float32(99.0), dest=0, tag=11)
+        world.rank(2).send(np.array([5, 6], np.int32), dest=1, tag=13)
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_tagged_p2p():
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "OK" in out
+
+
+def test_unknown_cid_holds_until_comm_exists():
+    """An arrival for a communicator not yet created locally parks (the
+    comm-creation race) and delivers once the comm exists — the stream
+    must not wedge or drop the message."""
+    from ompi_tpu.pml.fabric import K_EAGER
+
+    eng = _make_engine()
+    from ompi_tpu.pml.fabric import FabricError
+
+    known = {"ready": False}
+
+    def comm_of(cid):
+        if not known["ready"]:
+            raise FabricError("not created yet")
+        return None
+
+    eng._comm_of = comm_of
+    m = {"k": K_EAGER, "cid": 7, "src": 2, "dst": 0, "tag": 1,
+         "seq": 0, "nb": 0, "pay": b""}
+    eng._dispatch(1, m)
+    assert eng._pml.arrivals == []  # held, not dropped
+    known["ready"] = True
+    assert eng.progress() == 0  # no new wire traffic...
+    assert [s for s, _ in eng._pml.arrivals] == [0]  # ...but delivered

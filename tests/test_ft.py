@@ -388,3 +388,45 @@ def test_respawn_with_pytree_template(tmp_path, comm):
         assert len(restarts) == 1  # exactly one RESTART per respawn
     finally:
         elastic.reset()
+
+
+def test_pessimist_recv_posted_before_send(comm):
+    """Sender-based logging must precede the host send: when the recv
+    is already posted, ob1 delivers synchronously inside isend and the
+    delivery callback must find the send in the log (regression:
+    deliveries recorded seq=-1 and replay raised ReplayError)."""
+    c = _with_logging_comm(comm)
+    try:
+        pml = c.pml
+        pml.log.clear()
+        r = c.rank(1).irecv(source=0, tag=4)
+        c.rank(0).isend(np.float32(7.0), dest=1, tag=4)
+        assert float(r.result()) == 7.0
+        log = pml.log
+        assert len(log.sends) == 1
+        assert len(log.deliveries) == 1
+        assert log.deliveries[0].seq == log.sends[0].seq
+
+        replay_comm = comm.dup()
+        got = [float(x) for x in vprotocol.replay(replay_comm, log)]
+        assert got == [7.0]
+    finally:
+        _reset_logging()
+
+
+def test_crs_overwrite_keeps_a_complete_snapshot(tmp_path):
+    """Re-saving to the same path never passes through a state with no
+    snapshot: the old dir is moved aside, not deleted, before the new
+    one lands (and the .old remnant is cleaned up afterwards)."""
+    import os
+
+    c = crs.select()
+    p = str(tmp_path / "snap")
+    c.save(p, {"x": np.arange(3, dtype=np.float32)}, {"step": 1})
+    c.save(p, {"x": np.arange(3, dtype=np.float32) * 2}, {"step": 2})
+    state, meta = c.load(p)
+    (leaf,) = state.values()
+    np.testing.assert_array_equal(leaf, [0.0, 2.0, 4.0])
+    assert meta["step"] == 2
+    assert not os.path.exists(p + ".old")
+    assert not os.path.exists(p + ".tmp")

@@ -459,3 +459,48 @@ def test_fcoll_dynamic_read(tmp_path, comm):
             )
     finally:
         config.set("fcoll_select", "")
+
+
+def test_fcoll_dynamic_domains_cover_tail():
+    """Trailing runs below the per-aggregator quota still get a domain
+    (regression: the tail after the last volume cut was dropped,
+    silently losing those bytes in write_all/read_all)."""
+    from types import SimpleNamespace
+
+    from ompi_tpu.io.fcoll import DynamicFcoll
+
+    accesses = [
+        SimpleNamespace(rank=0, runs=[(0, 10)]),
+        SimpleNamespace(rank=1, runs=[(20, 10)]),
+        SimpleNamespace(rank=2, runs=[(40, 5)]),
+    ]
+    domains = DynamicFcoll._domains_by_volume(accesses, 8)
+    assert domains == [(0, 30), (40, 45)]
+    # every run byte is covered by exactly one domain
+    for off, ln in [(0, 10), (20, 10), (40, 5)]:
+        assert any(lo <= off and off + ln <= hi for lo, hi in domains)
+
+
+def test_fcoll_dynamic_small_tail_roundtrip(tmp_path, comm):
+    """End-to-end: a write pattern whose tail never reaches the
+    per-aggregator byte quota round-trips intact under fcoll=dynamic."""
+    n = comm.size
+    p = str(tmp_path / "tail.bin")
+    config.set("fcoll_select", "dynamic")
+    try:
+        with io_mod.open(comm, p, "w+") as fh:
+            # big cluster up front, tiny isolated tail at the end
+            offs = [r * 64 for r in range(n - 1)] + [64 * n + 4096]
+            data = np.stack([
+                np.full(64, r + 1, np.uint8) for r in range(n)
+            ])
+            fh.write_at_all(offs, data)
+            out = np.asarray(fh.read_at_all(offs, 64))
+        for r in range(n):
+            np.testing.assert_array_equal(out[r], np.full(64, r + 1))
+        raw = np.fromfile(p, np.uint8)
+        np.testing.assert_array_equal(
+            raw[64 * n + 4096:64 * n + 4160], np.full(64, n)
+        )
+    finally:
+        config.set("fcoll_select", "")

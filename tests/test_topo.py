@@ -100,3 +100,113 @@ class TestNeighborColl:
         recv = topo.neighbor_alltoall(g, send)
         for r in range(n):
             assert float(np.asarray(recv[r])[0][0]) == float((r - 1) % n)
+
+
+# -- treematch rank reordering (reference: ompi/mca/topo/treematch) --------
+
+def _ring_W(n, stride=1):
+    W = np.zeros((n, n))
+    for i in range(n):
+        j = (i + stride) % n
+        W[i, j] += 1
+        W[j, i] += 1
+    return W
+
+
+def test_treematch_reduces_hop_weight_on_2d_mesh():
+    """A ring comm graph placed naively on a 4x2 mesh has long hops;
+    treematch must strictly reduce the weighted hop distance."""
+    from ompi_tpu.topo import treematch as tm
+
+    coords = [(x, y) for x in range(4) for y in range(2)]  # 4x2 mesh
+    n = len(coords)
+    # ring over a scrambled rank order: identity placement is bad
+    scramble = [0, 5, 2, 7, 4, 1, 6, 3]
+    W = np.zeros((n, n))
+    for a, b in zip(scramble, scramble[1:] + scramble[:1]):
+        W[a, b] += 1
+        W[b, a] += 1
+    D = tm._distance_matrix(coords, None)
+    identity_cost = tm.total_hop_weight(W, D, list(range(n)))
+    perm = tm.treematch_permutation(W, coords)
+    assert sorted(perm) == list(range(n))
+    cost = tm.total_hop_weight(W, D, perm)
+    assert cost < identity_cost, (cost, identity_cost)
+    # a ring embeds in a 4x2 mesh with every edge a single hop
+    assert cost == n, cost
+
+
+def test_treematch_optimal_on_2x2():
+    from ompi_tpu.topo import treematch as tm
+
+    coords = [(0, 0), (0, 1), (1, 0), (1, 1)]
+    W = _ring_W(4)
+    perm = tm.treematch_permutation(W, coords)
+    D = tm._distance_matrix(coords, None)
+    assert tm.total_hop_weight(W, D, perm) == 4.0
+
+
+def test_treematch_torus_wraparound():
+    """wrap_dims makes opposite mesh edges adjacent (ICI torus links)."""
+    from ompi_tpu.topo import treematch as tm
+
+    assert tm.hop_distance((0, 0), (3, 0), wrap_dims=(4, 1)) == 1
+    assert tm.hop_distance((0, 0), (2, 0), wrap_dims=(4, 1)) == 2
+    assert tm.hop_distance((0, 0), (3, 0), wrap_dims=None) == 3
+
+
+def test_treematch_respects_weights_over_topology():
+    """Heavy pairs get adjacent slots even when the light edges lose."""
+    from ompi_tpu.topo import treematch as tm
+
+    coords = [(i,) for i in range(4)]  # a line
+    W = np.zeros((4, 4))
+    W[0, 3] = W[3, 0] = 100.0  # heavy pair
+    W[0, 1] = W[1, 0] = 1.0
+    perm = tm.treematch_permutation(W, coords)
+    D = tm._distance_matrix(coords, None)
+    assert abs(perm[0] - perm[3]) == 1  # heavy pair adjacent
+    assert tm.total_hop_weight(W, D, perm) <= 102.0
+
+
+def test_graph_create_reorder_improves_linear_placement(world):
+    """On the coordinate fallback (linear slots), a stride-4 ring graph
+    reorders to adjacent slots (regression for the old ring-order
+    heuristic, which ignored the comm graph entirely)."""
+    from ompi_tpu.topo import treematch as tm
+
+    comm = world
+    n = comm.size
+    index, edges = [], []
+    acc = 0
+    for r in range(n):
+        nb = [(r + n // 2) % n, (r - n // 2) % n]
+        nb = sorted(set(nb))
+        acc += len(nb)
+        index.append(acc)
+        edges.extend(nb)
+    g = topo.graph_create(comm, index, edges, reorder=True)
+    assert g.topo is not None
+    # placement cost of the stride graph under the new rank order
+    coords = [(i,) for i in range(n)]
+    D = tm._distance_matrix(coords, None)
+    slots = {wr: s for s, wr in enumerate(g.group.world_ranks)}
+    cost = 0.0
+    for r in range(n):
+        lo = index[r - 1] if r else 0
+        for nb in edges[lo:index[r]]:
+            cost += D[slots[comm.group.world_rank(r)],
+                      slots[comm.group.world_rank(nb)]]
+    naive = sum(
+        D[r, nb]
+        for r in range(n)
+        for nb in edges[(index[r - 1] if r else 0):index[r]]
+    )
+    assert cost < naive, (cost, naive)
+
+
+def test_cart_create_reorder_smoke(world):
+    c = topo.cart_create(world, (world.size,), reorder=True)
+    assert c.topo.dims == (world.size,)
+    # all world ranks present exactly once
+    assert sorted(c.group.world_ranks) == sorted(world.group.world_ranks)
