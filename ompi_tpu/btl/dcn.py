@@ -149,6 +149,25 @@ class DcnEndpoint:
         msgid = self._lib.dcn_poll_send(self._ctx)
         return int(msgid) if msgid else None
 
+    def set_link_weights(self, peer: int, weights) -> None:
+        """Per-link FRAG striping proportions for a peer (reference:
+        bml_r2's bandwidth-weighted scheduling, bml_r2.c:131-148).
+        Empty/None restores uniform round-robin."""
+        import ctypes
+
+        ws = list(weights or [])
+        arr = (ctypes.c_double * max(len(ws), 1))(*(ws or [0.0]))
+        rc = self._lib.dcn_set_link_weights(
+            self._ctx, peer, arr, len(ws)
+        )
+        if rc != 0:
+            raise DcnError(f"set_link_weights: unknown peer {peer}")
+
+    def link_frags(self, peer: int, idx: int) -> int:
+        """FRAGs scheduled onto link `idx` of `peer` (striping
+        observability)."""
+        return int(self._lib.dcn_link_frags(self._ctx, peer, idx))
+
     def peer_links(self, peer: int) -> int:
         """Live TCP links to a peer; 0 means the peer is unreachable
         (every link died — the btl_tcp endpoint-failed state)."""
@@ -219,15 +238,52 @@ class DcnBtl(BtlComponent):
         return self._endpoint
 
     def wire_up(self, peer_addrs: dict[int, tuple[str, int]],
-                my_index: int) -> None:
+                my_index: int,
+                peer_records: Optional[dict[int, dict]] = None) -> None:
         """Modex: connect to every peer process's listener (reference:
-        PMIx modex exchanging btl/tcp addresses, ompi_mpi_init.c:642)."""
+        PMIx modex exchanging btl/tcp addresses, ompi_mpi_init.c:642).
+        When full business cards are supplied (`peer_records`), the
+        remote address is chosen by weighted reachability over the
+        peer's interface list (reference: btl_tcp_proc.c address
+        matching + reachable/weighted scoring)."""
+        from ..runtime import interfaces
+
         ep = self.endpoint()
+        locals_ = interfaces.usable_interfaces()
         for idx, (ip, port) in sorted(peer_addrs.items()):
             if idx == my_index or idx in self._peer_ids:
                 continue
+            rec = (peer_records or {}).get(idx) or {}
+            best_ip, best_q = ip, -1.0
+            # Interface alternatives are reachable only when the peer's
+            # listener binds every interface; a single-address listener
+            # is authoritative.
+            candidates = (
+                rec.get("ifaces", []) if ip == "0.0.0.0" else []
+            )
+            for riface in candidates:
+                # A REMOTE loopback address points at the local host —
+                # never a valid cross-process target (and it would win
+                # the same-network tier against the real NIC pair).
+                if not riface.get("ip") or riface.get("loopback"):
+                    continue
+                q = max(
+                    (interfaces.connection_quality(
+                        li, riface["ip"], riface.get("speed", 0))
+                     for li in locals_),
+                    default=0.0,
+                )
+                if q > best_q:
+                    best_ip, best_q = riface["ip"], q
+            if best_ip == "0.0.0.0":
+                # listen-all peer with no scorable non-loopback NIC
+                # (single-host setups): any published address reaches it
+                best_ip = next(
+                    (r["ip"] for r in rec.get("ifaces", [])
+                     if r.get("ip")), "127.0.0.1",
+                )
             self._peer_ids[idx] = ep.connect(
-                ip, port, cookie=my_index + 1
+                best_ip, port, cookie=my_index + 1
             )
 
     def transfer(self, value, src_proc, dst_proc):

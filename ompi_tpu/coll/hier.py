@@ -27,6 +27,7 @@ from typing import Optional
 
 import numpy as np
 
+from ..core import config
 from ..core.counters import SPC
 from ..core.errors import OmpiTpuError
 from ..core.logging import get_logger
@@ -35,6 +36,45 @@ from ..ops import lookup as op_lookup
 logger = get_logger("coll.hier")
 
 _HIER_TAG = 0x48494552  # "HIER"
+
+# Tuned decision knobs for the inter-slice phase (reference lineage:
+# coll_tuned_decision_fixed.c:45-87 — allreduce <10KB -> recursive
+# doubling, large -> (segmented) ring with 1MiB segments).
+_schedule_var = config.register(
+    "coll", "hier", "schedule", type=str, default="",
+    description="Force the inter-slice schedule (rd|ring|gather); "
+                "empty = tuned decision",
+)
+_small_var = config.register(
+    "coll", "hier", "small_msg", type=int, default=10_000,
+    description="Bytes below which small-message schedules are chosen "
+                "(reference: coll_tuned_decision_fixed.c:53)",
+)
+_segment_var = config.register(
+    "coll", "hier", "segment_bytes", type=int, default=1 << 20,
+    description="Segment size for pipelining the intra-slice reduce "
+                "against the inter-slice wire (reference: 1MiB ring "
+                "segments, coll_tuned_decision_fixed.c:73)",
+)
+
+
+def choose_schedule(n_slices: int, nbytes: int) -> str:
+    """The per-(leaders, bytes) decision (coll/tuned's fixed rules,
+    restricted to the inter-slice exchange):
+
+    - forced override via coll_hier_schedule;
+    - small messages: recursive doubling (pof2 leader counts) or
+      gather-at-leader (non-pof2 — one extra hop beats 2(n-1) latency
+      terms of a ring at tiny sizes);
+    - large messages: ring (bandwidth-optimal, segment-pipelined).
+    """
+    forced = (_schedule_var.value or "").strip()
+    if forced:
+        return forced
+    pof2 = n_slices & (n_slices - 1) == 0
+    if nbytes < _small_var.value:
+        return "rd" if pof2 else "gather"
+    return "ring"
 
 
 class HierError(OmpiTpuError):
@@ -109,11 +149,11 @@ class SliceHandle:
 
 
 def _exchange_ring(h: SliceHandle, block: np.ndarray, op,
-                   timeout: float) -> np.ndarray:
+                   timeout: float, tag_base: int = _HIER_TAG
+                   ) -> np.ndarray:
     """Inter-slice reduce via a ring over DCN: n-1 rounds, each slice
     forwards the partial to the next slice (reference:
-    allreduce_intra_ring's structure, over the wire). Used when the
-    slice count is not a power of two."""
+    allreduce_intra_ring's structure, over the wire)."""
     # Circulate each slice's ORIGINAL block around the ring while
     # accumulating separately — forwarding the accumulator instead
     # double-counts contributions for n >= 3.
@@ -123,16 +163,17 @@ def _exchange_ring(h: SliceHandle, block: np.ndarray, op,
     left = (h.slice_id - 1) % h.n_slices
     for rnd in range(h.n_slices - 1):
         h.endpoint.send_bytes(
-            h.peer_ids[right], _HIER_TAG + rnd, cur.tobytes()
+            h.peer_ids[right], tag_base + rnd, cur.tobytes()
         )
-        raw = h.recv_from(left, _HIER_TAG + rnd, timeout)
+        raw = h.recv_from(left, tag_base + rnd, timeout)
         cur = np.frombuffer(raw, block.dtype).reshape(block.shape)
         acc = op.np_reduce(acc, cur)
     return acc
 
 
 def _exchange_rd(h: SliceHandle, block: np.ndarray, op,
-                 timeout: float) -> np.ndarray:
+                 timeout: float, tag_base: int = _HIER_TAG
+                 ) -> np.ndarray:
     """Recursive doubling over DCN (reference:
     allreduce_intra_recursivedoubling) — log2(n) rounds for
     power-of-two slice counts."""
@@ -142,9 +183,9 @@ def _exchange_rd(h: SliceHandle, block: np.ndarray, op,
     while dist < h.n_slices:
         partner = h.slice_id ^ dist
         h.endpoint.send_bytes(
-            h.peer_ids[partner], _HIER_TAG + rnd, acc.tobytes()
+            h.peer_ids[partner], tag_base + rnd, acc.tobytes()
         )
-        raw = h.recv_from(partner, _HIER_TAG + rnd, timeout)
+        raw = h.recv_from(partner, tag_base + rnd, timeout)
         incoming = np.frombuffer(raw, block.dtype).reshape(block.shape)
         acc = op.np_reduce(acc, incoming)
         dist <<= 1
@@ -152,16 +193,89 @@ def _exchange_rd(h: SliceHandle, block: np.ndarray, op,
     return acc
 
 
+def _exchange_gather(h: SliceHandle, block: np.ndarray, op,
+                     timeout: float, tag_base: int = _HIER_TAG
+                     ) -> np.ndarray:
+    """Gather-at-leader: every slice sends its partial to slice 0,
+    which reduces and broadcasts the result back — 2 latency terms
+    total, the small-message winner for non-pof2 leader counts
+    (reference analog: reduce+bcast 'nonoverlapping',
+    coll_base_allreduce.c:53)."""
+    if h.slice_id == 0:
+        acc = block.copy()
+        for src in range(1, h.n_slices):
+            raw = h.recv_from(src, tag_base, timeout)
+            acc = op.np_reduce(
+                acc, np.frombuffer(raw, block.dtype).reshape(block.shape)
+            )
+        for dst in range(1, h.n_slices):
+            h.endpoint.send_bytes(
+                h.peer_ids[dst], tag_base + 1, acc.tobytes()
+            )
+        return acc
+    h.endpoint.send_bytes(h.peer_ids[0], tag_base, block.tobytes())
+    raw = h.recv_from(0, tag_base + 1, timeout)
+    return np.frombuffer(raw, block.dtype).reshape(block.shape)
+
+
 def allreduce(h: SliceHandle, x, op="sum", *, timeout: float = 30.0,
-              schedule: Optional[str] = None):
+              schedule: Optional[str] = None,
+              segment_bytes: Optional[int] = None):
     """Hierarchical allreduce of a rank-major intra-slice buffer. In
     production each controller process drives its own handle; tests
-    drive several handles on threads (endpoints are thread-safe)."""
+    drive several handles on threads (endpoints are thread-safe).
+
+    Large payloads pipeline: the buffer splits into segments, every
+    segment's intra-slice reduce is enqueued on the devices up front
+    (JAX async dispatch), and the wire exchanges segment k while the
+    devices still compute segments k+1... — the overlap of phase 1
+    with phase 2 (reference analog: segmented ring, 1MiB segments,
+    coll_tuned_decision_fixed.c:73-81)."""
+    seg = segment_bytes if segment_bytes is not None \
+        else int(_segment_var.value)
+    arr = x if hasattr(x, "nbytes") else None
+    per_rank_bytes = (arr.nbytes // h.comm.size) if arr is not None else 0
+    if h.n_slices > 1 and seg > 0 and per_rank_bytes > seg:
+        return _allreduce_pipelined(h, x, op, timeout=timeout,
+                                    schedule=schedule, seg_bytes=seg)
     partial = phase1_local_reduce(h, x, op)
     global_block = phase2_exchange(
         h, partial, op, timeout=timeout, schedule=schedule
     )
     return phase3_local_bcast(h, global_block)
+
+
+def _allreduce_pipelined(h: SliceHandle, x, op, *, timeout: float,
+                         schedule: Optional[str], seg_bytes: int):
+    import jax
+    import jax.numpy as jnp
+
+    opo = op_lookup(op)
+    n = h.comm.size
+    flat = x.reshape(n, -1)
+    elems = int(flat.shape[1])
+    itemsize = jnp.dtype(flat.dtype).itemsize
+    seg_elems = max(1, seg_bytes // itemsize)
+    bounds = list(range(0, elems, seg_elems)) + [elems]
+    # Phase 1 for EVERY segment is enqueued before any wire work: the
+    # device runs ahead of the exchange loop below.
+    reduced = [
+        h.comm.reduce(flat[:, lo:hi],
+                      op=opo.name if opo.predefined else opo, root=0)
+        for lo, hi in zip(bounds, bounds[1:])
+    ]
+    SPC.record("hier_pipelined_allreduces")
+    rounds_span = h.n_slices + 2  # tag namespace per segment
+    out_segs = []
+    for s, dev_red in enumerate(reduced):
+        partial = np.asarray(jax.device_get(dev_red))
+        out_segs.append(phase2_exchange(
+            h, partial, op, timeout=timeout, schedule=schedule,
+            tag_base=_HIER_TAG + s * rounds_span,
+        ))
+        SPC.record("hier_segments")
+    full = np.concatenate([seg.reshape(-1) for seg in out_segs])
+    return phase3_local_bcast(h, full.reshape(x.shape[1:]))
 
 
 def phase1_local_reduce(h: SliceHandle, x, op="sum") -> np.ndarray:
@@ -175,29 +289,31 @@ def phase1_local_reduce(h: SliceHandle, x, op="sum") -> np.ndarray:
 
 def phase2_exchange(h: SliceHandle, partial: np.ndarray, op="sum", *,
                     timeout: float = 30.0,
-                    schedule: Optional[str] = None) -> np.ndarray:
-    """Inter-slice combine. Schedule: recursive doubling for
-    power-of-two slice counts, ring otherwise (the tuned-style
-    decision), overridable via `schedule` ('rd'|'ring')."""
+                    schedule: Optional[str] = None,
+                    tag_base: int = _HIER_TAG) -> np.ndarray:
+    """Inter-slice combine. Schedule per (leaders, bytes) from the
+    tuned decision (`choose_schedule`), overridable via `schedule`
+    ('rd'|'ring'|'gather') or the coll_hier_schedule config var."""
     op = op_lookup(op)
     if h.n_slices == 1:
         return partial
     h.wire_check()
     if schedule is None:
-        schedule = (
-            "rd" if h.n_slices & (h.n_slices - 1) == 0 else "ring"
-        )
+        schedule = choose_schedule(h.n_slices, int(partial.nbytes))
     if schedule == "rd":
         if h.n_slices & (h.n_slices - 1):
             raise HierError(
                 "recursive doubling needs a power-of-two slice count"
             )
-        out = _exchange_rd(h, partial, op, timeout)
+        out = _exchange_rd(h, partial, op, timeout, tag_base)
     elif schedule == "ring":
-        out = _exchange_ring(h, partial, op, timeout)
+        out = _exchange_ring(h, partial, op, timeout, tag_base)
+    elif schedule == "gather":
+        out = _exchange_gather(h, partial, op, timeout, tag_base)
     else:
         raise HierError(f"unknown schedule {schedule!r}")
     SPC.record("hier_dcn_exchanges")
+    SPC.record(f"hier_sched_{schedule}")
     return out
 
 

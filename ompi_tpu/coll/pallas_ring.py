@@ -43,13 +43,19 @@ from ..ops.op import Op
 
 __all__ = [
     "ring_allgather", "ring_reduce_scatter", "ring_allreduce",
-    "ppermute_shift",
+    "ring_allreduce_bidir", "tree_bcast", "ppermute_shift",
 ]
 
 _interpret_var = config.register(
     "coll", "pallas", "interpret",
     type=bool, default=None,
     description="Force Mosaic interpret mode (auto: on for CPU backend)",
+)
+_bidir_var = config.register(
+    "coll", "pallas", "bidir",
+    type=bool, default=False,
+    description="Use the bidirectional ring for pallas allreduce "
+                "(both ICI link directions per step)",
 )
 
 
@@ -319,6 +325,188 @@ def ring_allreduce(x: jax.Array, axis_name: str, op: Any = "sum"
     return out.reshape((n,) + shape)
 
 
+def _allreduce_bidir_kernel(axis_name: str, n: int, op: Op, half: int,
+                            x_ref, out_ref, buf_a, buf_b,
+                            ssem_a, rsem_a, csem_a,
+                            ssem_b, rsem_b, csem_b):
+    """Bidirectional ring allreduce: the payload splits in half and the
+    two halves run the 2(n-1)-step ring schedule in OPPOSITE directions
+    simultaneously, so both ICI directions of the torus link carry data
+    every step — 2x the link bandwidth of the unidirectional ring
+    (reference's algorithm space has only the one-direction ring,
+    coll_base_allreduce.c:341; this is the TPU-topology upgrade).
+    Both directions' DMAs are started before either is awaited."""
+    me = jax.lax.axis_index(axis_name)
+    parts = (
+        (1, buf_a, ssem_a, rsem_a, csem_a, slice(0, half)),
+        (-1, buf_b, ssem_b, rsem_b, csem_b, slice(half, None)),
+    )
+    for d, buf, _ss, _rs, _cs, sl in parts:
+        first = jax.lax.rem(me - d + n, n)
+        buf[0] = x_ref[first, sl]
+
+    for step in range(2 * (n - 1)):
+        slot = step % 2
+        nslot = (step + 1) % 2
+        descs = []
+        for d, buf, ssem, rsem, csem, sl in parts:
+            if step >= 2:
+                pltpu.semaphore_wait(csem.at[nslot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=buf.at[slot],
+                dst_ref=buf.at[nslot],
+                send_sem=ssem.at[slot],
+                recv_sem=rsem.at[nslot],
+                device_id=jax.lax.rem(me + d + n, n),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()  # both directions in flight together
+            descs.append(rdma)
+        for (d, buf, ssem, rsem, csem, sl), rdma in zip(parts, descs):
+            rdma.wait()
+            if step < n - 1:
+                blk = jax.lax.rem(me - d * (step + 2) + 3 * n, n)
+                val = _combine_blocks(op, buf[nslot], x_ref[blk, sl])
+                buf[nslot] = val
+                if step == n - 2:
+                    out_ref[blk, sl] = val  # blk == me: first done block
+            else:
+                blk = jax.lax.rem(
+                    me - d * (step - (n - 1) + 1) + 3 * n, n
+                )
+                out_ref[blk, sl] = buf[nslot]
+            if step < 2 * (n - 1) - 2:
+                pltpu.semaphore_signal(
+                    csem.at[nslot], inc=1,
+                    device_id=jax.lax.rem(me - d + n, n),
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+
+
+def _tree_bcast_kernel(axis_name: str, n: int, root: int,
+                       x_ref, out_ref, send_sem, recv_sem, ready_sem):
+    """Binomial-tree bcast: in round k every rank that already holds
+    the payload (relative rank < 2^k) pushes it one subtree over
+    (relative +2^k) — ceil(log2 n) rounds total (reference:
+    ompi_coll_base_bcast_intra_binomial, coll_base_bcast.c; tree shape
+    coll_base_topo.c). Asymmetric DMA: senders wait send completion,
+    receivers park on the recv semaphore (wait_recv). The receiver
+    remote-signals readiness to its sender BEFORE parking — the DMA
+    targets the same out_ref the receiver initializes at kernel start,
+    and with skewed kernel-start times an unsynchronized send could
+    land before that init overwrites it."""
+    me = jax.lax.axis_index(axis_name)
+    rel = jax.lax.rem(me - root + n, n)
+    out_ref[:] = x_ref[:]
+    rounds = max(1, (n - 1).bit_length())
+    for k in range(rounds):
+        bit = 1 << k
+        dst = jax.lax.rem(me + bit, n)
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref,
+            dst_ref=out_ref,
+            send_sem=send_sem.at[k % 2],
+            recv_sem=recv_sem.at[k % 2],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        is_recv = jnp.logical_and(rel >= bit, rel < 2 * bit)
+
+        @pl.when(is_recv)
+        def _ready():
+            # my sender is relative -bit: tell it my out_ref is ready
+            pltpu.semaphore_signal(
+                ready_sem.at[k % 2], inc=1,
+                device_id=jax.lax.rem(me - bit + n, n),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+        @pl.when(jnp.logical_and(rel < bit, rel + bit < n))
+        def _send(rdma=rdma):
+            pltpu.semaphore_wait(ready_sem.at[k % 2], 1)
+            rdma.start()
+            rdma.wait_send()
+
+        @pl.when(is_recv)
+        def _recv(rdma=rdma):
+            rdma.wait_recv()
+
+
+def ring_allreduce_bidir(x: jax.Array, axis_name: str, op: Any = "sum"
+                         ) -> jax.Array:
+    """Inside shard_map: local (n, chunk) contributions -> fully
+    reduced (n, chunk) via the bidirectional ring (both ICI link
+    directions active every step)."""
+    op = op_lookup(op)
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    shape = x.shape[1:]
+    flat = x.reshape(n, -1)
+    pad = (-flat.shape[1]) % 256  # two 128-lane-aligned halves
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    lanes = flat.shape[1]
+    half = lanes // 2
+    kernel = functools.partial(
+        _allreduce_bidir_kernel, axis_name, n, op, half
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct(flat.shape, flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, half), flat.dtype),
+            pltpu.VMEM((2, lanes - half), flat.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=6,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape((n,) + shape)
+
+
+def tree_bcast(x: jax.Array, axis_name: str, root: int = 0
+               ) -> jax.Array:
+    """Inside shard_map: local block -> root's block, binomial tree."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    flat, pad, shape = _pad_chunk(x)
+    kernel = functools.partial(_tree_bcast_kernel, axis_name, n,
+                               int(root))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.size,), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=5,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
 def _alltoall_kernel(axis_name: str, n: int, x_ref, out_ref,
                      send_sem, recv_sem):
     """Pairwise-exchange alltoall (reference: coll_base_alltoall.c's
@@ -451,6 +639,22 @@ def allreduce_block(b: jax.Array, axis_name: str, op: Any) -> jax.Array:
     return _unsplit_ring(out, pad, shape)
 
 
+def allreduce_block_bidir(b: jax.Array, axis_name: str, op: Any
+                          ) -> jax.Array:
+    """shard_map body for the bidirectional ring."""
+    n = jax.lax.axis_size(axis_name)
+    segs, pad, shape = _split_ring(b, n)
+    out = ring_allreduce_bidir(segs, axis_name, op)
+    return _unsplit_ring(out, pad, shape)
+
+
+def bcast_block(b: jax.Array, axis_name: str, root: int = 0
+                ) -> jax.Array:
+    """shard_map body: every rank ends with root's block (binomial
+    tree over ICI DMA)."""
+    return tree_bcast(b, axis_name, root=root)
+
+
 @COLL.register
 class PallasColl(CollComponent):
     NAME = "pallas"
@@ -462,9 +666,23 @@ class PallasColl(CollComponent):
         x = rank_major_check(comm, x)
         if comm.size == 1:
             return x
-        key = ("allreduce", "pallas", op.cache_key, x.shape, str(x.dtype))
+        body = allreduce_block_bidir if _bidir_var.value \
+            else allreduce_block
+        key = ("allreduce", "pallas", body.__name__, op.cache_key,
+               x.shape, str(x.dtype))
         plan = compile_plan(
-            comm, key, lambda b: allreduce_block(b, "ranks", op),
+            comm, key, lambda b: body(b, "ranks", op),
+            check_vma=False,
+        )
+        return plan(x)
+
+    def bcast(self, comm, x, root):
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x
+        key = ("bcast", "pallas", root, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: bcast_block(b, "ranks", root=root),
             check_vma=False,
         )
         return plan(x)

@@ -78,6 +78,24 @@ ALLREDUCE_ALGOS: dict[str, Callable] = {
     "gather_reduce": spmd._allreduce_gather_reduce,
 }
 
+
+def _pallas_algos() -> None:
+    """Extend the algorithm spaces with the Pallas kernel tier so the
+    tuned rules (and tools/tune.py sweeps) can select pallas-vs-xla
+    from measurement. Lazy: importing pallas pulls in Mosaic."""
+    if "pallas_ring" in ALLREDUCE_ALGOS:
+        return
+    from . import pallas_ring as pr
+
+    ALLREDUCE_ALGOS["pallas_ring"] = pr.allreduce_block
+    ALLREDUCE_ALGOS["pallas_bidir"] = pr.allreduce_block_bidir
+    BCAST_ALGOS["pallas_binomial"] = pr.bcast_block
+    ALLGATHER_ALGOS["pallas_ring"] = pr.ring_allgather
+
+
+def is_pallas_algo(name: str) -> bool:
+    return name.startswith("pallas")
+
 ALLGATHER_ALGOS: dict[str, Callable] = {
     "native": spmd.allgather_native,
     "ring": spmd.allgather_ring,
@@ -222,6 +240,8 @@ class TunedColl(XlaColl):
         if comm.size == 1:
             return x
         algo = decide_allreduce(op, _nbytes(x), comm.size)
+        if is_pallas_algo(algo):
+            _pallas_algos()
         fn = ALLREDUCE_ALGOS.get(algo)
         if fn is None:
             raise ArgumentError(
@@ -248,7 +268,8 @@ class TunedColl(XlaColl):
         from ..core.counters import SPC
 
         SPC.record(f"coll_allreduce_algo_{algo}")
-        plan = compile_plan(comm, key, per_rank)
+        plan = compile_plan(comm, key, per_rank,
+                            check_vma=not is_pallas_algo(algo))
         return plan(x)
 
     def alltoall(self, comm, x):
@@ -273,11 +294,14 @@ class TunedColl(XlaColl):
         if comm.size == 1:
             return x[:, None]
         algo = decide_allgather(_nbytes(x), comm.size)
+        if is_pallas_algo(algo):
+            _pallas_algos()
         fn = ALLGATHER_ALGOS.get(algo)
         if fn is None:
             raise ArgumentError(f"unknown allgather algorithm {algo!r}")
         key = ("allgather", algo, x.shape, str(x.dtype))
-        plan = compile_plan(comm, key, lambda b: fn(b, "ranks"))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks"),
+                            check_vma=not is_pallas_algo(algo))
         return plan(x)
 
     def bcast(self, comm, x, root):
@@ -285,9 +309,12 @@ class TunedColl(XlaColl):
         if comm.size == 1:
             return x
         algo = decide_bcast(_nbytes(x), comm.size)
+        if is_pallas_algo(algo):
+            _pallas_algos()
         fn = BCAST_ALGOS.get(algo)
         if fn is None:
             raise ArgumentError(f"unknown bcast algorithm {algo!r}")
         key = ("bcast", algo, root, _dtype_key(x))
-        plan = compile_plan(comm, key, lambda b: fn(b, "ranks", root=root))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks", root=root),
+                            check_vma=not is_pallas_algo(algo))
         return plan(x)

@@ -78,6 +78,37 @@ def failed_ranks() -> set[int]:
         return set(_failed)
 
 
+def watch_dcn(peer_world_ranks: dict) -> int:
+    """Bridge DCN link-death detection to elastic recovery: when every
+    TCP link to a peer endpoint dies, `DcnEndpoint.check_peer` raises a
+    DEVICE_ERROR event carrying the dcn peer id (`btl/dcn.py`); this
+    handler translates it into PROC_FAILED for each world rank that
+    peer's controller owned — the PMIx failure-notification flow
+    (reference: ompi_mpi_init.c:524 event registration routing peer
+    failures into errhandlers). `peer_world_ranks` maps dcn peer ids
+    (active AND passive ids both work) to the world ranks behind them.
+    Returns a handler id for events.deregister."""
+    enable()
+
+    def on_device_error(ev: events.Event) -> None:
+        if ev.info.get("transport") != "dcn":
+            return
+        ranks = peer_world_ranks.get(ev.info.get("peer"))
+        if not ranks:
+            return
+        for wr in ranks:
+            if wr not in failed_ranks():
+                events.raise_event(
+                    events.EventClass.PROC_FAILED, world_rank=wr,
+                    via="dcn_liveness",
+                )
+
+    hid = events.register(events.EventClass.DEVICE_ERROR,
+                          on_device_error)
+    SPC.record("ft_dcn_watches")
+    return hid
+
+
 def shrink(comm, *, dead: Optional[set] = None) -> Any:
     """MPI_Comm_shrink: a new communicator over the ranks of `comm`
     whose world ranks are not known-failed. `dead` lets callers pin

@@ -4,7 +4,7 @@ TPU-native equivalent of ompi/mca/io (reference: io/ompio + the
 fs/fbtl/fcoll/sharedfp frameworks it decomposes into, SURVEY §2.3).
 """
 
-from . import fbtl, fcoll, fs, sharedfp, view
+from . import fbtl, fcoll, fs, objstore, sharedfp, view
 from .file import File, delete, live_files, open
 from .fs import (
     APPEND,
@@ -23,5 +23,5 @@ __all__ = [
     "APPEND", "CREATE", "DELETE_ON_CLOSE", "EXCL", "File", "FileView",
     "RDONLY", "RDWR", "SEQUENTIAL", "UNIQUE_OPEN", "WRONLY",
     "contiguous_view", "delete", "fbtl", "fcoll", "fs", "live_files",
-    "open", "sharedfp", "view",
+    "objstore", "open", "sharedfp", "view",
 ]

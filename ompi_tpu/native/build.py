@@ -148,6 +148,12 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
     lib.dcn_peer_links.argtypes = [P, ctypes.c_int]
     lib.dcn_stat.restype = LL
     lib.dcn_stat.argtypes = [P, ctypes.c_int]
+    lib.dcn_set_link_weights.restype = ctypes.c_int
+    lib.dcn_set_link_weights.argtypes = [
+        P, ctypes.c_int, ctypes.POINTER(ctypes.c_double), ctypes.c_int,
+    ]
+    lib.dcn_link_frags.restype = LL
+    lib.dcn_link_frags.argtypes = [P, ctypes.c_int, ctypes.c_int]
     lib.dcn_destroy.restype = None
     lib.dcn_destroy.argtypes = [P]
 

@@ -106,7 +106,13 @@ struct OutMsg {
 
 struct Peer {
   std::vector<int> link_fds;
-  size_t rr = 0;  // round-robin cursor for striping
+  size_t rr = 0;  // round-robin cursor for striping (uniform mode)
+  // Weighted striping (reference: bml_r2 bandwidth-weighted
+  // scheduling, bml_r2.c:131-148): when weights are set, FRAGs are
+  // scheduled by smooth weighted round-robin over links.
+  std::vector<double> weights;
+  std::vector<double> credit;
+  std::vector<int64_t> frags_per_link;  // observability for tests
 };
 
 struct Ctx {
@@ -175,8 +181,28 @@ void enqueue_frame(Ctx* c, int peer, OutFrame&& f) {
   Peer& p = it->second;
   int fd;
   if (f.hdr.kind == kFrag) {
-    fd = p.link_fds[p.rr % p.link_fds.size()];
-    p.rr++;
+    size_t nlinks = p.link_fds.size();
+    size_t pick;
+    if (p.weights.size() == nlinks && nlinks > 1) {
+      // smooth weighted round-robin: credit accrues by weight, the
+      // richest link sends and pays the total back — proportions
+      // converge to the weights with minimal burstiness.
+      double total = 0;
+      for (double w : p.weights) total += w;
+      pick = 0;
+      for (size_t i = 0; i < nlinks; i++) {
+        p.credit[i] += p.weights[i];
+        if (p.credit[i] > p.credit[pick]) pick = i;
+      }
+      p.credit[pick] -= total;
+    } else {
+      pick = p.rr % nlinks;
+      p.rr++;
+    }
+    if (p.frags_per_link.size() != nlinks)
+      p.frags_per_link.assign(nlinks, 0);
+    p.frags_per_link[pick]++;
+    fd = p.link_fds[pick];
   } else {
     fd = p.link_fds[0];
   }
@@ -656,6 +682,48 @@ int dcn_peer_links(void* vc, int peer) {
   auto it = c->peers.find(peer);
   if (it == c->peers.end()) return -1;
   return (int)it->second.link_fds.size();
+}
+
+// Set per-link striping weights for a peer (reference: bml_r2's
+// bandwidth-weighted scheduling). n may differ from the live link
+// count; weights apply positionally and uniform striping resumes when
+// unset. Returns 0 on success.
+int dcn_set_link_weights(void* vc, int peer, const double* w, int n) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->peers.find(peer);
+  if (it == c->peers.end()) return -1;
+  Peer& p = it->second;
+  if (n <= 0 || !w) {
+    p.weights.clear();
+    p.credit.clear();
+    return 0;
+  }
+  size_t nlinks = p.link_fds.size();
+  p.weights.assign(nlinks, 0.0);
+  for (size_t i = 0; i < nlinks; i++)
+    p.weights[i] = (i < (size_t)n && w[i] > 0) ? w[i] : 0.0;
+  double total = 0;
+  for (double x : p.weights) total += x;
+  if (total <= 0) {  // all-zero: fall back to uniform
+    p.weights.clear();
+    p.credit.clear();
+    return 0;
+  }
+  p.credit.assign(nlinks, 0.0);
+  return 0;
+}
+
+// Frags scheduled onto link `idx` of `peer` so far (test observability
+// for striping proportions).
+long long dcn_link_frags(void* vc, int peer, int idx) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->peers.find(peer);
+  if (it == c->peers.end()) return -1;
+  auto& v = it->second.frags_per_link;
+  if (idx < 0 || (size_t)idx >= v.size()) return 0;
+  return v[idx];
 }
 
 long long dcn_stat(void* vc, int what) {

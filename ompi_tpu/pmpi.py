@@ -1,0 +1,244 @@
+"""PMPI-style interposition: wrap any public call with tracers.
+
+TPU-native equivalent of the reference's profiling interface (reference:
+ompi/mpi/c/allreduce.c:36-41 — every binding is compiled twice, the weak
+symbol `MPI_X` resolving to `PMPI_X` so any tool can interpose on any
+call without relinking). Here the binding surface is the Python API, so
+the weak-symbol trick becomes method wrapping:
+
+- `install()` wraps the public methods of the Communicator, Window and
+  File classes once; the pristine implementation stays reachable as
+  `P<name>` on the class (the PMPI_ name) and through `pcall()`.
+- Tracers attach/detach at runtime (`attach`/`detach`); with no tracers
+  attached the wrapper is a single truthiness check — the weak-symbol
+  cost model (near-zero when no tool interposes).
+- A tracer sees every call pre/post with its arguments and result; the
+  `ByteCountTracer` ports the reference's per-peer byte accounting
+  (reference: ompi/mca/common/monitoring/common_monitoring.c — per-peer
+  bytes/msg counts) onto the shim, as a tool would.
+
+Tools interpose here WITHOUT the framework's cooperation — unlike
+`monitoring/`, which is metering built into the dispatch points. Both
+exist in the reference (PMPI tools vs the monitoring components).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Optional
+
+from .core.counters import SPC
+from .core.logging import get_logger
+
+logger = get_logger("pmpi")
+
+#: method names wrapped per class — the "profiling surface". Mirrors the
+#: MPI_* call families the reference shims (p2p, collectives, comm
+#: management, RMA, IO).
+COMM_CALLS = (
+    "send", "recv", "isend", "irecv", "probe", "iprobe", "improbe",
+    "allreduce", "bcast", "reduce", "allgather", "alltoall",
+    "reduce_scatter_block", "reduce_scatter", "gather", "scatter",
+    "scan", "exscan", "barrier", "allgatherv", "gatherv", "scatterv",
+    "alltoallv", "alltoallw",
+    "iallreduce", "ibcast", "ireduce", "iallgather", "ialltoall",
+    "igather", "iscatter", "iscan", "ibarrier",
+    "neighbor_allgather", "neighbor_alltoall",
+    "dup", "split", "create", "free",
+)
+WIN_CALLS = (
+    "put", "get", "accumulate", "get_accumulate", "fetch_and_op",
+    "compare_and_swap", "fence", "lock", "unlock", "lock_all",
+    "unlock_all", "flush", "post", "start", "complete", "wait",
+)
+FILE_CALLS = (
+    "read", "write", "read_at", "write_at", "read_at_all",
+    "write_at_all", "read_all", "write_all", "iread_at", "iwrite_at",
+    "iread_at_all", "iwrite_at_all", "read_shared", "write_shared",
+    "read_ordered", "write_ordered", "seek", "sync", "close",
+)
+
+
+class Tracer:
+    """Base interposition tool: override either hook. `on_call` may
+    return a token; it is passed to `on_return` (timing, nesting...)."""
+
+    def on_call(self, name: str, obj: Any, args: tuple,
+                kwargs: dict) -> Any:
+        return None
+
+    def on_return(self, name: str, obj: Any, token: Any,
+                  result: Any, error: Optional[BaseException]) -> None:
+        pass
+
+
+_tracers: list[Tracer] = []
+_lock = threading.Lock()
+_installed = False
+
+
+def attach(tracer: Tracer) -> None:
+    """Arm a tracer (installs the shim on first use)."""
+    install()
+    with _lock:
+        if tracer not in _tracers:
+            _tracers.append(tracer)
+
+
+def detach(tracer: Tracer) -> None:
+    with _lock:
+        if tracer in _tracers:
+            _tracers.remove(tracer)
+
+
+def active() -> list[Tracer]:
+    return list(_tracers)
+
+
+def _wrap(cls: type, name: str) -> None:
+    orig = getattr(cls, name)
+    pname = "P" + name
+    if hasattr(cls, pname):  # already wrapped
+        return
+    setattr(cls, pname, orig)  # the PMPI_ entry point
+
+    def shim(self, *args, __orig=orig, __name=name, **kwargs):
+        if not _tracers:
+            return __orig(self, *args, **kwargs)
+        snapshot = list(_tracers)
+        tokens = [
+            (t, t.on_call(__name, self, args, kwargs)) for t in snapshot
+        ]
+        SPC.record("pmpi_intercepted_calls")
+        error = None
+        result = None
+        try:
+            result = __orig(self, *args, **kwargs)
+            return result
+        except BaseException as exc:
+            error = exc
+            raise
+        finally:
+            for t, token in reversed(tokens):
+                t.on_return(__name, self, token, result, error)
+
+    shim.__name__ = name
+    shim.__qualname__ = f"{cls.__name__}.{name}"
+    shim.__doc__ = orig.__doc__
+    setattr(cls, name, shim)
+
+
+def install() -> None:
+    """Wrap the public surfaces once (idempotent). Reference analog:
+    the weak-symbol aliasing happens at link time; here at first use."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        from .communicator import Communicator
+        from .osc.window import Window
+        from .io.file import File
+
+        for cls, names in ((Communicator, COMM_CALLS),
+                           (Window, WIN_CALLS), (File, FILE_CALLS)):
+            for name in names:
+                if hasattr(cls, name):
+                    _wrap(cls, name)
+        _installed = True
+        logger.info("pmpi shim installed")
+
+
+def uninstall() -> None:
+    """Restore the pristine methods (PMPI_ copies remain)."""
+    global _installed
+    with _lock:
+        if not _installed:
+            return
+        from .communicator import Communicator
+        from .osc.window import Window
+        from .io.file import File
+
+        for cls, names in ((Communicator, COMM_CALLS),
+                           (Window, WIN_CALLS), (File, FILE_CALLS)):
+            for name in names:
+                pname = "P" + name
+                if hasattr(cls, pname):
+                    setattr(cls, name, getattr(cls, pname))
+                    delattr(cls, pname)
+        _tracers.clear()
+        _installed = False
+
+
+def pcall(obj: Any, name: str, *args, **kwargs):
+    """Invoke the unwrapped implementation — PMPI_X from inside a tool
+    (a tracer calling the API would otherwise recurse into itself)."""
+    fn = getattr(type(obj), "P" + name, None)
+    if fn is None:
+        fn = getattr(type(obj), name)
+    return fn(obj, *args, **kwargs)
+
+
+# ---------------------------------------------------------------------------
+# A ported tool: per-peer byte accounting (the common_monitoring port).
+# ---------------------------------------------------------------------------
+
+def _nbytes(value) -> int:
+    import jax
+
+    total = 0
+    for leaf in jax.tree.leaves(value):
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif hasattr(leaf, "__len__") and not isinstance(leaf, str):
+            total += len(leaf)
+    return total
+
+
+class ByteCountTracer(Tracer):
+    """Counts bytes and calls per (cid, src, dst) for p2p and per
+    (cid, op) for collectives — the reference monitoring component's
+    accounting, implemented as an external PMPI tool."""
+
+    P2P_SENDS = ("send", "isend")
+    COLL_OPS = frozenset(
+        n for n in COMM_CALLS
+        if n not in ("send", "recv", "isend", "irecv", "probe",
+                     "iprobe", "improbe", "dup", "split", "create",
+                     "free")
+    )
+
+    def __init__(self) -> None:
+        self.p2p: dict[tuple[int, int, int], list[int]] = {}
+        self.coll: dict[tuple[int, str], list[int]] = {}
+        self._lock = threading.Lock()
+
+    def on_call(self, name, obj, args, kwargs):
+        import time
+
+        if name in self.P2P_SENDS and args:
+            value, dest = args[0], args[1]
+            src = kwargs.get("source")
+            key = (obj.cid, -1 if src is None else src, dest)
+            with self._lock:
+                ent = self.p2p.setdefault(key, [0, 0])
+                ent[0] += 1
+                ent[1] += _nbytes(value)
+        elif name in self.COLL_OPS and hasattr(obj, "cid"):
+            key = (obj.cid, name)
+            with self._lock:
+                ent = self.coll.setdefault(key, [0, 0])
+                ent[0] += 1
+                ent[1] += _nbytes(args[0]) if args else 0
+        return time.perf_counter()
+
+    def on_return(self, name, obj, token, result, error):
+        pass
+
+    def dump(self) -> str:
+        lines = ["# pmpi byte counts (cid src dst calls bytes)"]
+        with self._lock:
+            for (cid, src, dst), (calls, nb) in sorted(self.p2p.items()):
+                lines.append(f"p2p  {cid} {src} {dst} {calls} {nb}")
+            for (cid, op), (calls, nb) in sorted(self.coll.items()):
+                lines.append(f"coll {cid} {op} {calls} {nb}")
+        return "\n".join(lines)

@@ -1,0 +1,173 @@
+"""NIC enumeration and weighted reachability for the DCN transport.
+
+TPU-native equivalent of opal/mca/if (interface discovery) and
+opal/mca/reachable/weighted (reference: reachable_weighted.c — score
+each (local interface, remote interface) pair by address-family match
+and subnet commonality, weighting connection candidates; btl/tcp picks
+and stripes by the resulting weights, bml_r2.c:131-148 schedules by
+bandwidth).
+
+Discovery reads the kernel's view directly (/sys/class/net + ioctl),
+no vendor library: interface name, state, IPv4 address/netmask, and
+link speed where the driver reports one.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import struct
+from dataclasses import dataclass
+from typing import Optional
+
+from ..core.logging import get_logger
+
+logger = get_logger("runtime.if")
+
+SIOCGIFADDR = 0x8915
+SIOCGIFNETMASK = 0x891B
+
+# reachable/weighted's quality ladder (reference:
+# opal/mca/reachable/weighted/reachable_weighted.c — CQ constants):
+# same subnet beats same-family public, beats same-family private,
+# beats cross-family; bandwidth scales within a tier.
+CQ_SAME_NETWORK = 50
+CQ_PUBLIC_SAME_FAMILY = 40
+CQ_PRIVATE_SAME_FAMILY = 30
+CQ_DIFFERENT_FAMILY = 0
+
+
+@dataclass(frozen=True)
+class Interface:
+    name: str
+    up: bool
+    loopback: bool
+    ipv4: Optional[str]
+    netmask: Optional[str]
+    speed_mbps: int  # 0 when the driver doesn't report
+
+    @property
+    def usable(self) -> bool:
+        return self.up and self.ipv4 is not None
+
+
+def _ioctl_ip(sock, name: str, req: int) -> Optional[str]:
+    import fcntl
+
+    try:
+        packed = struct.pack("256s", name.encode()[:15])
+        out = fcntl.ioctl(sock.fileno(), req, packed)
+        return socket.inet_ntoa(out[20:24])
+    except OSError:
+        return None
+
+
+def discover() -> list[Interface]:
+    """Enumerate host interfaces (the opal_if list)."""
+    out = []
+    try:
+        names = sorted(os.listdir("/sys/class/net"))
+    except OSError:
+        names = []
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    try:
+        for name in names:
+            base = f"/sys/class/net/{name}"
+
+            def read(fname: str, default: str = "") -> str:
+                try:
+                    with open(os.path.join(base, fname)) as f:
+                        return f.read().strip()
+                except OSError:
+                    return default
+
+            state = read("operstate", "down")
+            flags = int(read("flags", "0x0"), 16)
+            loopback = bool(flags & 0x8)  # IFF_LOOPBACK
+            up = state == "up" or (loopback and bool(flags & 0x1))
+            try:
+                speed = int(read("speed", "0"))
+            except ValueError:
+                speed = 0
+            out.append(Interface(
+                name=name,
+                up=up,
+                loopback=loopback,
+                ipv4=_ioctl_ip(sock, name, SIOCGIFADDR),
+                netmask=_ioctl_ip(sock, name, SIOCGIFNETMASK),
+                speed_mbps=max(speed, 0),
+            ))
+    finally:
+        sock.close()
+    return out
+
+
+def usable_interfaces(include_loopback: bool = True) -> list[Interface]:
+    return [
+        i for i in discover()
+        if i.usable and (include_loopback or not i.loopback)
+    ]
+
+
+def _ip_int(ip: str) -> int:
+    return struct.unpack("!I", socket.inet_aton(ip))[0]
+
+
+def _is_private(ip: str) -> bool:
+    v = _ip_int(ip)
+    return (
+        (v >> 24) == 10
+        or (v >> 20) == (172 << 4 | 1)  # 172.16/12
+        or (v >> 16) == (192 << 8 | 168)
+        or (v >> 24) == 127
+    )
+
+
+def connection_quality(local: Interface, remote_ip: str,
+                       remote_speed_mbps: int = 0) -> float:
+    """reachable/weighted's scoring for one (local if, remote addr)
+    pair: quality tier + bandwidth term (min of the two ends)."""
+    if local.ipv4 is None:
+        return 0.0
+    if local.netmask is not None:
+        mask = _ip_int(local.netmask)
+        if (_ip_int(local.ipv4) & mask) == (_ip_int(remote_ip) & mask):
+            tier = CQ_SAME_NETWORK
+        elif _is_private(local.ipv4) == _is_private(remote_ip):
+            tier = (CQ_PRIVATE_SAME_FAMILY if _is_private(remote_ip)
+                    else CQ_PUBLIC_SAME_FAMILY)
+        else:
+            tier = CQ_DIFFERENT_FAMILY
+    else:
+        tier = CQ_PRIVATE_SAME_FAMILY
+    bw = min(local.speed_mbps or 10_000,
+             remote_speed_mbps or 10_000)
+    # tier dominates; bandwidth breaks ties within a tier
+    return tier * 1e6 + bw
+
+
+def link_weights(locals_: list[Interface], remote_ip: str,
+                 remote_speed_mbps: int = 0) -> list[float]:
+    """Per-link striping weights from reachability scores, normalized
+    to sum 1 (feeds dcn_set_link_weights; uniform when nothing scores)."""
+    scores = [
+        connection_quality(i, remote_ip, remote_speed_mbps)
+        for i in locals_
+    ]
+    total = sum(scores)
+    if total <= 0:
+        n = max(len(locals_), 1)
+        return [1.0 / n] * len(locals_)
+    return [s / total for s in scores]
+
+
+def modex_payload() -> list[dict]:
+    """This host's interface list for the modex business card
+    (reference: btl/tcp publishes its address list via PMIx)."""
+    return [
+        {
+            "name": i.name, "ip": i.ipv4, "mask": i.netmask,
+            "speed": i.speed_mbps, "loopback": i.loopback,
+        }
+        for i in usable_interfaces()
+    ]

@@ -87,10 +87,25 @@ def get(key: str, timeout_s: float = 60.0) -> Any:
 
 
 def publish_dcn_address(endpoint, process_index: int) -> None:
-    """PMIx_Put + Commit of this process's DCN listener."""
+    """PMIx_Put + Commit of this process's DCN business card: listener
+    address plus the NIC list (reference: btl/tcp publishes every usable
+    interface address via the modex, btl_tcp_proc.c consumes it for
+    address matching)."""
+    from . import interfaces
+
     put(f"dcn/{process_index}", {
         "ip": endpoint.address[0], "port": endpoint.address[1],
+        "ifaces": interfaces.modex_payload(),
     })
+
+
+def collect_dcn_records(num_processes: int, timeout_s: float = 60.0
+                        ) -> dict[int, dict]:
+    """Full business cards (address + interface list) per process."""
+    return {
+        idx: get(f"dcn/{idx}", timeout_s=timeout_s)
+        for idx in range(num_processes)
+    }
 
 
 def collect_dcn_addresses(num_processes: int, timeout_s: float = 60.0

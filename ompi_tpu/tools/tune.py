@@ -22,13 +22,13 @@ from typing import Callable
 import numpy as np
 
 
-def _time_plan(comm, key: tuple, per_rank: Callable, x, iters: int
-               ) -> float:
+def _time_plan(comm, key: tuple, per_rank: Callable, x, iters: int,
+               check_vma: bool = True) -> float:
     import jax
 
     from ..coll.framework import compile_plan
 
-    plan = compile_plan(comm, key, per_rank)
+    plan = compile_plan(comm, key, per_rank, check_vma=check_vma)
     jax.block_until_ready(plan(x))  # warmup/compile
     best = float("inf")
     for _ in range(iters):
@@ -42,6 +42,7 @@ def sweep_op(comm, opname: str, algos: dict, min_bytes: int,
              max_bytes: int, iters: int) -> list[dict]:
     """Time each algorithm per size; return winner rules sorted by
     size band (first-match format of coll/tuned's Rules)."""
+    from ..coll.tuned import is_pallas_algo
     from ..ops import lookup as op_lookup
 
     op = op_lookup("sum")
@@ -65,7 +66,10 @@ def sweep_op(comm, opname: str, algos: dict, min_bytes: int,
                     per_rank = lambda b, f=fn: f(b, "ranks", root=0)
                 else:
                     per_rank = lambda b, f=fn: f(b, "ranks")
-                times[name] = _time_plan(comm, key, per_rank, x, iters)
+                times[name] = _time_plan(
+                    comm, key, per_rank, x, iters,
+                    check_vma=not is_pallas_algo(name),
+                )
             except Exception:
                 continue  # algorithm invalid for this shape/rank count
         if times:
@@ -91,8 +95,10 @@ def tune(comm, ops=None, min_bytes: int = 256,
         ALLREDUCE_ALGOS,
         ALLTOALL_ALGOS,
         BCAST_ALGOS,
+        _pallas_algos,
     )
 
+    _pallas_algos()  # pallas-vs-xla selection from measurement
     spaces = {
         "allreduce": {
             k: v for k, v in ALLREDUCE_ALGOS.items()

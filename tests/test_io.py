@@ -504,3 +504,96 @@ def test_fcoll_dynamic_small_tail_roundtrip(tmp_path, comm):
         )
     finally:
         config.set("fcoll_select", "")
+
+
+# -- object-store fs component (reference: fs/{pvfs2,ime} pattern;
+# SURVEY §7.8 "GCS/posix") --------------------------------------------------
+
+@pytest.fixture
+def gcs_root(tmp_path):
+    root = str(tmp_path / "objstore")
+    config.set("fs_gcs_fake_root", root)
+    yield root
+    config.set("fs_gcs_fake_root", "")
+
+
+def test_objstore_roundtrip_and_persistence(gcs_root, comm):
+    from ompi_tpu.io import objstore
+
+    uri = "gs://bkt/models/ckpt.bin"
+    data = np.arange(256, dtype=np.uint8)
+    with io_mod.open(comm, uri, "w+") as fh:
+        fh.write_at(0, data)
+        out = np.asarray(fh.read_at(0, 256))
+    np.testing.assert_array_equal(out, data)
+    # close uploaded the object: visible in the store and reopenable
+    store = objstore.LocalObjectStore(gcs_root)
+    assert store.download("bkt", "models/ckpt.bin") == data.tobytes()
+    with io_mod.open(comm, uri, "r") as fh:
+        np.testing.assert_array_equal(
+            np.asarray(fh.read_at(0, 256)), data
+        )
+
+
+def test_objstore_sync_publishes_midlife(gcs_root, comm):
+    from ompi_tpu.io import objstore
+
+    store = objstore.LocalObjectStore(gcs_root)
+    with io_mod.open(comm, "gs://b/k", "w+") as fh:
+        fh.write_at(0, np.full(16, 7, np.uint8))
+        assert not store.exists("b", "k")  # staged only
+        fh.sync()
+        assert store.download("b", "k") == bytes([7] * 16)
+
+
+def test_objstore_collective_two_phase(gcs_root, comm):
+    """The whole fcoll aggregation stack runs unchanged against the
+    staged object fd."""
+    n = comm.size
+    with io_mod.open(comm, "gs://b/coll.bin", "w+") as fh:
+        offs = [r * 8 for r in range(n)]
+        data = np.stack([
+            np.full(8, r + 1, np.uint8) for r in range(n)
+        ])
+        fh.write_at_all(offs, data)
+        out = np.asarray(fh.read_at_all(offs, 8))
+    for r in range(n):
+        np.testing.assert_array_equal(out[r], np.full(8, r + 1))
+
+
+def test_objstore_modes_and_delete(gcs_root, comm):
+    from ompi_tpu.core.errors import IOError_ as IOErr
+
+    with pytest.raises(IOErr):
+        io_mod.open(comm, "gs://b/missing", "r")
+    with io_mod.open(comm, "gs://b/x", "w+") as fh:
+        fh.write_at(0, np.ones(4, np.uint8))
+    # truncate mode discards the prior object
+    with io_mod.open(comm, "gs://b/x", "w+") as fh:
+        assert fh.get_size() == 0
+    io_mod.delete("gs://b/x")
+    with pytest.raises(IOErr):
+        io_mod.delete("gs://b/x")
+
+
+def test_objstore_not_claimed_without_backend(comm, tmp_path):
+    """With no client and no fake root, gs:// paths have no fs
+    component; plain paths still go to posix."""
+    from ompi_tpu.core.errors import IOError_ as IOErr
+    from ompi_tpu.io import fs as fs_mod2
+
+    assert config.get("fs_gcs_fake_root") == ""
+    with pytest.raises(Exception):
+        fs_mod2.select("gs://b/k").fs_open("gs://b/k", fs_mod2.RDONLY)
+    comp = fs_mod2.select(str(tmp_path / "plain.bin"))
+    assert comp.NAME == "posix"
+
+
+def test_objstore_nonblocking_individual(gcs_root, comm):
+    with io_mod.open(comm, "gs://b/nb.bin", "w+") as fh:
+        req = fh.iwrite_at(0, np.arange(32, dtype=np.uint8))
+        req.wait()
+        r2 = fh.iread_at(0, 32)
+        np.testing.assert_array_equal(
+            np.asarray(r2.result()), np.arange(32, dtype=np.uint8)
+        )

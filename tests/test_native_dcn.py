@@ -299,3 +299,101 @@ def test_hier_recv_fails_fast_on_dead_slice():
         assert time.time() - t0 < 15  # failed fast, not full timeout
     finally:
         h0.endpoint.close()
+
+
+# -- weighted multi-link striping (reference: bml_r2.c:131-148) ------------
+
+def test_weighted_frag_striping():
+    """FRAG striping proportions follow configured per-link weights
+    (smooth weighted round-robin; zero weight starves a link)."""
+    from ompi_tpu.btl import dcn
+
+    a = dcn.DcnEndpoint()
+    b = dcn.DcnEndpoint()
+    try:
+        peer = a.connect(b.address[0], b.address[1], cookie=1, nlinks=4)
+        a.set_link_weights(peer, [2.0, 1.0, 1.0, 0.0])
+        payload = bytes(8 << 20)  # 64 FRAGs of 128K
+        a.send_bytes(peer, 5, payload)
+        got = b.recv_bytes(timeout=30)
+        assert got[1] == 5 and len(got[2]) == len(payload)
+        frags = [a.link_frags(peer, i) for i in range(4)]
+        assert sum(frags) == 64
+        assert frags == [32, 16, 16, 0], frags
+
+        # clearing weights resumes uniform striping over all links
+        a.set_link_weights(peer, [])
+        a.send_bytes(peer, 6, payload)
+        b.recv_bytes(timeout=30)
+        delta = [a.link_frags(peer, i) - f for i, f in enumerate(frags)]
+        assert sum(delta) == 64
+        assert max(delta) - min(delta) <= 1, delta
+    finally:
+        a.close()
+        b.close()
+
+
+def test_set_link_weights_unknown_peer():
+    from ompi_tpu.btl import dcn
+
+    ep = dcn.DcnEndpoint()
+    try:
+        with pytest.raises(dcn.DcnError):
+            ep.set_link_weights(99, [1.0])
+    finally:
+        ep.close()
+
+
+# -- NIC enumeration + weighted reachability -------------------------------
+
+def test_interface_discovery_finds_loopback():
+    from ompi_tpu.runtime import interfaces
+
+    ifs = interfaces.discover()
+    lo = [i for i in ifs if i.loopback]
+    assert lo, f"no loopback in {[i.name for i in ifs]}"
+    assert lo[0].ipv4 == "127.0.0.1"
+    assert any(i.usable for i in ifs)
+
+
+def test_connection_quality_ladder():
+    from ompi_tpu.runtime import interfaces as I
+
+    lo = I.Interface("lo", True, True, "10.0.0.1", "255.255.255.0", 1000)
+    same_net = I.connection_quality(lo, "10.0.0.9")
+    same_family = I.connection_quality(lo, "192.168.1.1")
+    public = I.connection_quality(lo, "8.8.8.8")
+    assert same_net > same_family > public
+
+    # bandwidth breaks ties within a tier (min of both ends)
+    fast = I.Interface("f", True, False, "10.0.0.1", "255.0.0.0", 10000)
+    slow = I.Interface("s", True, False, "10.0.0.2", "255.0.0.0", 100)
+    assert I.connection_quality(fast, "10.1.0.1") > \
+        I.connection_quality(slow, "10.1.0.1")
+
+
+def test_link_weights_normalized():
+    from ompi_tpu.runtime import interfaces as I
+
+    a = I.Interface("a", True, False, "10.0.0.1", "255.255.255.0", 1000)
+    b = I.Interface("b", True, False, "192.168.0.1", "255.255.255.0", 1000)
+    ws = I.link_weights([a, b], "10.0.0.7")
+    assert abs(sum(ws) - 1.0) < 1e-9
+    assert ws[0] > ws[1]  # same-subnet interface dominates
+
+
+def test_modex_carries_iface_card():
+    from ompi_tpu.btl import dcn
+    from ompi_tpu.runtime import modex
+
+    modex.clear_local()
+    ep = dcn.DcnEndpoint()
+    try:
+        modex.publish_dcn_address(ep, 0)
+        rec = modex.collect_dcn_records(1)[0]
+        assert rec["port"] == ep.address[1]
+        assert isinstance(rec["ifaces"], list)
+        addrs = modex.collect_dcn_addresses(1)
+        assert addrs[0] == ep.address
+    finally:
+        ep.close()

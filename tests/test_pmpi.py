@@ -1,0 +1,133 @@
+"""PMPI-style interposition shim (reference: ompi/mpi/c weak-symbol
+profiling interface, allreduce.c:36-41; byte-count tool ports
+ompi/mca/common/monitoring's accounting)."""
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu import pmpi
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    for t in pmpi.active():
+        pmpi.detach(t)
+
+
+class _Recorder(pmpi.Tracer):
+    def __init__(self):
+        self.calls = []
+        self.returns = []
+
+    def on_call(self, name, obj, args, kwargs):
+        self.calls.append(name)
+        return len(self.calls)
+
+    def on_return(self, name, obj, token, result, error):
+        self.returns.append((name, token, error is not None))
+
+
+def test_tracer_sees_collectives_and_p2p(comm):
+    rec = _Recorder()
+    pmpi.attach(rec)
+    x = comm.put_rank_major(np.ones((comm.size, 3), np.float32))
+    comm.allreduce(x)
+    comm.rank(0).isend(np.float32(1.0), dest=1, tag=40)
+    comm.rank(1).recv(source=0, tag=40)
+    assert "allreduce" in rec.calls
+    assert "isend" in rec.calls and "recv" in rec.calls
+    # paired returns with matching tokens, no errors
+    names = [n for n, _, _ in rec.returns]
+    assert set(rec.calls) == set(names)
+    assert all(not err for _, _, err in rec.returns)
+
+
+def test_detach_stops_tracing(comm):
+    rec = _Recorder()
+    pmpi.attach(rec)
+    comm.barrier()
+    n = len(rec.calls)
+    pmpi.detach(rec)
+    comm.barrier()
+    assert len(rec.calls) == n
+
+
+def test_pmpi_entry_points_bypass_tracers(comm):
+    """PMPI_X analog: P-prefixed methods and pcall() skip the shim."""
+    rec = _Recorder()
+    pmpi.attach(rec)
+    pmpi.pcall(comm, "barrier")
+    comm.Pbarrier()
+    assert "barrier" not in rec.calls
+
+
+def test_errors_propagate_and_are_reported(comm):
+    rec = _Recorder()
+    pmpi.attach(rec)
+    with pytest.raises(Exception):
+        comm.bcast(comm.put_rank_major(
+            np.ones((comm.size, 2), np.float32)), root=comm.size + 7)
+    assert ("bcast", 1, True) in [
+        (n, t, e) for n, t, e in rec.returns if n == "bcast"
+    ]
+
+
+def test_byte_count_tracer_port(comm):
+    t = pmpi.ByteCountTracer()
+    pmpi.attach(t)
+    x = comm.put_rank_major(np.ones((comm.size, 4), np.float32))
+    comm.allreduce(x)
+    comm.allreduce(x)
+    comm.rank(0).isend(np.zeros(8, np.float32), dest=2, tag=3)
+    comm.rank(2).recv(source=0, tag=3)
+    calls, nbytes = t.coll[(comm.cid, "allreduce")]
+    assert calls == 2 and nbytes == 2 * comm.size * 4 * 4
+    calls, nbytes = t.p2p[(comm.cid, 0, 2)]
+    assert calls == 1 and nbytes == 32
+    out = t.dump()
+    assert "allreduce" in out and "p2p" in out
+
+
+def test_tracer_survives_on_window_and_file(comm, tmp_path):
+    from ompi_tpu import io as io_mod
+    from ompi_tpu.osc import window as osc
+
+    rec = _Recorder()
+    pmpi.attach(rec)
+    w = osc.Window(comm, np.zeros((comm.size, 2), np.float32))
+    w.fence()
+    w.put(np.ones(2, np.float32), target=1)
+    w.fence()
+    with io_mod.open(comm, str(tmp_path / "t.bin"), "w+") as fh:
+        fh.write_at(0, np.arange(4, dtype=np.uint8))
+    assert "fence" in rec.calls and "put" in rec.calls
+    assert "write_at" in rec.calls and "close" in rec.calls
+
+
+def test_uninstall_restores_pristine_methods(comm):
+    pmpi.install()
+    from ompi_tpu.communicator import Communicator
+
+    assert hasattr(Communicator, "Pallreduce")
+    pmpi.uninstall()
+    assert not hasattr(Communicator, "Pallreduce")
+    # back to working order, and reinstall is clean
+    comm.barrier()
+    rec = _Recorder()
+    pmpi.attach(rec)
+    comm.barrier()
+    assert rec.calls == ["barrier"]
