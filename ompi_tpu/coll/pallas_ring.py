@@ -43,7 +43,8 @@ from ..ops.op import Op
 
 __all__ = [
     "ring_allgather", "ring_reduce_scatter", "ring_allreduce",
-    "ring_allreduce_bidir", "tree_bcast", "ppermute_shift",
+    "ring_allreduce_bidir", "ring_allreduce_chunked", "ring_allreduce_rd",
+    "tree_bcast", "tree_reduce", "ppermute_shift",
 ]
 
 _interpret_var = config.register(
@@ -56,6 +57,27 @@ _bidir_var = config.register(
     type=bool, default=False,
     description="Use the bidirectional ring for pallas allreduce "
                 "(both ICI link directions per step)",
+)
+_segment_var = config.register(
+    "coll", "pallas", "segment_bytes",
+    type=int, default=1 << 20,
+    description="Segment size for the chunked HBM-streaming ring "
+                "kernels (reference's segmented-ring knob: 1 MiB, "
+                "coll_tuned_decision_fixed.c:73)",
+)
+_chunk_threshold_var = config.register(
+    "coll", "pallas", "chunk_threshold_bytes",
+    type=int, default=4 << 20,
+    description="Per-shard payload size above which pallas allreduce "
+                "streams segments HBM->VMEM (chunked kernel) instead "
+                "of staging the whole payload in VMEM",
+)
+_rd_cutoff_var = config.register(
+    "coll", "pallas", "rd_cutoff_bytes",
+    type=int, default=10_000,
+    description="Per-shard bytes below which pallas allreduce uses "
+                "recursive doubling (reference: 10000B cutoff, "
+                "coll_tuned_decision_fixed.c:53)",
 )
 
 
@@ -90,13 +112,22 @@ def _allgather_kernel(axis_name: str, n: int, local_ref, out_ref,
 
     out_ref[me] = local_ref[:]
     comm_buf[0] = local_ref[:]
+    # Post-seed credit: gates the upstream neighbor's step-1 write into
+    # comm_buf[0] so a fast neighbor cannot land it before the seed
+    # (kernel-start skew; there is no implicit entry barrier). A
+    # 2-member ring has no step 1 in this n-1-step schedule — emitting
+    # the credit would leave cap_sem[0] non-zero at kernel exit.
+    if n > 2:
+        pltpu.semaphore_signal(cap_sem.at[0], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
 
     for step in range(n - 1):
         slot = step % 2
         nslot = (step + 1) % 2
-        # Backpressure: the slot we are about to fill downstream was
-        # last filled at step-2; wait until the consumer drained it.
-        if step >= 2:
+        # Backpressure: wait for the downstream credit before filling
+        # its slot (step 1: the post-seed credit; later steps: the
+        # consumer drained the slot two steps ago).
+        if step >= 1:
             pltpu.semaphore_wait(cap_sem.at[nslot], 1)
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[slot],
@@ -133,11 +164,16 @@ def _reduce_scatter_kernel(axis_name: str, n: int, op: Op, x_ref, out_ref,
     # block b circulates from rank b+1 around to rank b, accumulating.
     first = jax.lax.rem(me - 1 + n, n)
     comm_buf[0] = x_ref[first]
+    # Post-seed credit gating the upstream step-1 write (see allgather;
+    # same n==2 exclusion — the n-1-step schedule has no step 1 there).
+    if n > 2:
+        pltpu.semaphore_signal(cap_sem.at[0], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
 
     for step in range(n - 1):
         slot = step % 2
         nslot = (step + 1) % 2
-        if step >= 2:
+        if step >= 1:
             pltpu.semaphore_wait(cap_sem.at[nslot], 1)
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[slot],
@@ -177,11 +213,14 @@ def _allreduce_kernel(axis_name: str, n: int, op: Op, x_ref, out_ref,
 
     first = jax.lax.rem(me - 1 + n, n)
     comm_buf[0] = x_ref[first]
+    # Post-seed credit gating the upstream step-1 write (see allgather).
+    pltpu.semaphore_signal(cap_sem.at[0], inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
 
     for step in range(2 * (n - 1)):
         slot = step % 2
         nslot = (step + 1) % 2
-        if step >= 2:
+        if step >= 1:
             pltpu.semaphore_wait(cap_sem.at[nslot], 1)
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[slot],
@@ -211,6 +250,256 @@ def _allreduce_kernel(axis_name: str, n: int, op: Op, x_ref, out_ref,
                 cap_sem.at[nslot], inc=1, device_id=left,
                 device_id_type=pltpu.DeviceIdType.LOGICAL,
             )
+
+
+# ---------------------------------------------------------------------------
+# Chunked (HBM-streaming) ring allreduce: the reference's segmented ring
+# (coll_base_allreduce.c:618-717 — 1 MiB segments pipelined through a
+# bounded buffer) re-built for the TPU memory hierarchy. The payload
+# stays in HBM; the VMEM working set is six segment-sized slots (input
+# prefetch x2, comm buffer x2, output stage x2), so shard sizes are
+# bounded by HBM, not the ~16 MiB VMEM. Data is laid out (n, rows, 128)
+# so every slot slice is a cleanly tiled 2-D block (Mosaic rejects
+# dim-0 slices of 2-D buffers that break the (8,128) tiling — found by
+# compiling on hardware).
+#
+# Flow control has two levels: within a segment, the capacity semaphore
+# of the plain ring kernels; across segments, a credit semaphore — a
+# device may start sending segment i+1 only after its downstream
+# neighbor signals it has drained segment i (the reference's analog is
+# the bounded num_segments pipeline in the segmented ring). One credit
+# is primed at kernel start and the residue drained at kernel end so
+# every segment's wait is unconditional (no predicated semaphore ops).
+# ---------------------------------------------------------------------------
+
+def _sublane(dtype) -> int:
+    """Minimum second-to-last-dim tile for the dtype (pallas_guide:
+    (8,128) f32, (16,128) bf16, (32,128) int8)."""
+    return max(8, 32 // max(1, jnp.dtype(dtype).itemsize))
+
+
+def _allreduce_chunked_kernel(axis_name: str, n: int, op: Op, seg: int,
+                              n_segs: int, x_hbm, out_hbm,
+                              comm_buf, x_buf, out_buf,
+                              send_sem, recv_sem, cap_sem,
+                              x_sem, out_sem, seg_sem):
+    me = jax.lax.axis_index(axis_name)
+    right = jax.lax.rem(me + 1, n)
+    left = jax.lax.rem(me - 1 + n, n)
+
+    # Prime one segment credit so every segment (incl. 0) waits uniformly.
+    pltpu.semaphore_signal(seg_sem, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def seg_body(si, _):
+        off = si * seg
+
+        # Credit from the right neighbor: it drained our previous
+        # segment's sends from its comm buffer.
+        pltpu.semaphore_wait(seg_sem, 1)
+
+        def x_dma(j, slot):
+            # j-th needed input block for this rank's ring schedule:
+            # j=0 seeds the comm buffer, j=s+1 is combined at RS step s.
+            blk = jax.lax.rem(me - 1 - j + 2 * n, n)
+            return pltpu.make_async_copy(
+                x_hbm.at[blk, pl.ds(off, seg)], x_buf.at[slot],
+                x_sem.at[slot])
+
+        def out_dma(blk, slot):
+            return pltpu.make_async_copy(
+                out_buf.at[slot], out_hbm.at[blk, pl.ds(off, seg)],
+                out_sem.at[slot])
+
+        x_dma(0, 0).start()
+        x_dma(1, 1).start()
+        x_dma(0, 0).wait()
+        comm_buf[0] = x_buf[0]
+        # Post-seed credit: the upstream neighbor's step-1 remote write
+        # lands in comm_buf[0] — the slot the seed just filled. Without
+        # this credit a fast left neighbor (already credited for the
+        # next segment at our previous segment's end) could write
+        # comm_buf[0] BEFORE the seed, which then silently overwrites
+        # the delivered partial (the recv semaphore count would still
+        # satisfy our step-1 wait). Gate every step-1 send on it.
+        pltpu.semaphore_signal(cap_sem.at[0], inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+        writes = []  # in-flight VMEM->HBM output copies (unrolled)
+        for step in range(2 * (n - 1)):
+            slot = step % 2
+            nslot = (step + 1) % 2
+            if step >= 1:
+                pltpu.semaphore_wait(cap_sem.at[nslot], 1)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=comm_buf.at[slot],
+                dst_ref=comm_buf.at[nslot],
+                send_sem=send_sem.at[slot],
+                recv_sem=recv_sem.at[nslot],
+                device_id=right,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            # Prefetch the input block for the NEXT reduce-scatter step
+            # while the remote DMA is in flight; its slot held block
+            # `step`, consumed at the previous step.
+            if step + 2 < n:
+                x_dma(step + 2, step % 2).start()
+            rdma.wait()
+            if step < n - 1:
+                # reduce-scatter phase: fold our block into the arrival
+                x_dma(step + 1, (step + 1) % 2).wait()
+                blk = jax.lax.rem(me - step - 2 + 2 * n, n)
+                val = _combine_blocks(op, comm_buf[nslot],
+                                      x_buf[(step + 1) % 2])
+                comm_buf[nslot] = val
+                if step == n - 2:  # blk == me: first fully-reduced block
+                    wslot = len(writes) % 2
+                    if len(writes) >= 2:
+                        writes[-2].wait()
+                    out_buf[wslot] = val
+                    writes.append(out_dma(blk, wslot))
+                    writes[-1].start()
+            else:
+                # allgather phase: stream fully-reduced blocks out
+                blk = jax.lax.rem(me - (step - (n - 1)) - 1 + 2 * n, n)
+                wslot = len(writes) % 2
+                if len(writes) >= 2:
+                    writes[-2].wait()
+                out_buf[wslot] = comm_buf[nslot]
+                writes.append(out_dma(blk, wslot))
+                writes[-1].start()
+            if step < 2 * (n - 1) - 2:
+                pltpu.semaphore_signal(
+                    cap_sem.at[nslot], inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL,
+                )
+        # Drained every send from the left neighbor: grant next credit.
+        pltpu.semaphore_signal(seg_sem, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        # Out-copies must land before their slots are reused next segment.
+        for w in writes[-2:]:
+            w.wait()
+        return 0
+
+    jax.lax.fori_loop(0, n_segs, seg_body, 0)
+    # Consume the residual credit (prime + n_segs signals, n_segs waits).
+    pltpu.semaphore_wait(seg_sem, 1)
+
+
+def _selfdma_chunked_kernel(axis_name: str, seg: int, n_segs: int,
+                            x_hbm, out_hbm,
+                            x_buf, comm_buf, x_sem, send_sem, recv_sem,
+                            out_sem):
+    """Degenerate 1-member ring of the chunked schedule: per segment,
+    HBM->VMEM prefetch, one self-targeted remote DMA (the ICI machinery
+    with device_id == me), VMEM->HBM writeback — double-buffered. This
+    is the bench's on-chip Mosaic proof path: a 1-rank allreduce is the
+    identity, but every DMA engine the n>1 schedule uses runs for real."""
+    def in_dma(si):
+        return pltpu.make_async_copy(
+            x_hbm.at[0, pl.ds(si * seg, seg)], x_buf.at[si % 2],
+            x_sem.at[si % 2])
+
+    def out_dma(si):
+        return pltpu.make_async_copy(
+            comm_buf.at[si % 2], out_hbm.at[0, pl.ds(si * seg, seg)],
+            out_sem.at[si % 2])
+
+    in_dma(0).start()
+    if n_segs > 1:
+        in_dma(1).start()
+    for si in range(n_segs):
+        slot = si % 2
+        in_dma(si).wait()
+        if si >= 2:
+            out_dma(si - 2).wait()  # comm_buf[slot] reader must finish
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=x_buf.at[slot], dst_ref=comm_buf.at[slot],
+            send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+            device_id=jax.lax.axis_index(axis_name),
+            device_id_type=pltpu.DeviceIdType.LOGICAL)
+        rdma.start()
+        rdma.wait()
+        if si + 2 < n_segs:
+            in_dma(si + 2).start()  # x_buf[slot] free after rdma send
+        out_dma(si).start()
+    for si in range(max(0, n_segs - 2), n_segs):
+        out_dma(si).wait()
+
+
+def ring_allreduce_chunked(x: jax.Array, axis_name: str, op: Any = "sum",
+                           seg_bytes: int | None = None) -> jax.Array:
+    """Inside shard_map: this rank's full contribution (any shape) ->
+    fully reduced buffer of the same shape, streamed through VMEM in
+    double-buffered segments. Unlike the whole-payload kernels, handles
+    shards far larger than VMEM (the reference's segmented ring regime,
+    coll_base_allreduce.c:618)."""
+    op = op_lookup(op)
+    n = jax.lax.axis_size(axis_name)
+    if seg_bytes is None:
+        seg_bytes = _segment_var.value
+    shape = x.shape
+    flat = x.reshape(-1)
+    itemsize = jnp.dtype(flat.dtype).itemsize
+    a = _sublane(flat.dtype)
+
+    # Lay out as (n, rows, 128): rows aligned to the sublane tile and
+    # to a whole number of segments.
+    rows = -(-flat.size // (n * 128))
+    rows = -(-rows // a) * a
+    seg_rows = max(a, min(-(-rows // a) * a,
+                          (seg_bytes // (128 * itemsize) // a) * a or a))
+    rows = -(-rows // seg_rows) * seg_rows
+    n_segs = rows // seg_rows
+    pad = n * rows * 128 - flat.size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    blocks = flat.reshape(n, rows, 128)
+
+    if n == 1:
+        kernel = functools.partial(_selfdma_chunked_kernel, axis_name,
+                                   seg_rows, n_segs)
+        scratch = [
+            pltpu.VMEM((2, seg_rows, 128), flat.dtype),
+            pltpu.VMEM((2, seg_rows, 128), flat.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+        ]
+        # collective_id must be absent on a 1-member ring (no barrier).
+        params = pltpu.CompilerParams(has_side_effects=True)
+    else:
+        kernel = functools.partial(_allreduce_chunked_kernel, axis_name,
+                                   n, op, seg_rows, n_segs)
+        scratch = [
+            pltpu.VMEM((2, seg_rows, 128), flat.dtype),
+            pltpu.VMEM((2, seg_rows, 128), flat.dtype),
+            pltpu.VMEM((2, seg_rows, 128), flat.dtype),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ]
+        params = pltpu.CompilerParams(has_side_effects=True,
+                                      collective_id=7)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, rows, 128), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=scratch,
+        compiler_params=params,
+        interpret=_interpret(),
+    )(blocks)
+    flat_out = out.reshape(-1)
+    if pad:
+        flat_out = flat_out[:-pad]
+    return flat_out.reshape(shape)
 
 
 # ---------------------------------------------------------------------------
@@ -341,16 +630,21 @@ def _allreduce_bidir_kernel(axis_name: str, n: int, op: Op, half: int,
         (1, buf_a, ssem_a, rsem_a, csem_a, slice(0, half)),
         (-1, buf_b, ssem_b, rsem_b, csem_b, slice(half, None)),
     )
-    for d, buf, _ss, _rs, _cs, sl in parts:
+    for d, buf, _ss, _rs, csem, sl in parts:
         first = jax.lax.rem(me - d + n, n)
         buf[0] = x_ref[first, sl]
+        # Post-seed credit to this direction's upstream (see allgather).
+        pltpu.semaphore_signal(
+            csem.at[0], inc=1, device_id=jax.lax.rem(me - d + n, n),
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
 
     for step in range(2 * (n - 1)):
         slot = step % 2
         nslot = (step + 1) % 2
         descs = []
         for d, buf, ssem, rsem, csem, sl in parts:
-            if step >= 2:
+            if step >= 1:
                 pltpu.semaphore_wait(csem.at[nslot], 1)
             rdma = pltpu.make_async_remote_copy(
                 src_ref=buf.at[slot],
@@ -381,6 +675,157 @@ def _allreduce_bidir_kernel(axis_name: str, n: int, op: Op, half: int,
                     device_id=jax.lax.rem(me - d + n, n),
                     device_id_type=pltpu.DeviceIdType.LOGICAL,
                 )
+
+
+def _allreduce_rd_kernel(axis_name: str, n: int, op: Op,
+                         x_ref, out_ref, comm_buf, send_sems, recv_sems):
+    """Recursive-doubling allreduce (reference:
+    ompi_coll_base_allreduce_intra_recursivedoubling,
+    coll_base_allreduce.c:130): log2(n) rounds, each exchanging the FULL
+    payload with partner me^2^k — the latency-optimal schedule tuned
+    picks below the 10 KB cutoff. Round k gets its own comm slot AND its
+    own semaphore pair: partners of different rounds live in disjoint
+    hypercube blocks until they meet, so a fast subtree can run rounds
+    ahead — per-round semaphores keep its early DMA from satisfying an
+    earlier round's wait (slot-mod-2 sharing would)."""
+    me = jax.lax.axis_index(axis_name)
+    out_ref[:] = x_ref[:]
+    rounds = (n - 1).bit_length()
+    for k in range(rounds):
+        bit = 1 << k
+        partner = me ^ bit
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref,
+            dst_ref=comm_buf.at[k],
+            send_sem=send_sems.at[k],
+            recv_sem=recv_sems.at[k],
+            device_id=partner,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+        rdma.start()
+        rdma.wait()
+
+        # Rank-ordered combine so non-commutative user ops see the MPI
+        # reduction order (the reference's is_commutative branch).
+        @pl.when(partner < me)
+        def _lower():
+            out_ref[:] = _combine_blocks(op, comm_buf[k], out_ref[:])
+
+        @pl.when(partner >= me)
+        def _upper():
+            out_ref[:] = _combine_blocks(op, out_ref[:], comm_buf[k])
+
+
+def ring_allreduce_rd(x: jax.Array, axis_name: str, op: Any = "sum"
+                      ) -> jax.Array:
+    """Inside shard_map: full local contribution -> fully reduced buffer
+    via recursive doubling (power-of-two axis sizes only, like the
+    reference's variant)."""
+    op = op_lookup(op)
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    if n & (n - 1):
+        raise ValueError(
+            f"recursive doubling needs a power-of-two ring, got {n}"
+        )
+    flat, pad, shape = _pad_chunk(x)
+    rounds = (n - 1).bit_length()
+    kernel = functools.partial(_allreduce_rd_kernel, axis_name, n, op)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.size,), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((rounds, flat.size), flat.dtype),
+            pltpu.SemaphoreType.DMA((rounds,)),
+            pltpu.SemaphoreType.DMA((rounds,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=8,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
+def _tree_reduce_kernel(axis_name: str, n: int, root: int, op: Op,
+                        x_ref, out_ref, comm_buf, send_sems, recv_sems):
+    """Binomial-tree reduce-to-root (reference:
+    ompi_coll_base_reduce_intra_binomial, coll_base_reduce.c): the
+    mirror of the bcast tree — in round k, every rank whose relative
+    rank has lowest set bit 2^k sends its accumulated subtree to
+    relative rank rel-2^k and leaves the game; receivers fold arrivals
+    in ascending subtree order. Per-round buffers + semaphores for the
+    same skew reason as the rd kernel."""
+    me = jax.lax.axis_index(axis_name)
+    rel = jax.lax.rem(me - root + n, n)
+    out_ref[:] = x_ref[:]
+    rounds = (n - 1).bit_length()
+    for k in range(rounds):
+        bit = 1 << k
+        low = rel & (2 * bit - 1)
+        is_send = low == bit
+        is_recv = jnp.logical_and(low == 0, rel + bit < n)
+        dst = jax.lax.rem(me - bit + n, n)  # sender's parent
+        rdma = pltpu.make_async_remote_copy(
+            src_ref=out_ref,
+            dst_ref=comm_buf.at[k],
+            send_sem=send_sems.at[k],
+            recv_sem=recv_sems.at[k],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+        @pl.when(is_send)
+        def _send(rdma=rdma):
+            rdma.start()
+            rdma.wait_send()
+
+        @pl.when(is_recv)
+        def _recv(rdma=rdma):
+            rdma.wait_recv()
+            # arrival comes from rel+bit: higher relative rank, so the
+            # accumulator stays on the left of the fold
+            out_ref[:] = _combine_blocks(op, out_ref[:], comm_buf[k])
+
+
+def tree_reduce(x: jax.Array, axis_name: str, op: Any = "sum",
+                root: int = 0) -> jax.Array:
+    """Inside shard_map: full local contribution -> the reduction at
+    root (other ranks return their partial accumulator — MPI semantics:
+    recvbuf significant only at root)."""
+    op = op_lookup(op)
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x
+    flat, pad, shape = _pad_chunk(x)
+    rounds = (n - 1).bit_length()
+    kernel = functools.partial(_tree_reduce_kernel, axis_name, n,
+                               int(root), op)
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.size,), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((rounds, flat.size), flat.dtype),
+            pltpu.SemaphoreType.DMA((rounds,)),
+            pltpu.SemaphoreType.DMA((rounds,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=9,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
 
 
 def _tree_bcast_kernel(axis_name: str, n: int, root: int,
@@ -513,17 +958,20 @@ def _alltoall_kernel(axis_name: str, n: int, x_ref, out_ref,
     pairwise variant): at step s every rank RDMA-writes block
     (me+s) directly into rank (me+s)'s out[me] — no intermediate
     buffering, each byte crosses ICI exactly once. The EP/Ulysses
-    primitive (SURVEY §2.6, §5.7)."""
+    primitive (SURVEY §2.6, §5.7). Each step has its OWN semaphore
+    pair: the writer of my out at step s is (me-s), a different device
+    each step with no transitive ordering, so a 2-slot rotation would
+    let a fast peer's later-step write satisfy an earlier step's wait
+    and the kernel could exit before the straggler lands."""
     me = jax.lax.axis_index(axis_name)
     out_ref[me] = x_ref[me]
     for step in range(1, n):
         dst = jax.lax.rem(me + step, n)
-        slot = step % 2
         rdma = pltpu.make_async_remote_copy(
             src_ref=x_ref.at[dst],
             dst_ref=out_ref.at[me],
-            send_sem=send_sem.at[slot],
-            recv_sem=recv_sem.at[slot],
+            send_sem=send_sem.at[step - 1],
+            recv_sem=recv_sem.at[step - 1],
             device_id=dst,
             device_id_type=pltpu.DeviceIdType.LOGICAL,
         )
@@ -550,7 +998,8 @@ def ring_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
         in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.SemaphoreType.DMA((2,)), pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=4,
@@ -648,6 +1097,27 @@ def allreduce_block_bidir(b: jax.Array, axis_name: str, op: Any
     return _unsplit_ring(out, pad, shape)
 
 
+def allreduce_block_chunked(b: jax.Array, axis_name: str, op: Any
+                            ) -> jax.Array:
+    """shard_map body for the chunked HBM-streaming ring (shards larger
+    than VMEM; reference regime: segmented ring,
+    coll_base_allreduce.c:618)."""
+    return ring_allreduce_chunked(b, axis_name, op)
+
+
+def allreduce_block_rd(b: jax.Array, axis_name: str, op: Any
+                       ) -> jax.Array:
+    """shard_map body for recursive doubling (small-message regime;
+    reference: <10 KB cutoff, coll_tuned_decision_fixed.c:53)."""
+    return ring_allreduce_rd(b, axis_name, op)
+
+
+def reduce_block(b: jax.Array, axis_name: str, op: Any, root: int = 0
+                 ) -> jax.Array:
+    """shard_map body for binomial-tree reduce-to-root."""
+    return tree_reduce(b, axis_name, op, root=root)
+
+
 def bcast_block(b: jax.Array, axis_name: str, root: int = 0
                 ) -> jax.Array:
     """shard_map body: every rank ends with root's block (binomial
@@ -666,15 +1136,51 @@ class PallasColl(CollComponent):
         x = rank_major_check(comm, x)
         if comm.size == 1:
             return x
-        body = allreduce_block_bidir if _bidir_var.value \
-            else allreduce_block
+        shard_bytes = (x.size // comm.size) * x.dtype.itemsize
+        pof2 = comm.size & (comm.size - 1) == 0
+        if shard_bytes > _chunk_threshold_var.value:
+            # Large payloads stream HBM->VMEM in segments; the
+            # whole-payload kernels would blow the ~16 MiB VMEM.
+            body = allreduce_block_chunked
+        elif shard_bytes < _rd_cutoff_var.value and pof2:
+            # small-message latency regime: log2(n) rounds beats the
+            # ring's 2(n-1) (reference 10 KB cutoff)
+            body = allreduce_block_rd
+        elif _bidir_var.value:
+            body = allreduce_block_bidir
+        else:
+            body = allreduce_block
         key = ("allreduce", "pallas", body.__name__, op.cache_key,
                x.shape, str(x.dtype))
+        if body is allreduce_block_chunked:
+            # the segment size is baked into the traced kernel; a knob
+            # change must not hit a stale plan
+            key = key + (int(_segment_var.value),)
         plan = compile_plan(
             comm, key, lambda b: body(b, "ranks", op),
             check_vma=False,
         )
         return plan(x)
+
+    def reduce(self, comm, x, op, root):
+        """Binomial tree reduce over ICI DMA; result block at root
+        (reference: coll_base_reduce.c binomial)."""
+        op = op_lookup(op)
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x[0] if x.shape[0] == 1 else x[root]
+        if not getattr(op, "commutative", True):
+            # rank-ordered fallback (reference: non-commutative ops take
+            # the linear path, coll_tuned_decision_fixed.c:85)
+            return COLL.component("basic").reduce(comm, x, op, root)
+        key = ("reduce", "pallas", "tree", op.cache_key, root, x.shape,
+               str(x.dtype))
+        plan = compile_plan(
+            comm, key,
+            lambda b: reduce_block(b, "ranks", op, root=root),
+            check_vma=False,
+        )
+        return plan(x)[root]
 
     def bcast(self, comm, x, root):
         x = rank_major_check(comm, x)

@@ -87,8 +87,20 @@ def _pallas_algos() -> None:
         return
     from . import pallas_ring as pr
 
+    def _pallas_rd_guarded(b, axis_name, op):
+        # recursive doubling needs a power-of-two ring; rules naming it
+        # on other sizes degrade to the plain ring instead of failing at
+        # trace time (the reference's decision functions guard the same
+        # way before picking an algorithm)
+        n = jax.lax.axis_size(axis_name)
+        if n & (n - 1):
+            return pr.allreduce_block(b, axis_name, op)
+        return pr.allreduce_block_rd(b, axis_name, op)
+
     ALLREDUCE_ALGOS["pallas_ring"] = pr.allreduce_block
     ALLREDUCE_ALGOS["pallas_bidir"] = pr.allreduce_block_bidir
+    ALLREDUCE_ALGOS["pallas_rd"] = _pallas_rd_guarded
+    ALLREDUCE_ALGOS["pallas_ring_chunked"] = pr.allreduce_block_chunked
     BCAST_ALGOS["pallas_binomial"] = pr.bcast_block
     ALLGATHER_ALGOS["pallas_ring"] = pr.ring_allgather
 
