@@ -240,6 +240,7 @@ def register_components() -> None:
     from . import (  # noqa: F401
         basic,
         demo,
+        hier,
         pallas_ring,
         selfcoll,
         sync,

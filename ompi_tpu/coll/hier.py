@@ -220,7 +220,8 @@ def _exchange_gather(h: SliceHandle, block: np.ndarray, op,
 
 def allreduce(h: SliceHandle, x, op="sum", *, timeout: float = 30.0,
               schedule: Optional[str] = None,
-              segment_bytes: Optional[int] = None):
+              segment_bytes: Optional[int] = None,
+              tag_base: int = _HIER_TAG):
     """Hierarchical allreduce of a rank-major intra-slice buffer. In
     production each controller process drives its own handle; tests
     drive several handles on threads (endpoints are thread-safe).
@@ -237,16 +238,19 @@ def allreduce(h: SliceHandle, x, op="sum", *, timeout: float = 30.0,
     per_rank_bytes = (arr.nbytes // h.comm.size) if arr is not None else 0
     if h.n_slices > 1 and seg > 0 and per_rank_bytes > seg:
         return _allreduce_pipelined(h, x, op, timeout=timeout,
-                                    schedule=schedule, seg_bytes=seg)
+                                    schedule=schedule, seg_bytes=seg,
+                                    tag_base=tag_base)
     partial = phase1_local_reduce(h, x, op)
     global_block = phase2_exchange(
-        h, partial, op, timeout=timeout, schedule=schedule
+        h, partial, op, timeout=timeout, schedule=schedule,
+        tag_base=tag_base,
     )
     return phase3_local_bcast(h, global_block)
 
 
 def _allreduce_pipelined(h: SliceHandle, x, op, *, timeout: float,
-                         schedule: Optional[str], seg_bytes: int):
+                         schedule: Optional[str], seg_bytes: int,
+                         tag_base: int = _HIER_TAG):
     import jax
     import jax.numpy as jnp
 
@@ -271,7 +275,7 @@ def _allreduce_pipelined(h: SliceHandle, x, op, *, timeout: float,
         partial = np.asarray(jax.device_get(dev_red))
         out_segs.append(phase2_exchange(
             h, partial, op, timeout=timeout, schedule=schedule,
-            tag_base=_HIER_TAG + s * rounds_span,
+            tag_base=tag_base + s * rounds_span,
         ))
         SPC.record("hier_segments")
     full = np.concatenate([seg.reshape(-1) for seg in out_segs])
@@ -341,3 +345,279 @@ def wire_slices(handles: list[SliceHandle], *, nlinks: int = 1) -> None:
                     b.endpoint.address[0], b.endpoint.address[1],
                     cookie=a.slice_id + 1, nlinks=nlinks,
                 )
+
+
+# ---------------------------------------------------------------------------
+# COLL component: process-spanning communicators route through the comm
+# vtable (VERDICT r2 item 2; reference: every comm gets its coll table by
+# component query/priority, coll_base_comm_select.c:110-152, with the
+# intra/inter-node hierarchy a component concern like coll/sm).
+#
+# `FabricSlice` is the auto-wired SliceHandle: phases 1/3 run on a local
+# sub-communicator of this controller's ranks; the phase-2 inter-slice
+# exchange is MPI p2p between slice-leader ranks on the PARENT comm —
+# i.e. it rides the pml/fabric engine over DCN, the same layering as the
+# reference's colls sitting on PML send/recv (SURVEY §1 invariant).
+# ---------------------------------------------------------------------------
+
+from .framework import COLL, CollComponent  # noqa: E402
+
+
+def _fabric_wired() -> bool:
+    from ..pml.framework import PML
+
+    try:
+        ob1 = PML.component("ob1")
+    except Exception:
+        return False
+    return getattr(ob1, "_fabric", None) is not None
+
+
+class FabricSlice:
+    """A SliceHandle built automatically from a spanning comm's proc
+    table. Duck-types the surface the exchange schedules use
+    (slice_id / n_slices / peer_ids / endpoint.send_bytes / recv_from /
+    comm for the local phases); no hand wiring, no extra listener."""
+
+    def __init__(self, parent) -> None:
+        import jax
+
+        from ..communicator import Communicator
+        from ..group import Group
+
+        self.parent = parent
+        procs = parent.procs
+        self.slices = sorted({p.process_index for p in procs})
+        slices = self.slices
+        my = jax.process_index()
+        self.slice_id = slices.index(my)
+        self.n_slices = len(slices)
+        self.peer_ids = {s: s for s in range(self.n_slices)}
+        self.leaders: dict[int, int] = {}
+        self.local_ranks: list[int] = []
+        self.rank_slice: list[int] = []  # comm rank -> slice index
+        for r, p in enumerate(procs):
+            s = slices.index(p.process_index)
+            self.rank_slice.append(s)
+            self.leaders.setdefault(s, r)
+            if p.process_index == my:
+                self.local_ranks.append(r)
+        world_ranks = [parent.group.world_ranks[r]
+                       for r in self.local_ranks]
+        self.comm = Communicator(
+            Group(world_ranks), parent._world_procs,
+            name=f"{parent.name}.hier_local", parent_cid=parent.cid,
+        )
+        self.endpoint = self  # send_bytes/recv below
+        self._pending: list = []
+        # Per-collective tag epoch: every vtable collective on this comm
+        # gets a disjoint tag window, so an aborted attempt's stale
+        # payloads can never match a retry's receives (all controllers
+        # bump at entry, keeping epochs aligned in MPI program order).
+        self._epoch = 0
+
+    # SliceHandle surface -------------------------------------------------
+
+    def wire_check(self) -> None:
+        pass  # reachability is the fabric's concern (checked per send)
+
+    def send_bytes(self, peer_slice: int, tag: int, raw: bytes) -> None:
+        dst = self.leaders[peer_slice]
+        me = self.leaders[self.slice_id]
+        req = self.parent.rank(me).isend(
+            np.frombuffer(raw, np.uint8).copy(), dest=dst, tag=tag
+        )
+        self._pending.append(req)
+
+    def recv_from(self, src_slice: int, tag: int,
+                  timeout: float) -> bytes:
+        me = self.leaders[self.slice_id]
+        req = self.parent.rank(me).irecv(
+            source=self.leaders[src_slice], tag=tag
+        )
+        # honor the deadline so a dead peer raises instead of wedging
+        # the surviving controllers (SliceHandle.recv_from semantics)
+        val = req.result(timeout=timeout)
+        return np.asarray(val).tobytes()
+
+    def rank_ordered(self) -> bool:
+        """True when comm ranks ascend with slice index (each process's
+        ranks contiguous, processes in rank order) — the layout where a
+        slice-ordered fold equals MPI's rank-ordered reduction."""
+        return all(a <= b for a, b in
+                   zip(self.rank_slice, self.rank_slice[1:]))
+
+    def finish(self) -> None:
+        """Drain outstanding leader isends (rendezvous sends complete
+        when the peer's CTS arrives during its own exchange)."""
+        pending, self._pending = self._pending, []
+        for req in pending:
+            req.wait()
+
+    def abort_pending(self) -> None:
+        """Drop references to in-flight sends after a failed exchange
+        (they may never complete if the peer died; the next collective
+        uses a fresh tag epoch so late stragglers cannot match it)."""
+        self._pending = []
+
+    def next_tag_base(self) -> int:
+        """Allocate this collective's tag window."""
+        epoch = self._epoch
+        self._epoch += 1
+        return _HIER_TAG + (epoch % 4096) * 0x10000
+
+    def local_rank_major(self, x):
+        """Validate the spanning-comm buffer convention: each controller
+        contributes a rank-major buffer over its LOCAL ranks."""
+        import jax.numpy as jnp
+
+        from ..core.errors import ArgumentError
+
+        arr = x if hasattr(x, "shape") else jnp.asarray(x)
+        if arr.ndim < 1 or arr.shape[0] != self.comm.size:
+            raise ArgumentError(
+                f"{self.parent.name} spans {self.n_slices} controller "
+                f"processes; each contributes a rank-major buffer over "
+                f"its {self.comm.size} local ranks, got shape "
+                f"{getattr(arr, 'shape', None)}"
+            )
+        return arr
+
+
+def comm_slice(comm) -> FabricSlice:
+    """The comm's auto-wired hier handle (built once, cached)."""
+    h = getattr(comm, "_hier_slice", None)
+    if h is None:
+        h = FabricSlice(comm)
+        comm._hier_slice = h
+    return h
+
+
+@COLL.register
+class HierColl(CollComponent):
+    NAME = "hier"
+    PRIORITY = 85  # above tuned (80): device tiers cannot cross controllers
+    DESCRIPTION = ("two-level ICI+DCN collectives for process-spanning "
+                   "communicators (auto-wired from the fabric)")
+
+    def available(self, comm=None, **_) -> bool:
+        if comm is None:
+            return False
+        try:
+            idxs = {p.process_index for p in comm.procs}
+        except Exception:
+            return False
+        if len(idxs) <= 1:
+            return False
+        import jax
+
+        return jax.process_index() in idxs and _fabric_wired()
+
+    def allreduce(self, comm, x, op):
+        h = comm_slice(comm)
+        opo = op_lookup(op)
+        schedule = None
+        if not getattr(opo, "commutative", True):
+            # The rd/ring exchanges combine in arrival/XOR order; only
+            # the gather schedule folds slices in ascending order, which
+            # equals MPI rank order when ranks ascend with slices
+            # (reference: non-commutative ops take the ordered path,
+            # coll_tuned_decision_fixed.c:85).
+            if not h.rank_ordered():
+                raise HierError(
+                    "non-commutative ops on a spanning comm need ranks "
+                    "contiguous per process and processes in rank order"
+                )
+            schedule = "gather"
+        try:
+            out = allreduce(h, h.local_rank_major(x), op,
+                            schedule=schedule,
+                            tag_base=h.next_tag_base())
+            h.finish()
+        except BaseException:
+            h.abort_pending()
+            raise
+        return out
+
+    def bcast(self, comm, x, root):
+        import jax.numpy as jnp
+
+        h = comm_slice(comm)
+        x = h.local_rank_major(x)
+        root_slice = h.rank_slice[root]
+        tag = h.next_tag_base()
+        try:
+            if h.slice_id == root_slice:
+                block = np.asarray(x[h.local_ranks.index(root)])
+                for s in range(h.n_slices):
+                    if s != root_slice:
+                        h.send_bytes(s, tag, block.tobytes())
+            else:
+                raw = h.recv_from(root_slice, tag, timeout=60.0)
+                block = np.frombuffer(
+                    raw, jnp.dtype(x.dtype)
+                ).reshape(x.shape[1:]).copy()
+            out = phase3_local_bcast(h, block)
+            h.finish()
+        except BaseException:
+            h.abort_pending()
+            raise
+        return out
+
+    def reduce(self, comm, x, op, root):
+        """Result lands on the root rank's device (root's controller);
+        other controllers return None (MPI: recvbuf significant only
+        at root)."""
+        import jax
+
+        h = comm_slice(comm)
+        x = h.local_rank_major(x)
+        opo = op_lookup(op)
+        if not getattr(opo, "commutative", True) and not h.rank_ordered():
+            raise HierError(
+                "non-commutative ops on a spanning comm need ranks "
+                "contiguous per process and processes in rank order"
+            )
+        partial = phase1_local_reduce(h, x, opo)
+        root_slice = h.rank_slice[root]
+        tag = h.next_tag_base()
+        try:
+            if h.slice_id == root_slice:
+                # fold in ascending slice order = MPI rank order for
+                # rank-ordered layouts (and a fixed deterministic order
+                # regardless)
+                parts = []
+                for s in range(h.n_slices):
+                    if s == root_slice:
+                        parts.append(partial)
+                    else:
+                        raw = h.recv_from(s, tag, timeout=60.0)
+                        parts.append(np.frombuffer(
+                            raw, partial.dtype).reshape(partial.shape))
+                acc = parts[0]
+                for p in parts[1:]:
+                    acc = opo.np_reduce(acc, p)
+                h.finish()
+                return jax.device_put(acc, comm.procs[root].device)
+            h.send_bytes(root_slice, tag, partial.tobytes())
+            h.finish()
+        except BaseException:
+            h.abort_pending()
+            raise
+        return None
+
+    def barrier(self, comm):
+        """Local device barrier, then a zero-payload leader exchange
+        (gather+release — no controller leaves before all entered)."""
+        h = comm_slice(comm)
+        h.comm.barrier()
+        token = np.zeros(1, np.uint8)
+        try:
+            _exchange_gather(h, token, op_lookup("max"), timeout=60.0,
+                             tag_base=h.next_tag_base())
+            h.finish()
+        except BaseException:
+            h.abort_pending()
+            raise
+        SPC.record("hier_vtable_barriers")
+        return None

@@ -390,6 +390,15 @@ def wire_up(*, endpoint=None, timeout_s: float = 60.0,
     ob1.attach_fabric(engine)
     engine.attach_pml(ob1)
     _progress.register(engine.progress)
+    # Re-run coll selection on live comms: components gated on fabric
+    # availability (coll/hier for spanning comms) become selectable now
+    # (the reference's comm_select runs after add_procs+modex for the
+    # same reason, ompi_mpi_init.c:839-941).
+    from ..communicator import live_comms
+
+    for c in list(live_comms):
+        if not c._freed:
+            c._select_frameworks()
     logger.info(
         "fabric wired: process %d/%d, peers %s", my, n,
         sorted(engine.peer_ids),

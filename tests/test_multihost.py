@@ -328,3 +328,103 @@ def test_elastic_drill_kill_one_controller(tmp_path):
     assert rc1 == 17, f"victim should die deliberately: {rc1}\n{err1[-800:]}"
     assert rc0 == 0, f"survivor failed:\n{err0[-3000:]}"
     assert "RECOVERED size=2" in out0
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 item 2: spanning comms route through the coll vtable — a
+# 2-process job calls comm.allreduce (NOT hier.allreduce) and the hier
+# component carries it over DCN, selection visible via hook/comm_method
+# (reference: coll_base_comm_select.c:110-152).
+# ---------------------------------------------------------------------------
+
+_VTABLE_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.pml import fabric
+    from ompi_tpu.hook import comm_method
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    world = ompi_tpu.init()         # spanning: 2 ranks per process
+    assert world.size == 2 * nprocs
+    eng = fabric.wire_up()
+
+    # selection: wire_up re-ran comm_select; hier must own the spanning
+    # comm's reductions, and the comm_method hook must show it
+    comp = type(world._coll["allreduce"][0]).__name__
+    assert comp == "HierColl", comp
+    rendered = comm_method.render(world)
+    assert "hier" in rendered, rendered
+
+    n_local = 2
+    local = np.stack([
+        np.arange(5, dtype=np.float32) + 10 * pid + r + 1
+        for r in range(n_local)
+    ])
+    out = np.asarray(world.allreduce(local))
+    expect = sum(
+        np.arange(5, dtype=np.float32) + 10 * p + r + 1
+        for p in range(nprocs) for r in range(n_local)
+    )
+    assert out.shape == (n_local, 5), out.shape
+    assert np.allclose(out, expect), (out[0], expect)
+
+    # bcast from a REMOTE root (rank 3 lives on process 1)
+    buf = np.zeros((n_local, 4), np.float32)
+    if pid == 1:
+        buf[1] = [7, 8, 9, 10]   # rank 3's block
+    bout = np.asarray(world.bcast(buf, root=3))
+    assert np.allclose(bout, [7, 8, 9, 10]), bout
+
+    # reduce to a local-to-p0 root: result on root's device, None away
+    rout = world.reduce(local, op="max", root=0)
+    if pid == 0:
+        got = np.asarray(rout)
+        exp = np.arange(5, dtype=np.float32) + 10 * (nprocs - 1) + n_local
+        assert np.allclose(got, exp), (got, exp)
+    else:
+        assert rout is None
+
+    world.barrier()
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def test_two_process_vtable_collectives():
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _VTABLE_WORKER, str(pid),
+             str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "OK" in out
