@@ -215,6 +215,55 @@ def _pallas_proof(device) -> dict:
         return {"compiled": False, "error": f"{type(exc).__name__}: {exc}"}
 
 
+def _fabric_loopback() -> dict:
+    """Wire perf of the native DCN engine over loopback (the btl/tcp
+    analog): small-frame p50 RTT (the fastbox/eager regime) and large-
+    frame bandwidth (the rendezvous segment regime). Host-only — no TPU
+    in the path."""
+    try:
+        from ompi_tpu.btl.dcn import DcnEndpoint
+        from ompi_tpu.native import build
+
+        if not build.available():
+            return {"skipped": "native library unavailable"}
+        a, b = DcnEndpoint(), DcnEndpoint()
+        try:
+            pid_ab = a.connect(b.address[0], b.address[1], cookie=1)
+
+            def xfer(payload: bytes, iters: int) -> list:
+                times = []
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    a.send_bytes(pid_ab, 1, payload)
+                    deadline = t0 + 10.0
+                    while True:
+                        got = b.poll_recv()
+                        if got is not None:
+                            break
+                        if time.perf_counter() > deadline:
+                            raise TimeoutError(
+                                "loopback frame lost (10s deadline)"
+                            )
+                    times.append(time.perf_counter() - t0)
+                return times
+
+            xfer(b"x" * 64, 50)  # warm
+            small = xfer(b"x" * 64, 500)
+            big_payload = b"x" * (4 << 20)
+            big = xfer(big_payload, 20)
+            return {
+                "p50_64B_us": round(float(np.median(small)) * 1e6, 1),
+                "gbps_4MiB": round(
+                    len(big_payload) / float(np.median(big)) / 1e9, 2
+                ),
+            }
+        finally:
+            a.close()
+            b.close()
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def bench_single_chip() -> dict:
     import jax
     import jax.numpy as jnp
@@ -301,6 +350,7 @@ def bench_single_chip() -> dict:
                              "plan-cache overhead (the ob1 small-"
                              "message latency regime)",
             "pallas": _pallas_proof(device),
+            "fabric_loopback": _fabric_loopback(),
         },
     }
 

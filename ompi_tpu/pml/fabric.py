@@ -54,11 +54,16 @@ logger = get_logger("pml.fabric")
 
 #: DCN frame tag marking the MPI p2p channel ("MPIP")
 P2P_TAG = 0x4D504950
+#: DCN frame tag for the small-message fast path ("MPIF"): fixed binary
+#: header + raw array bytes, no per-send dss dict (the sendi/fastbox
+#: analog — reference: btl_sm_fbox.h:22-60, 4 KiB fastbox;
+#: mca_pml_ob1_send_inline -> btl_sendi, pml_ob1_isend.c:246)
+P2P_FAST_TAG = 0x4D504946
 
 K_EAGER = 1  # envelope + payload (ob1 MATCH)
 K_RTS = 2    # envelope only (ob1 RNDV)
 K_CTS = 3    # receiver matched; send the payload (ob1 ACK)
-K_DATA = 4   # rendezvous payload (ob1 FRAG/FIN collapsed: DCN frags)
+K_DATA = 4   # rendezvous payload segment (ob1 FRAG; FIN = last segment)
 
 _eager_var = config.register(
     "pml", "fabric", "eager_limit", type=int, default=64 * 1024,
@@ -69,6 +74,86 @@ _timeout_var = config.register(
     "pml", "fabric", "timeout_s", type=float, default=60.0,
     description="Blocking wait/probe timeout for cross-process p2p",
 )
+_fastbox_var = config.register(
+    "pml", "fabric", "fastbox", type=int, default=4096,
+    description="Largest single-array payload sent via the fixed-header "
+                "fast path (reference: btl/sm 4 KiB fastbox)",
+)
+_segment_var = config.register(
+    "pml", "fabric", "pipeline_segment", type=int, default=1 << 20,
+    description="Rendezvous DATA pipeline segment size (reference: ob1 "
+                "RDMA/FRAG pipeline, pml_ob1_sendreq.h:385-455; 1 MiB "
+                "tuned segment)",
+)
+
+
+# -- fast-path wire format ---------------------------------------------------
+
+import struct
+
+_FAST_MAGIC = 0x4FA57B0C
+#: magic u32 | cid i32 | src i32 | dst i32 | tag i32 | seq q | ndim B |
+#: dtype 8s | shape 6i
+_FAST_HDR = struct.Struct("<IiiiiqB8s6i")
+_FAST_MAX_DIMS = 6
+
+
+def _fast_eligible(value, limit: int):
+    """A single contiguous numeric array/scalar small enough for the
+    fastbox: returns the host ndarray or None."""
+    if not (isinstance(value, (np.ndarray, np.generic))
+            or (hasattr(value, "devices") and hasattr(value, "dtype"))):
+        return None
+    # size/shape/dtype are metadata — reject BEFORE any device readback
+    # so large rendezvous sends don't pay a D2H just to be turned away
+    if (getattr(value, "nbytes", limit + 1) > limit
+            or getattr(value, "ndim", _FAST_MAX_DIMS + 1) > _FAST_MAX_DIMS
+            or np.dtype(value.dtype).kind not in "biufc"):
+        # extension dtypes (bfloat16 etc.) don't round-trip through
+        # dtype.str — they take the dss path
+        return None
+    arr = np.asarray(value)  # host readback only for fastbox-sized data
+    return np.ascontiguousarray(arr)
+
+
+
+def encode_fast(cid: int, src: int, dst: int, tag: int, seq: int,
+                arr: np.ndarray) -> bytes:
+    shape = list(arr.shape) + [0] * (_FAST_MAX_DIMS - arr.ndim)
+    hdr = _FAST_HDR.pack(
+        _FAST_MAGIC, cid, src, dst, tag, seq, arr.ndim,
+        arr.dtype.str.encode().ljust(8, b"\0"), *shape,
+    )
+    return hdr + arr.tobytes()
+
+
+def decode_fast(raw: bytes) -> dict:
+    """Parse a fast frame into the ordered-stream msg shape."""
+    (magic, cid, src, dst, tag, seq, ndim, dtype_s,
+     *shape) = _FAST_HDR.unpack_from(raw)
+    if magic != _FAST_MAGIC:
+        raise FabricError(f"bad fast-frame magic {magic:#x}")
+    dtype = np.dtype(dtype_s.rstrip(b"\0").decode())
+    payload = _FastPayload(dtype, tuple(shape[:ndim]),
+                           raw[_FAST_HDR.size:])
+    return {
+        "k": K_EAGER, "cid": cid, "src": src, "dst": dst, "tag": tag,
+        "seq": seq, "nb": len(raw) - _FAST_HDR.size, "pay": payload,
+    }
+
+
+class _FastPayload:
+    """Decoded-fast-frame marker accepted by FabricEngine.place."""
+
+    __slots__ = ("dtype", "shape", "raw")
+
+    def __init__(self, dtype, shape, raw) -> None:
+        self.dtype = dtype
+        self.shape = shape
+        self.raw = raw
+
+    def to_array(self) -> np.ndarray:
+        return np.frombuffer(self.raw, self.dtype).reshape(self.shape)
 
 
 class FabricError(OmpiTpuError):
@@ -168,7 +253,7 @@ class FabricEngine:
                     return idx
         raise FabricError(f"message on unmapped dcn peer {peer}")
 
-    def _send(self, dst_idx: int, msg: dict) -> None:
+    def _send_raw(self, dst_idx: int, dcn_tag: int, raw: bytes) -> None:
         pid = self.peer_ids.get(dst_idx)
         if pid is None:
             raise FabricError(
@@ -176,7 +261,10 @@ class FabricEngine:
                 f"(wired: {sorted(self.peer_ids)})"
             )
         self.ep.check_peer(pid, what=f"process {dst_idx}")
-        self.ep.send_bytes(pid, P2P_TAG, dss.pack(msg))
+        self.ep.send_bytes(pid, dcn_tag, raw)
+
+    def _send(self, dst_idx: int, msg: dict) -> None:
+        self._send_raw(dst_idx, P2P_TAG, dss.pack(msg))
 
     # -- send path ---------------------------------------------------------
 
@@ -197,6 +285,17 @@ class FabricEngine:
             key = (comm.cid, dst_idx)
             seq = self._send_seq.get(key, 0)
             self._send_seq[key] = seq + 1
+        fast_arr = _fast_eligible(value, int(_fastbox_var.value))
+        if fast_arr is not None:
+            # sendi/fastbox analog: fixed binary header + raw bytes, no
+            # dss dict built or parsed on either side
+            self._send_raw(
+                dst_idx, P2P_FAST_TAG,
+                encode_fast(comm.cid, src, dst, tag, seq, fast_arr),
+            )
+            SPC.record("fabric_fast_sends")
+            req._mark_sent(value)
+            return req
         head = {
             "cid": comm.cid, "src": src, "dst": dst, "tag": tag,
             "seq": seq, "nb": nbytes,
@@ -228,10 +327,15 @@ class FabricEngine:
             if got is None:
                 break
             peer, tag, raw = got
-            if tag != P2P_TAG:
+            if tag == P2P_FAST_TAG:
+                self._dispatch(self._peer_index(peer), decode_fast(raw))
+                SPC.record("fabric_fast_recvs")
+            elif tag == P2P_TAG:
+                self._dispatch(self._peer_index(peer),
+                               dss.unpack_one(raw))
+            else:
                 logger.warning("non-p2p frame (tag %#x) on fabric", tag)
                 continue
-            self._dispatch(self._peer_index(peer), dss.unpack_one(raw))
             n += 1
         # Streams held on a not-yet-created communicator (the comm-
         # creation race) retry here once the local comm exists.
@@ -311,7 +415,7 @@ class FabricEngine:
         env = pending.env
         with self._lock:
             self._await_data[(pending.src_idx, pending.comm_cid,
-                              pending.seq)] = (req, pending)
+                              pending.seq)] = (req, pending, {})
         req.block_on_progress = True
         self._send(pending.src_idx, {
             "k": K_CTS, "cid": pending.comm_cid, "seq": pending.seq,
@@ -329,29 +433,55 @@ class FabricEngine:
                 f"seq={msg['seq']} from process {src_idx})"
             )
         value, req = entry
-        self._send(src_idx, {
-            "k": K_DATA, "cid": msg["cid"], "seq": msg["seq"],
-            "src": msg["src"], "dst": msg["dst"], "tag": msg["tag"],
-            "nb": msg["nb"], "pay": pack_value(value),
-        })
+        # Pipeline the payload as segments (ob1 schedules RNDV FRAGs the
+        # same way, pml_ob1_sendreq.h:385-455): bounded per-message DCN
+        # frames, progressive arrival on the receiver, and a transfer
+        # counter that moves per segment instead of one giant blob.
+        raw = pack_value(value)
+        seg = max(1, int(_segment_var.value))
+        n_seg = max(1, -(-len(raw) // seg))
+        for si in range(n_seg):
+            self._send(src_idx, {
+                "k": K_DATA, "cid": msg["cid"], "seq": msg["seq"],
+                "src": msg["src"], "dst": msg["dst"], "tag": msg["tag"],
+                "nb": msg["nb"], "segs": n_seg, "si": si,
+                "pay": raw[si * seg:(si + 1) * seg],
+            })
+            SPC.record("fabric_data_segments_sent")
         req._mark_sent(value)
 
     def _on_data(self, src_idx: int, msg: dict) -> None:
+        """A rendezvous payload segment arrived. Segments of one message
+        reassemble by index (striped DCN links may reorder them); the
+        recv completes when the last lands — ob1's FRAG accounting via
+        bytes_received (pml_ob1_recvreq)."""
+        key = (src_idx, msg["cid"], msg["seq"])
+        n_seg = int(msg.get("segs", 1))
+        si = int(msg.get("si", 0))
         with self._lock:
-            entry = self._await_data.pop(
-                (src_idx, msg["cid"], msg["seq"]), None
-            )
-        if entry is None:
-            raise FabricError(
-                f"DATA without a matched recv (cid={msg['cid']} "
-                f"seq={msg['seq']})"
-            )
-        req, pending = entry
-        value = unpack_value(msg["pay"], device=pending.dst_proc.device)
+            entry = self._await_data.get(key)
+            if entry is None:
+                raise FabricError(
+                    f"DATA without a matched recv (cid={msg['cid']} "
+                    f"seq={msg['seq']})"
+                )
+            req, pending, parts = entry
+            parts[si] = msg["pay"]
+            SPC.record("fabric_data_segments_recvd")
+            if len(parts) < n_seg:
+                return
+            self._await_data.pop(key, None)
+        raw = b"".join(parts[i] for i in range(n_seg))
+        value = unpack_value(raw, device=pending.dst_proc.device)
         req._matched(pending.env, value)
         SPC.record("fabric_rndv_delivered")
 
-    def place(self, payload_bytes: bytes, dst_proc) -> Any:
+    def place(self, payload_bytes, dst_proc) -> Any:
+        import jax
+
+        if isinstance(payload_bytes, _FastPayload):
+            return jax.device_put(payload_bytes.to_array(),
+                                  dst_proc.device)
         return unpack_value(payload_bytes, device=dst_proc.device)
 
     # -- teardown ----------------------------------------------------------
@@ -376,6 +506,13 @@ def wire_up(*, endpoint=None, timeout_s: float = 60.0,
     my = jax.process_index()
     n = jax.process_count()
     ep = endpoint if endpoint is not None else DcnEndpoint()
+    # Arm the native tag-matching channel BEFORE publishing the address:
+    # a fast peer may send MTL frames the moment it can reach us, and an
+    # unarmed engine would complete them onto the plain queue where the
+    # progress loop discards unknown tags.
+    from .mtl import MTL_MATCH_TAG
+
+    ep.enable_matching(MTL_MATCH_TAG)
     modex.put(f"p2p/{my}", {"ip": ep.address[0], "port": ep.address[1]})
     engine = FabricEngine(ep, my, n)
     for idx in range(n):

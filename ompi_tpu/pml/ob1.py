@@ -165,8 +165,18 @@ def _nbytes_of(value) -> int:
 
     total = 0
     for leaf in jax.tree.leaves(value):
-        arr = jnp.asarray(leaf)
-        total += arr.size * arr.dtype.itemsize
+        if hasattr(leaf, "nbytes"):
+            total += int(leaf.nbytes)
+        elif isinstance(leaf, (bytes, bytearray, str)):
+            total += len(leaf)
+        elif isinstance(leaf, (bool, int, float, complex)) or leaf is None:
+            total += 8
+        else:  # uncommon leaf types: best-effort array view
+            try:
+                arr = jnp.asarray(leaf)
+                total += arr.size * arr.dtype.itemsize
+            except (TypeError, ValueError):
+                total += 8
     return total
 
 

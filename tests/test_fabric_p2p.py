@@ -129,6 +129,9 @@ _WORKER = textwrap.dedent(r"""
     # 0,1 owned by process 0, ranks 2,3 by process 1.
     world = ompi_tpu.init()
     assert world.size == 2 * nprocs, world.size
+    from ompi_tpu.core import config
+    from ompi_tpu.core.counters import SPC
+    config.set("pml_fabric_pipeline_segment", 64 * 1024)
     eng = fabric.wire_up()
 
     big = np.arange(64 * 1024, dtype=np.float32)  # 256 KiB > eager
@@ -162,6 +165,15 @@ _WORKER = textwrap.dedent(r"""
         # reply eagerly to P0
         world.rank(3).send(np.float32(99.0), dest=0, tag=11)
         world.rank(2).send(np.array([5, 6], np.int32), dest=1, tag=13)
+    snap = SPC.snapshot()
+    if pid == 0:
+        # the scalar send took the fastbox path; the 256 KiB rendezvous
+        # left as >= 4 pipelined DATA segments (64 KiB each)
+        assert snap.get("fabric_fast_sends", 0) >= 1, snap
+        assert snap.get("fabric_data_segments_sent", 0) >= 4, snap
+    else:
+        assert snap.get("fabric_fast_recvs", 0) >= 1, snap
+        assert snap.get("fabric_data_segments_recvd", 0) >= 4, snap
     print(f"WORKER {pid} OK", flush=True)
 """)
 
@@ -225,3 +237,254 @@ def test_unknown_cid_holds_until_comm_exists():
     known["ready"] = True
     assert eng.progress() == 0  # no new wire traffic...
     assert [s for s, _ in eng._pml.arrivals] == [0]  # ...but delivered
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 item 4: small-message fast path (sendi/fastbox analog) and
+# segmented rendezvous DATA pipeline.
+# ---------------------------------------------------------------------------
+
+def test_fast_frame_roundtrip():
+    from ompi_tpu.pml import fabric
+
+    arr = np.arange(12, dtype=np.int16).reshape(3, 4)
+    raw = fabric.encode_fast(5, 1, 2, 77, 9, arr)
+    msg = fabric.decode_fast(raw)
+    assert (msg["cid"], msg["src"], msg["dst"], msg["tag"],
+            msg["seq"]) == (5, 1, 2, 77, 9)
+    assert msg["k"] == fabric.K_EAGER and msg["nb"] == arr.nbytes
+    np.testing.assert_array_equal(msg["pay"].to_array(), arr)
+
+
+def test_fast_eligibility():
+    from ompi_tpu.pml import fabric
+
+    assert fabric._fast_eligible(np.ones(8, np.float32), 4096) is not None
+    assert fabric._fast_eligible(np.ones(4096, np.float32), 4096) is None
+    assert fabric._fast_eligible({"tree": 1}, 4096) is None  # pytree
+    assert fabric._fast_eligible(np.float64(3.5), 4096) is not None
+
+
+def test_rndv_data_segments_reassemble_out_of_order():
+    """Striped DCN links may reorder DATA segments; the recv completes
+    only when every indexed segment landed (ob1 FRAG accounting)."""
+    from types import SimpleNamespace
+
+    from ompi_tpu.pml import fabric as fmod
+    from ompi_tpu.pml.fabric import K_DATA
+
+    eng = _make_engine()
+    delivered = []
+
+    class _Req:
+        def _matched(self, env, value):
+            delivered.append(value)
+
+    payload = {"x": np.arange(1000, dtype=np.float32)}
+    raw = fmod.pack_value(payload)
+    seg = 256
+    n_seg = -(-len(raw) // seg)
+    assert n_seg >= 3
+    pending = SimpleNamespace(
+        env=None, dst_proc=SimpleNamespace(device=None))
+    eng._await_data[(1, 0, 7)] = (_Req(), pending, {})
+
+    order = list(range(n_seg))
+    order[0], order[-1] = order[-1], order[0]  # last segment first
+    for si in order:
+        eng._on_data(1, {
+            "k": K_DATA, "cid": 0, "seq": 7, "src": 2, "dst": 0,
+            "tag": 3, "nb": len(raw), "segs": n_seg, "si": si,
+            "pay": raw[si * seg:(si + 1) * seg],
+        })
+        if si != order[-1]:
+            assert not delivered  # incomplete: stays buffered
+    assert len(delivered) == 1
+    np.testing.assert_array_equal(delivered[0]["x"], payload["x"])
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 item 7: real mtl — tag matching offloaded to the native DCN
+# engine (reference: mtl.h:418-421; posted-recv FIFO + unexpected queue
+# run in the transport thread, not Python).
+# ---------------------------------------------------------------------------
+
+def test_native_matching_offload_inprocess():
+    import time
+
+    from ompi_tpu.btl.dcn import DcnEndpoint
+    from ompi_tpu.pml import fabric
+    from ompi_tpu.pml.mtl import MTL_MATCH_TAG
+
+    a, b = DcnEndpoint(), DcnEndpoint()
+    pid = a.connect(b.address[0], b.address[1], cookie=3)
+    b.enable_matching(MTL_MATCH_TAG)
+    try:
+        # unexpected-then-post: arrival parks in the C++ unexpected
+        # queue; probe sees it; post matches immediately
+        frame = fabric.encode_fast(7, 0, 1, 42, 0,
+                                   np.arange(5, dtype=np.float32))
+        a.send_bytes(pid, MTL_MATCH_TAG, frame)
+        for _ in range(400):
+            if b.match_stat(1) == 1:
+                break
+            time.sleep(0.005)
+        assert b.match_stat(1) == 1
+        pr = b.match_probe(7, -1, 1, -1)
+        assert pr is not None and pr[0] == 0 and pr[1] == 42
+        pay = b.post_recv(101, 7, 0, 1, 42)
+        assert pay is not None
+        msg = fabric.decode_fast(pay)
+        np.testing.assert_array_equal(
+            msg["pay"].to_array(), np.arange(5, dtype=np.float32))
+
+        # post-then-arrive: the epoll thread makes the match (wildcard
+        # src and tag)
+        assert b.post_recv(102, 7, -1, 1, -1) is None
+        a.send_bytes(pid, MTL_MATCH_TAG,
+                     fabric.encode_fast(7, 0, 1, 99, 1, np.float64(2.5)))
+        got = None
+        for _ in range(400):
+            got = b.poll_matched()
+            if got:
+                break
+            time.sleep(0.005)
+        assert got is not None and got[0] == 102
+        m2 = fabric.decode_fast(got[1])
+        assert float(m2["pay"].to_array()) == 2.5 and m2["tag"] == 99
+
+        # DCN-level rendezvous payload still lands in the match engine
+        big = np.arange(100_000, dtype=np.float32)
+        assert b.post_recv(103, 7, 2, 1, 5) is None
+        a.send_bytes(pid, MTL_MATCH_TAG,
+                     fabric.encode_fast(7, 2, 1, 5, 0, big))  # new (src) stream: seq from 0
+        got = None
+        for _ in range(800):
+            got = b.poll_matched()
+            if got:
+                break
+            time.sleep(0.005)
+        assert got is not None and got[0] == 103
+        np.testing.assert_array_equal(
+            fabric.decode_fast(got[1])["pay"].to_array(), big)
+        assert b.match_stat(2) >= 3  # all three matched in the engine
+    finally:
+        a.close()
+        b.close()
+
+
+_CM_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.core import config
+    from ompi_tpu.core.counters import SPC
+    from ompi_tpu.pml import fabric
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    config.set("pml_select", "cm")
+    world = ompi_tpu.init()
+    eng = fabric.wire_up()
+    assert world.pml.NAME == "cm", world.pml.NAME
+
+    if pid == 0:
+        world.rank(0).send(np.float32(7.0), dest=2, tag=11)
+        world.rank(1).send({"w": np.arange(6, dtype=np.int32)},
+                           dest=3, tag=12)
+        # engine-matched receive from the remote side
+        back = world.rank(0).recv(source=3, tag=13)
+        assert float(np.asarray(back)) == 21.0
+    else:
+        # post BEFORE arrival possible + wildcard src over remote
+        got = world.rank(2).recv(source=-1, tag=11)
+        assert float(np.asarray(got)) == 7.0
+        tree = world.rank(3).recv(source=1, tag=12)
+        np.testing.assert_array_equal(np.asarray(tree["w"]),
+                                      np.arange(6))
+        world.rank(3).send(np.float32(21.0), dest=0, tag=13)
+        snap = SPC.snapshot()
+        assert snap.get("mtl_matched_recvs", 0) >= 2, snap
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def test_two_process_cm_mtl_offload():
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _CM_WORKER, str(pid), str(nprocs),
+             coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "OK" in out
+
+
+def test_native_matching_non_overtaking():
+    """An eager frame completes before an earlier rendezvous to the same
+    envelope; the matcher must still release them in send (seq) order —
+    MPI non-overtaking (reference: expected_sequence,
+    pml_ob1_recvfrag.c:387-412)."""
+    import time
+
+    from ompi_tpu.btl.dcn import DcnEndpoint
+    from ompi_tpu.pml import fabric
+    from ompi_tpu.pml.mtl import MTL_MATCH_TAG
+
+    a, b = DcnEndpoint(), DcnEndpoint()
+    pid = a.connect(b.address[0], b.address[1], cookie=4)
+    b.enable_matching(MTL_MATCH_TAG)
+    try:
+        assert b.post_recv(201, 8, 0, 1, 7) is None
+        assert b.post_recv(202, 8, 0, 1, 7) is None
+        big = np.arange(200_000, dtype=np.float32)  # rndv at DCN level
+        small = np.float32(1.0)                     # eager: finishes 1st
+        a.send_bytes(pid, MTL_MATCH_TAG,
+                     fabric.encode_fast(8, 0, 1, 7, 0, big))
+        a.send_bytes(pid, MTL_MATCH_TAG,
+                     fabric.encode_fast(8, 0, 1, 7, 1, small))
+        got = []
+        for _ in range(1000):
+            m = b.poll_matched()
+            if m:
+                got.append(m)
+            if len(got) == 2:
+                break
+            time.sleep(0.005)
+        assert len(got) == 2
+        assert got[0][0] == 201 and got[1][0] == 202, [g[0] for g in got]
+        np.testing.assert_array_equal(
+            fabric.decode_fast(got[0][1])["pay"].to_array(), big)
+        assert float(
+            fabric.decode_fast(got[1][1])["pay"].to_array()) == 1.0
+    finally:
+        a.close()
+        b.close()
