@@ -173,6 +173,72 @@ class DcnEndpoint:
         (every link died — the btl_tcp endpoint-failed state)."""
         return int(self._lib.dcn_peer_links(self._ctx, peer))
 
+    # -- tag-matching offload (reference: mtl.h:418-421) -------------------
+
+    def enable_matching(self, dcn_tag: int) -> None:
+        """Divert completed messages carrying `dcn_tag` into the
+        engine's matching thread (-1 disables)."""
+        self._lib.dcn_enable_matching(self._ctx, dcn_tag)
+
+    def post_recv(self, handle: int, cid: int, src: int, dst: int,
+                  tag: int) -> Optional[bytes]:
+        """Post a receive to the engine (src/tag < 0 = wildcard).
+        Returns the payload immediately when an unexpected message
+        already matches; None when queued for the transport thread."""
+        receipt = self._lib.dcn_post_recv(
+            self._ctx, handle, cid, src, dst, tag
+        )
+        if receipt == 0:
+            return None
+        return self._read_receipt(int(receipt))
+
+    def poll_matched(self) -> Optional[tuple[int, bytes]]:
+        """(handle, payload) of one match made by the transport thread,
+        or None."""
+        import ctypes
+
+        handle = ctypes.c_longlong(0)
+        receipt = self._lib.dcn_poll_matched(
+            self._ctx, ctypes.byref(handle)
+        )
+        if receipt == 0:
+            return None
+        return int(handle.value), self._read_receipt(int(receipt))
+
+    def match_probe(self, cid: int, src: int, dst: int, tag: int
+                    ) -> Optional[tuple[int, int, int]]:
+        """(src, tag, nbytes) of the first compatible unexpected
+        message, without consuming it (MPI_Iprobe)."""
+        import ctypes
+
+        o_src = ctypes.c_int(0)
+        o_tag = ctypes.c_int(0)
+        o_len = ctypes.c_longlong(0)
+        hit = self._lib.dcn_match_probe(
+            self._ctx, cid, src, dst, tag, ctypes.byref(o_src),
+            ctypes.byref(o_tag), ctypes.byref(o_len),
+        )
+        if not hit:
+            return None
+        return int(o_src.value), int(o_tag.value), int(o_len.value)
+
+    def match_stat(self, what: int) -> int:
+        """0=posted depth, 1=unexpected depth, 2=matches, 3=unexpected
+        arrivals."""
+        return int(self._lib.dcn_match_stat(self._ctx, what))
+
+    def _read_receipt(self, receipt: int) -> bytes:
+        length = int(self._lib.dcn_receipt_len(self._ctx, receipt))
+        if length < 0:
+            raise DcnError(f"unknown matched receipt {receipt}")
+        buf = np.empty(max(1, length), np.uint8)
+        got = self._lib.dcn_read(
+            self._ctx, receipt, buf.ctypes.data, length
+        )
+        if got != length:
+            raise DcnError(f"short matched read {got} != {length}")
+        return buf[:length].tobytes()
+
     def peer_alive(self, peer: int) -> bool:
         return self.peer_links(peer) > 0
 

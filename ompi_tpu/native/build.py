@@ -154,6 +154,23 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
     ]
     lib.dcn_link_frags.restype = LL
     lib.dcn_link_frags.argtypes = [P, ctypes.c_int, ctypes.c_int]
+    lib.dcn_enable_matching.restype = None
+    lib.dcn_enable_matching.argtypes = [P, LL]
+    lib.dcn_post_recv.restype = LL
+    lib.dcn_post_recv.argtypes = [P, LL, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int]
+    lib.dcn_poll_matched.restype = LL
+    lib.dcn_poll_matched.argtypes = [P, ctypes.POINTER(LL)]
+    lib.dcn_match_probe.restype = ctypes.c_int
+    lib.dcn_match_probe.argtypes = [
+        P, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(LL),
+    ]
+    lib.dcn_match_stat.restype = LL
+    lib.dcn_match_stat.argtypes = [P, ctypes.c_int]
+    lib.dcn_receipt_len.restype = LL
+    lib.dcn_receipt_len.argtypes = [P, LL]
     lib.dcn_destroy.restype = None
     lib.dcn_destroy.argtypes = [P]
 

@@ -33,6 +33,7 @@
 #include <unistd.h>
 
 #include <algorithm>
+#include <array>
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
@@ -139,10 +140,64 @@ struct Ctx {
   std::deque<int64_t> send_done;  // completed outgoing msg ids
   int64_t next_receipt = 1;
   std::unordered_map<int64_t, InMsg> recv_ready;  // receipt -> msg
+  // MPI tag-matching offload (the mtl rationale — reference
+  // mtl.h:418-421: transports with native MPI matching; here the epoll
+  // thread plays the matching NIC). Completed messages whose DCN tag
+  // equals match_tag get their MPI envelope parsed HERE and matched
+  // against posted receives without waking Python at all.
+  struct PostedRecv {
+    int64_t handle;
+    int32_t cid, src, dst, tag;  // src/tag < 0 = wildcard
+  };
+  std::atomic<int64_t> match_tag{-1};  // -1 = offload disabled
+  std::deque<PostedRecv> posted;
+  std::deque<std::pair<int, int64_t>> unexpected_m;  // arrival order
+  std::deque<std::array<int64_t, 2>> matched_done;   // {handle,receipt}
+  // MPI non-overtaking: completion order is NOT send order (an eager
+  // frame can finish before an earlier rndv to the same peer), so the
+  // matcher releases messages per-stream in envelope-seq order — the
+  // same expected_sequence + can't-match hold the reference keeps in
+  // pml_ob1_recvfrag.c:387-412, here in the transport thread.
+  std::map<std::array<int64_t, 4>, int64_t> match_expect;
+  std::map<std::array<int64_t, 4>,
+           std::map<int64_t, std::pair<int, int64_t>>> match_held;
   // stats
   std::atomic<int64_t> bytes_sent{0}, bytes_recv{0};
   std::atomic<int64_t> eager_sends{0}, rndv_sends{0}, frags_sent{0};
+  std::atomic<int64_t> offload_matches{0}, offload_unexpected{0};
 };
+
+// The envelope layout shared with pml/fabric's fast-frame header
+// (struct format "<IiiiiqB8s6i"): magic u32 | cid i32 | src i32 |
+// dst i32 | tag i32 | seq i64 | ndim u8 | dtype 8s | shape 6*i32.
+constexpr uint32_t kEnvelopeMagic = 0x4FA57B0Cu;
+constexpr size_t kEnvelopeSize = 4 + 4 * 4 + 8 + 1 + 8 + 6 * 4;
+
+struct MpiEnvelope {
+  int32_t cid = 0, src = 0, dst = 0, tag = 0;
+  int64_t seq = 0;
+  bool ok = false;
+};
+
+MpiEnvelope parse_envelope(const std::vector<char>& d) {
+  MpiEnvelope e;
+  if (d.size() < kEnvelopeSize) return e;
+  uint32_t magic;
+  memcpy(&magic, d.data(), 4);
+  if (magic != kEnvelopeMagic) return e;
+  memcpy(&e.cid, d.data() + 4, 4);
+  memcpy(&e.src, d.data() + 8, 4);
+  memcpy(&e.dst, d.data() + 12, 4);
+  memcpy(&e.tag, d.data() + 16, 4);
+  memcpy(&e.seq, d.data() + 20, 8);
+  e.ok = true;
+  return e;
+}
+
+bool env_matches(const Ctx::PostedRecv& r, const MpiEnvelope& e) {
+  return r.cid == e.cid && r.dst == e.dst &&
+         (r.src < 0 || r.src == e.src) && (r.tag < 0 || r.tag == e.tag);
+}
 
 void set_nonblock(int fd) {
   int fl = fcntl(fd, F_GETFL, 0);
@@ -237,6 +292,72 @@ void schedule_frags(Ctx* c, int64_t msgid, OutMsg& m) {
 void handle_handshake(Ctx* c, Link& l, int64_t cookie);
 
 // mu held.
+// mu held. Feed one in-order message into the matching engine: scan
+// posted receives (the reference's mca_pml_ob1_recv_frag match_one,
+// but running in the transport thread) or park it unexpected.
+void match_one(Ctx* c, std::pair<int, int64_t> key,
+               const MpiEnvelope& e) {
+  auto it = c->inflight_in.find(key);
+  if (it == c->inflight_in.end()) return;
+  for (auto pit = c->posted.begin(); pit != c->posted.end(); ++pit) {
+    if (env_matches(*pit, e)) {
+      int64_t receipt = c->next_receipt++;
+      int64_t handle = pit->handle;
+      c->recv_ready.emplace(receipt, std::move(it->second));
+      c->inflight_in.erase(it);
+      c->posted.erase(pit);
+      c->matched_done.push_back({handle, receipt});
+      c->offload_matches++;
+      return;
+    }
+  }
+  c->unexpected_m.push_back(key);
+  c->offload_unexpected++;
+}
+
+// mu held. Route a completed incoming message: either into the
+// offloaded matching engine — released per-stream in envelope-seq
+// order so an eager frame cannot overtake an earlier rendezvous with
+// the same envelope (MPI non-overtaking) — or onto the plain
+// completion queue.
+void route_completed(Ctx* c, std::pair<int, int64_t> key) {
+  auto it = c->inflight_in.find(key);
+  if (it == c->inflight_in.end()) return;
+  InMsg& m = it->second;
+  if (c->match_tag.load() == m.tag) {
+    MpiEnvelope e = parse_envelope(m.data);
+    if (e.ok) {
+      std::array<int64_t, 4> stream{(int64_t)m.peer, e.cid, e.src,
+                                    e.dst};
+      int64_t& expect = c->match_expect[stream];
+      if (e.seq != expect) {
+        c->match_held[stream][e.seq] = key;  // early: hold for the gap
+        return;
+      }
+      match_one(c, key, e);
+      expect++;
+      // release any held successors that are now in order
+      auto hit = c->match_held.find(stream);
+      if (hit != c->match_held.end()) {
+        auto& held = hit->second;
+        while (!held.empty() && held.begin()->first == expect) {
+          auto hkey = held.begin()->second;
+          held.erase(held.begin());
+          auto mit = c->inflight_in.find(hkey);
+          if (mit != c->inflight_in.end()) {
+            MpiEnvelope he = parse_envelope(mit->second.data);
+            if (he.ok) match_one(c, hkey, he);
+          }
+          expect++;
+        }
+        if (held.empty()) c->match_held.erase(hit);
+      }
+      return;
+    }
+  }
+  c->recv_done.push_back(key);
+}
+
 void handle_frame(Ctx* c, Link& l) {
   const FrameHeader& h = l.cur;
   switch (h.kind) {
@@ -254,7 +375,7 @@ void handle_frame(Ctx* c, Link& l) {
       c->bytes_recv += h.payload_len;
       auto key = std::make_pair(l.peer, h.msgid);
       c->inflight_in.emplace(key, std::move(m));
-      c->recv_done.push_back(key);
+      route_completed(c, key);
       break;
     }
     case kRndvReq: {
@@ -289,7 +410,7 @@ void handle_frame(Ctx* c, Link& l) {
           c->bytes_recv += h.payload_len;
           if (m.received >= (int64_t)m.data.size()) {
             m.complete = true;
-            c->recv_done.push_back(key);
+            route_completed(c, key);
           }
         }
       }
@@ -671,6 +792,101 @@ long long dcn_poll_send(void* vc) {
 
 void dcn_set_eager(void* vc, long long limit) {
   static_cast<Ctx*>(vc)->eager_limit.store(limit);
+}
+
+// ---- tag-matching offload API (reference: mtl.h:418-421) -------------
+
+// Divert completed messages with this DCN tag into the matching engine
+// (-1 disables; queued unexpected messages stay queued).
+void dcn_enable_matching(void* vc, long long dcn_tag) {
+  static_cast<Ctx*>(vc)->match_tag.store(dcn_tag);
+}
+
+// Post a receive (src/tag < 0 = wildcard). Returns a receipt (>0,
+// readable via dcn_read) when an unexpected message matches right
+// away; 0 when the receive was queued for the transport thread.
+long long dcn_post_recv(void* vc, long long handle, int cid, int src,
+                        int dst, int tag) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  Ctx::PostedRecv r{handle, cid, src, dst, tag};
+  for (auto it = c->unexpected_m.begin(); it != c->unexpected_m.end();
+       ++it) {
+    auto mit = c->inflight_in.find(*it);
+    if (mit == c->inflight_in.end()) {
+      continue;  // stale key (peer drop); removed when popped
+    }
+    MpiEnvelope e = parse_envelope(mit->second.data);
+    if (e.ok && env_matches(r, e)) {
+      int64_t receipt = c->next_receipt++;
+      c->recv_ready.emplace(receipt, std::move(mit->second));
+      c->inflight_in.erase(mit);
+      c->unexpected_m.erase(it);
+      c->offload_matches++;
+      return receipt;
+    }
+  }
+  c->posted.push_back(r);
+  return 0;
+}
+
+// Poll one completed match made by the transport thread: fills the
+// posted handle, returns the payload receipt (>0) or 0 when none.
+long long dcn_poll_matched(void* vc, long long* handle) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  if (c->matched_done.empty()) return 0;
+  auto m = c->matched_done.front();
+  c->matched_done.pop_front();
+  *handle = m[0];
+  return m[1];
+}
+
+// Non-destructive probe of the unexpected queue: fills src/tag/len of
+// the first compatible envelope, returns 1/0 (MPI_Iprobe for the
+// offloaded path).
+int dcn_match_probe(void* vc, int cid, int src, int dst, int tag,
+                    int* out_src, int* out_tag, long long* out_len) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  Ctx::PostedRecv r{0, cid, src, dst, tag};
+  for (const auto& key : c->unexpected_m) {
+    auto mit = c->inflight_in.find(key);
+    if (mit == c->inflight_in.end()) continue;
+    MpiEnvelope e = parse_envelope(mit->second.data);
+    if (e.ok && env_matches(r, e)) {
+      *out_src = e.src;
+      *out_tag = e.tag;
+      // payload length excludes the envelope header, matching the
+      // count a completed matched recv reports
+      *out_len = (long long)(mit->second.data.size() - kEnvelopeSize);
+      return 1;
+    }
+  }
+  return 0;
+}
+
+// Payload size of a pending receipt (before dcn_read consumes it).
+long long dcn_receipt_len(void* vc, long long receipt) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->recv_ready.find(receipt);
+  if (it == c->recv_ready.end()) return -1;
+  return (long long)it->second.data.size();
+}
+
+// Observability: 0=posted depth, 1=unexpected depth, 2=matches made,
+// 3=unexpected arrivals.
+long long dcn_match_stat(void* vc, int what) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  switch (what) {
+    case 0: return (long long)c->posted.size();
+    case 1: return (long long)c->unexpected_m.size();
+    case 2: return c->offload_matches.load();
+    case 3: return c->offload_unexpected.load();
+    default: return -1;
+  }
 }
 
 int dcn_port(void* vc) { return static_cast<Ctx*>(vc)->port; }

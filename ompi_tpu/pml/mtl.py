@@ -3,22 +3,42 @@
 TPU-native equivalent of ompi/mca/mtl + pml/cm (reference: mtl.h:418-421
 mtl_send/isend/irecv/iprobe for NICs with native MPI matching — ofi,
 psm2, portals4; pml/cm is the thin PML forwarding to the selected MTL;
-mutually exclusive with ob1, pml.h:40-47). The TPU analog of a
-"matching-capable fabric" is the XLA runtime itself: inside one driver
-program, issue order IS match order, so the mtl/fabric component's
-matching is the program order of device transfers — no unexpected
-queue, no rendezvous protocol, which is exactly why cm exists as a
-separate, thinner PML in the reference.
+mutually exclusive with ob1, pml.h:40-47).
+
+The offload is REAL here: the native DCN engine's epoll thread parses
+the MPI envelope (cid, src, dst, tag) of arriving messages and matches
+them against posted receives entirely in C++ (native/src/dcn.cc
+`route_completed` / `dcn_post_recv` — posted-receive FIFO + unexpected
+queue, the matching a PSM2/Portals4 NIC does in hardware). Python posts
+a receive descriptor once and collects completed matches from a
+completion queue — no per-message Python-side matching, no GIL on the
+match path. That is the mtl rationale the reference states at
+mtl.h:418-421, and why cm exists as a thinner PML than ob1: the
+transport owns the unexpected queue.
+
+Two domains:
+- **local ranks** (same controller): matching is the driver's program
+  order — the issue order of device transfers IS the match order, so
+  cm keeps a per-(cid,src,dst,tag) FIFO of in-flight device moves.
+- **remote ranks** (other controllers): the native engine matches.
+  Wildcard source/tag receives are supported for remote arrivals (the
+  engine scans envelopes); a wildcard on a purely-local comm still
+  raises — those queues live in ob1.
 
 Select with ``--mca pml cm`` (config: ``pml_select=cm``); ob1 remains
-the default because wildcard/out-of-order matching needs its queues.
+the default (full wildcard + rendezvous semantics across both domains).
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Any, Optional
 
+import numpy as np
+
 from ..core import component as mca
+from ..core import progress as _progress
 from ..core.counters import SPC
 from ..core.errors import CommError, RankError, TagError
 from ..core.request import CompletedRequest, Request, Status
@@ -26,36 +46,181 @@ from .framework import PML, PmlComponent
 
 MTL = mca.framework("mtl", "matching transport layer")
 
+#: DCN frame tag of the mtl's matched channel ("MTLM") — distinct from
+#: ob1's P2P_TAG/P2P_FAST_TAG streams so both PMLs can share the wire.
+MTL_MATCH_TAG = 0x4D544C4D
+
 
 class MtlComponent(mca.Component):
-    """Interface: send/recv with transport-native matching."""
+    """Interface: send/recv with transport-native matching
+    (mtl.h:418-421)."""
 
     def send(self, comm, value, src: int, dst: int, tag: int) -> Any:
         raise NotImplementedError
 
+    def isend_remote(self, comm, value, src, dst, tag) -> Request:
+        raise NotImplementedError
+
+    def irecv_remote(self, comm, source, dst, tag) -> Request:
+        raise NotImplementedError
+
+
+class _MatchedRecv(Request):
+    """A receive posted into the native matching engine."""
+
+    def __init__(self, mtl: "FabricMtl", handle: int, comm) -> None:
+        super().__init__()
+        self._mtl = mtl
+        self.handle = handle
+        self._comm = comm
+
+    def _poll(self) -> bool:
+        if not self.done:
+            self._mtl.progress()
+        return self.done
+
 
 @MTL.register
 class FabricMtl(MtlComponent):
-    """Matching by program order over the device fabric: the transfer
-    is dispatched immediately (XLA async), so 'matching' reduces to the
-    driver's issue order — the property hardware-matching NICs provide
-    and cm relies on."""
+    """Tag matching in the native DCN engine (the PSM2/Portals4 model):
+    the transport thread parses envelopes and matches posted receives;
+    Python only collects completions."""
 
     NAME = "fabric"
     PRIORITY = 10
-    DESCRIPTION = "program-order matching over device transfers"
+    DESCRIPTION = ("native-engine tag matching over DCN (+ program-order "
+                   "matching for local device transfers)")
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        self._engine = None
+        self._handles = itertools.count(1)
+        self._outstanding: dict[int, _MatchedRecv] = {}
+        self._seqs: dict[tuple, int] = {}  # (cid,src,dst) send stream
+        self._lock = threading.Lock()
+        self._armed = False
+
+    # -- wiring ------------------------------------------------------------
+
+    def _fabric_engine(self):
+        """The wired cross-process engine (pml/fabric.wire_up attaches
+        it to ob1; the mtl rides the same endpoint)."""
+        if self._engine is None:
+            ob1 = PML.component("ob1")
+            eng = getattr(ob1, "_fabric", None)
+            if eng is None:
+                raise CommError(
+                    "pml/cm remote p2p needs the fabric wired "
+                    "(pml.fabric.wire_up) — no DCN engine attached"
+                )
+            self._engine = eng
+            eng.ep.enable_matching(MTL_MATCH_TAG)
+        return self._engine
+
+    # -- local domain ------------------------------------------------------
 
     def send(self, comm, value, src: int, dst: int, tag: int) -> Any:
+        """Local-rank transfer: matching by program order (XLA async
+        dispatch preserves issue order — the property hardware-matching
+        NICs provide)."""
         import jax
 
         return jax.device_put(value, comm.devices[dst])
 
+    # -- remote domain: the real offload -----------------------------------
+
+    def isend_remote(self, comm, value, src, dst, tag) -> Request:
+        from . import fabric as fmod
+
+        eng = self._fabric_engine()
+        dst_idx = comm.procs[dst].process_index
+        pid = eng.peer_ids.get(dst_idx)
+        if pid is None:
+            raise CommError(f"no fabric wiring to process {dst_idx}")
+        raw = fmod.pack_value(value)
+        with self._lock:
+            key = (comm.cid, src, dst)
+            seq = self._seqs.get(key, 0)
+            self._seqs[key] = seq + 1
+        # the engine releases messages to the matcher in seq order per
+        # (cid,src,dst) stream (MPI non-overtaking: an eager frame must
+        # not overtake an earlier rendezvous with the same envelope)
+        frame = fmod.encode_fast(
+            comm.cid, src, dst, tag, seq,
+            np.frombuffer(raw, np.uint8),
+        )
+        eng.ep.check_peer(pid, what=f"process {dst_idx}")
+        eng.ep.send_bytes(pid, MTL_MATCH_TAG, frame)
+        SPC.record("mtl_remote_sends")
+        # cm semantics: the matching transport owns buffering; local
+        # completion on hand-off (the DCN engine copies the frame).
+        return CompletedRequest(value, Status(source=src, tag=tag))
+
+    def irecv_remote(self, comm, source, dst, tag) -> Request:
+        eng = self._fabric_engine()
+        handle = next(self._handles)
+        req = _MatchedRecv(self, handle, comm)
+        with self._lock:
+            self._outstanding[handle] = req
+        payload = eng.ep.post_recv(handle, comm.cid, source, dst, tag)
+        if payload is not None:
+            with self._lock:
+                self._outstanding.pop(handle, None)
+            self._deliver(req, comm, payload)
+            return req
+        if not self._armed:
+            _progress.register(self.progress)
+            self._armed = True
+        SPC.record("mtl_posted_recvs")
+        return req
+
+    def iprobe_remote(self, comm, source, dst, tag) -> Optional[Status]:
+        eng = self._fabric_engine()
+        hit = eng.ep.match_probe(comm.cid, source, dst, tag)
+        if hit is None:
+            return None
+        src, got_tag, nbytes = hit
+        return Status(source=src, tag=got_tag, count=nbytes)
+
+    def progress(self) -> int:
+        """Collect completed matches from the engine (registered with
+        the progress engine while receives are outstanding)."""
+        eng = self._engine
+        if eng is None:
+            return 0
+        n = 0
+        while True:
+            got = eng.ep.poll_matched()
+            if got is None:
+                break
+            handle, payload = got
+            with self._lock:
+                req = self._outstanding.pop(handle, None)
+            if req is None:
+                continue  # cancelled
+            self._deliver(req, req._comm, payload)
+            n += 1
+        if n:
+            SPC.record("mtl_engine_matches", n)
+        return n
+
+    def _deliver(self, req: _MatchedRecv, comm, payload: bytes) -> None:
+        from . import fabric as fmod
+
+        msg = fmod.decode_fast(payload)
+        value = fmod.unpack_value(
+            bytes(msg["pay"].raw),
+            device=comm.procs[msg["dst"]].device,
+        )
+        req._complete(value, Status(source=msg["src"], tag=msg["tag"],
+                                    count=msg["nb"]))
+        SPC.record("mtl_matched_recvs")
+
 
 @PML.register
 class CmPml(PmlComponent):
-    """Thin PML over the MTL (reference: pml/cm). In-order, no
-    wildcards: each recv completes the oldest same-(src,dst,tag) send.
-    """
+    """Thin PML over the MTL (reference: pml/cm): local ranks match by
+    program order; remote ranks by the engine's offloaded matching."""
 
     NAME = "cm"
     PRIORITY = 5  # ob1 (higher) wins unless explicitly selected
@@ -71,6 +236,14 @@ class CmPml(PmlComponent):
         if self._mtl is None:
             self._mtl = MTL.select_one()
         return self._mtl
+
+    def _my_index(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def _is_remote(self, comm, rank: int) -> bool:
+        return comm.procs[rank].process_index != self._my_index()
 
     def _infer_source(self, comm, value, source):
         if source is not None:
@@ -89,6 +262,8 @@ class CmPml(PmlComponent):
         if tag < 0:
             raise TagError(f"send tag must be >= 0, got {tag}")
         src = self._infer_source(comm, value, source)
+        if self._is_remote(comm, comm.check_rank(dest)):
+            return self.mtl.isend_remote(comm, value, src, dest, tag)
         moved = self.mtl.send(comm, value, src, dest, tag)
         key = (comm.cid, src, dest, tag)
         self._queues.setdefault(key, []).append(moved)
@@ -104,36 +279,74 @@ class CmPml(PmlComponent):
               dest: Optional[int] = None) -> Request:
         if dest is None:
             raise RankError("driver-mode recv needs dest=")
-        if source < 0 or tag < 0:
+        remote_possible = any(
+            self._is_remote(comm, r) for r in range(comm.size)
+        )
+        if source >= 0 and not self._is_remote(comm,
+                                               comm.check_rank(source)):
+            # local source: program-order FIFO
+            if tag < 0:
+                raise CommError(
+                    "pml/cm local receives have no wildcard tag "
+                    "matching; select pml ob1"
+                )
+            key = (comm.cid, comm.check_rank(source),
+                   comm.check_rank(dest), tag)
+            q = self._queues.get(key)
+            if not q:
+                raise CommError(
+                    f"pml/cm: no in-flight send for {key}; cm matches "
+                    "strictly in program order (send must precede recv)"
+                )
+            moved = q.pop(0)
+            SPC.record("pml_cm_recvs")
+            return CompletedRequest(moved, Status(source=source, tag=tag))
+        if not remote_possible:
             raise CommError(
-                "pml/cm has no wildcard matching (the queues that "
-                "implement MPI_ANY_SOURCE live in ob1); select pml ob1"
+                "pml/cm has no wildcard matching for purely-local "
+                "comms (those queues live in ob1); select pml ob1"
             )
-        key = (comm.cid, comm.check_rank(source),
-               comm.check_rank(dest), tag)
-        q = self._queues.get(key)
-        if not q:
-            raise CommError(
-                f"pml/cm: no in-flight send for {key}; cm matches "
-                "strictly in program order (send must precede recv)"
-            )
-        moved = q.pop(0)
-        SPC.record("pml_cm_recvs")
-        return CompletedRequest(moved, Status(source=source, tag=tag))
+        if source < 0:
+            # a wildcard could also be satisfied by a LOCAL program-
+            # order send, which the engine's envelope space never sees;
+            # fail fast instead of hanging on the remote-only scan
+            d = comm.check_rank(dest)
+            if any(k[0] == comm.cid and k[2] == d and q
+                   for k, q in self._queues.items()):
+                raise CommError(
+                    "pml/cm wildcard recv is ambiguous: a local "
+                    "program-order send is pending for this dest; "
+                    "cm cannot arbitrate local vs engine matching — "
+                    "select pml ob1"
+                )
+        # remote (or wildcard-over-remote) source: engine matching.
+        # Wildcards scan remote arrivals only — local program-order
+        # sends are not in the engine's envelope space.
+        src = source if source < 0 else comm.check_rank(source)
+        return self.mtl.irecv_remote(comm, src, comm.check_rank(dest),
+                                     tag)
 
     def recv(self, comm, source: int, tag: int, dest=None):
         return self.irecv(comm, source, tag, dest=dest).result()
 
     def probe(self, comm, source: int, tag: int, *, dest=None,
               blocking: bool = True):
-        if source < 0 or tag < 0 or dest is None:
+        if dest is None:
             return None
-        key = (comm.cid, comm.check_rank(source),
-               comm.check_rank(dest), tag)
-        q = self._queues.get(key)
-        if q:
-            return Status(source=source, tag=tag)
-        return None
+        if source >= 0 and not self._is_remote(comm,
+                                               comm.check_rank(source)):
+            if tag < 0:
+                return None
+            key = (comm.cid, comm.check_rank(source),
+                   comm.check_rank(dest), tag)
+            if self._queues.get(key):
+                return Status(source=source, tag=tag)
+            return None
+        probe = getattr(self.mtl, "iprobe_remote", None)
+        if probe is None:
+            return None
+        src = source if source < 0 else comm.check_rank(source)
+        return probe(comm, src, comm.check_rank(dest), tag)
 
     def comm_freed(self, comm) -> None:
         self._queues = {
