@@ -1,5 +1,6 @@
 """One-sided communication (reference: ompi/mca/osc)."""
 
+from .fabric_window import FabricWindow
 from .window import (
     LOCK_EXCLUSIVE,
     LOCK_SHARED,
@@ -13,7 +14,7 @@ from .window import (
 )
 
 __all__ = [
-    "DynamicWindow", "LOCK_EXCLUSIVE", "LOCK_SHARED", "SyncType",
+    "DynamicWindow", "FabricWindow", "LOCK_EXCLUSIVE", "LOCK_SHARED", "SyncType",
     "Window", "WindowResult", "allocate_window",
     "create_dynamic_window", "create_window",
 ]

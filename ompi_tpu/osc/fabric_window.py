@@ -1,0 +1,550 @@
+"""Cross-process one-sided communication over the fabric.
+
+TPU-native equivalent of osc/rdma's network path (reference:
+osc_rdma_comm.c put/get over btl; osc_rdma_accumulate.c's
+active-message fallback when the btl has no native atomics;
+osc_rdma_sync.h:24-30 epoch state machine; osc_rdma_lock.h passive
+locks). There is no RDMA into another controller's HBM, so every
+remote RMA op is an ACTIVE MESSAGE: the origin ships op descriptors
+over the parent comm's p2p (pml/fabric over DCN) and the TARGET's
+controller applies them to its device-resident blocks — exactly the
+reference's fallback mode, with the epoch close as the completion
+point.
+
+Window layout on a spanning comm: each controller holds the rank-major
+blocks of its LOCAL ranks (an inner `Window` over the auto-wired local
+sub-communicator, so the apply machinery — compiled scatter/gather
+epochs — is shared with the single-controller path).
+
+Synchronization:
+- **fence**: origin flushes per-target-process batches (one message per
+  peer controller, empty allowed), the passive handler applies arrivals
+  and answers each batch with a reply (get/fetch results + ack), and a
+  spanning barrier (coll/hier) closes the epoch.
+- **lock/unlock**: a lock manager at the target's controller grants
+  shared/exclusive access per local rank (request/grant messages — the
+  reference uses remote atomics, osc_rdma_lock.h); unlock ships the
+  batch and completes on the reply.
+- **passive-side application**: the handler is registered with the
+  progress engine, so ANY blocking call on the target's controller
+  applies pending remote ops (the same progress-dependent guarantee the
+  reference's active-message mode has).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core import progress as _progress
+from ..core.counters import SPC
+from ..core.errors import RMASyncError, WinError
+from ..ops import lookup as op_lookup
+from .window import (
+    LOCK_EXCLUSIVE,
+    LOCK_SHARED,
+    SyncType,
+    Window,
+    WindowResult,
+    _PendingOp,
+)
+
+#: Tag band above the hier epochs' windows (those top out below
+#: 0x5900_0000); 8 sub-tags per window id.
+_TAG_BASE = 0x60000000
+_T_BATCH = 0   # op batch (fence flush or unlock flush)
+_T_REPLY = 1   # per-batch reply: get/fetch results + application ack
+_T_LOCK = 2    # lock request
+_T_GRANT = 3   # lock grant
+
+def _enc_index(idx) -> Any:
+    """dss-able encoding of a window index (None | int | slice | tuple
+    of those) — the datatype story of the RMA wire."""
+    if idx is None or isinstance(idx, int):
+        return idx
+    if isinstance(idx, slice):
+        return ("s", idx.start, idx.stop, idx.step)
+    if isinstance(idx, tuple):
+        return ("t",) + tuple(_enc_index(i) for i in idx)
+    raise WinError(f"unsupported remote RMA index {idx!r}")
+
+
+def _dec_index(enc) -> Any:
+    if enc is None or isinstance(enc, int):
+        return enc
+    if isinstance(enc, (tuple, list)):
+        if enc[0] == "s":
+            return slice(enc[1], enc[2], enc[3])
+        if enc[0] == "t":
+            return tuple(_dec_index(i) for i in enc[1:])
+    raise WinError(f"bad remote RMA index encoding {enc!r}")
+
+
+class FabricWindow:
+    """An RMA window over a process-spanning communicator."""
+
+    RESULT_KINDS = ("get", "get_acc", "fetch_op", "cswap")
+
+    def __init__(self, comm, buffer, *, name: str = "") -> None:
+        import jax.numpy as jnp
+
+        from ..coll.hier import comm_slice
+
+        self.comm = comm
+        self.h = comm_slice(comm)
+        # Window creation is collective over the comm, so a per-comm
+        # counter yields the SAME id on every controller (tags derive
+        # from it); a process-global counter would diverge when the
+        # controllers hold different comm sets.
+        if not hasattr(comm, "_win_counter"):
+            comm._win_counter = itertools.count(0)
+        self.win_id = next(comm._win_counter)
+        self.name = name or f"fwin{comm.cid}.{self.win_id}"
+        arr = jnp.asarray(buffer)
+        n_local = self.h.comm.size
+        if arr.shape[0] != n_local:
+            raise WinError(
+                f"{self.name}: spanning-comm window buffer carries this "
+                f"controller's LOCAL blocks; leading dim must be "
+                f"{n_local}, got {arr.shape[0]}"
+            )
+        self._inner = Window(self.h.comm, arr, name=f"{self.name}.local")
+        self._inner.fence()  # persistent inner epoch; we own outer sync
+        self._sync = SyncType.NONE
+        self._epoch = 0
+        self._remote_pending: dict[int, list[dict]] = {}  # slice -> ops
+        self._result_slots: dict[int, list[list]] = {}    # slice -> slots
+        self._locks: dict[int, str] = {}
+        # lock manager for MY local ranks: rank -> (mode, holders,
+        # waitq of (origin_slice, mode))
+        self._lock_state: dict[int, list] = {}
+        self._lock_mu = threading.RLock()
+        # fence arrival accounting (driven by the handler)
+        self._got_batches: set[int] = set()
+        self._held: list = []  # future-epoch messages
+        self._in_handler = False
+        self._freed = False
+        _progress.register(self._handle_arrivals)
+
+    # -- accessors ---------------------------------------------------------
+
+    @property
+    def array(self):
+        """This controller's local blocks (rank-major over local ranks)."""
+        return self._inner.array
+
+    @property
+    def block_shape(self):
+        return self._inner.block_shape
+
+    def _tag(self, sub: int) -> int:
+        return _TAG_BASE + (self.win_id % 0xFFFF) * 8 + sub
+
+    def _slice_of(self, target: int) -> int:
+        return self.h.rank_slice[self.comm.check_rank(target)]
+
+    def _local_idx(self, target: int) -> int:
+        return self.h.local_ranks.index(target)
+
+    def _leader(self, slice_id: int) -> int:
+        return self.h.leaders[slice_id]
+
+    def _my_leader(self) -> int:
+        return self.h.leaders[self.h.slice_id]
+
+    def _check_alive(self):
+        if self._freed:
+            raise WinError(f"{self.name} has been freed")
+
+    def _check_epoch(self, target: Optional[int] = None):
+        if self._sync == SyncType.NONE:
+            raise RMASyncError(
+                f"{self.name}: RMA op outside an access epoch"
+            )
+        if self._sync == SyncType.LOCK and target is not None:
+            if target not in self._locks:
+                raise RMASyncError(
+                    f"{self.name}: target {target} is not locked"
+                )
+
+    # -- RMA operations ----------------------------------------------------
+
+    def _queue_remote(self, kind: str, target: int, value, index,
+                      op=None, compare=None) -> Optional[WindowResult]:
+        s = self._slice_of(target)
+        desc = {
+            "k": kind, "t": target, "i": _enc_index(index),
+            "v": None if value is None else np.asarray(value),
+        }
+        if op is not None:
+            desc["o"] = op.name if hasattr(op, "name") else str(op)
+        if compare is not None:
+            desc["c"] = np.asarray(compare)
+        self._remote_pending.setdefault(s, []).append(desc)
+        SPC.record("osc_fabric_remote_ops")
+        if kind in self.RESULT_KINDS:
+            slot: list = []
+            self._result_slots.setdefault(s, []).append(slot)
+            return WindowResult(slot, self)
+        return None
+
+    def put(self, value, target: int, index=None) -> None:
+        self._check_alive()
+        self._check_epoch(target)
+        if self._slice_of(target) == self.h.slice_id:
+            self._inner.put(value, self._local_idx(target), index)
+            return
+        self._queue_remote("put", target, value, index)
+
+    def get(self, target: int, index=None) -> WindowResult:
+        self._check_alive()
+        self._check_epoch(target)
+        if self._slice_of(target) == self.h.slice_id:
+            return self._inner.get(self._local_idx(target), index)
+        return self._queue_remote("get", target, None, index)
+
+    def accumulate(self, value, target: int, op="sum", index=None) -> None:
+        self._check_alive()
+        self._check_epoch(target)
+        op = op_lookup(op)
+        if self._slice_of(target) == self.h.slice_id:
+            self._inner.accumulate(value, self._local_idx(target),
+                                   op, index)
+            return
+        self._queue_remote("acc", target, value, index, op=op)
+
+    def get_accumulate(self, value, target: int, op="sum", index=None
+                       ) -> WindowResult:
+        self._check_alive()
+        self._check_epoch(target)
+        op = op_lookup(op)
+        if self._slice_of(target) == self.h.slice_id:
+            return self._inner.get_accumulate(
+                value, self._local_idx(target), op, index)
+        return self._queue_remote("get_acc", target, value, index, op=op)
+
+    def fetch_and_op(self, value, target: int, op="sum", index=None
+                     ) -> WindowResult:
+        return self.get_accumulate(value, target, op, index)
+
+    def compare_and_swap(self, value, compare, target: int, index=None
+                         ) -> WindowResult:
+        self._check_alive()
+        self._check_epoch(target)
+        if self._slice_of(target) == self.h.slice_id:
+            return self._inner.compare_and_swap(
+                value, compare, self._local_idx(target), index)
+        return self._queue_remote("cswap", target, value, index,
+                                  compare=compare)
+
+    # -- wire helpers ------------------------------------------------------
+
+    def _send_msg(self, slice_id: int, sub: int, msg: dict) -> None:
+        self.comm.rank(self._my_leader()).send(
+            msg, dest=self._leader(slice_id), tag=self._tag(sub)
+        )
+
+    def _flush_slice(self, s: int, ep: int) -> None:
+        """Ship slice `s`'s batch (possibly empty). `ep` is the fence
+        epoch, or -1 for lock-epoch flushes (applied immediately at the
+        passive target)."""
+        ops = self._remote_pending.pop(s, [])
+        self._send_msg(s, _T_BATCH, {
+            "win": self.win_id, "ep": ep,
+            "org": self.h.slice_id, "ops": ops,
+        })
+        SPC.record("osc_fabric_batches_sent")
+
+    def _pump_until(self, cond, what: str, timeout: float = 60.0) -> None:
+        ok = _progress.ENGINE.progress_until(cond, timeout)
+        if not ok:
+            raise RMASyncError(f"{self.name}: timeout waiting for {what}")
+
+    # -- passive handler ---------------------------------------------------
+
+    def _handle_arrivals(self) -> int:
+        """Progress callback: apply arrived batches to local blocks and
+        answer lock traffic (the passive side of osc/rdma's active
+        message mode). Reentrancy-guarded — improbe pumps progress."""
+        if self._in_handler or self._freed:
+            return 0
+        self._in_handler = True
+        n = 0
+        try:
+            pml = self.comm.pml
+            me = self._my_leader()
+            for sub in (_T_BATCH, _T_LOCK):
+                while True:
+                    m = pml.improbe(self.comm, -1, self._tag(sub),
+                                    dest=me)
+                    if m is None:
+                        break
+                    msg = m.mrecv()
+                    self._dispatch(sub, msg)
+                    n += 1
+        finally:
+            self._in_handler = False
+        return n
+
+    def _dispatch(self, sub: int, msg: dict) -> None:
+        if msg.get("win") != self.win_id:
+            # another window's traffic shares no tags; this is a bug
+            raise WinError(f"{self.name}: foreign window message {msg}")
+        if sub == _T_BATCH:
+            if msg["ep"] != -1 and msg["ep"] != self._epoch:
+                self._held.append((sub, msg))  # future fence epoch
+                return
+            self._apply_batch(msg)
+        elif sub == _T_LOCK:
+            self._handle_lock_req(msg)
+
+    def _apply_batch(self, msg: dict) -> None:
+        org = msg["org"]
+        results: list = []
+        for d in msg["ops"]:
+            lidx = self._local_idx(d["t"])
+            idx = _dec_index(d["i"])
+            kind = d["k"]
+            opname = d.get("o")
+            op = op_lookup(opname) if opname else None
+            pending = _PendingOp(
+                kind={"fetch_op": "get_acc"}.get(kind, kind),
+                target=lidx, value=d.get("v"), index=idx, op=op,
+                compare=d.get("c"),
+                result_slot=[] if kind in self.RESULT_KINDS else None,
+            )
+            self._inner._pending.append(pending)
+            if pending.result_slot is not None:
+                results.append(pending.result_slot)
+        self._inner._apply_pending()
+        SPC.record("osc_fabric_batches_applied")
+        vals = [np.asarray(r[0]) if r else None for r in results]
+        self._send_msg(org, _T_REPLY, {
+            "win": self.win_id, "ep": msg["ep"],
+            "org": self.h.slice_id, "vals": vals,
+        })
+        if msg["ep"] != -1:
+            self._got_batches.add(org)
+
+    # -- lock manager (targets owned by this controller) -------------------
+
+    def _handle_lock_req(self, msg: dict) -> None:
+        rank, mode, org, unlock = (msg["rank"], msg["mode"], msg["org"],
+                                   msg.get("unlock", False))
+        with self._lock_mu:
+            st = self._lock_state.setdefault(rank, ["", set(), []])
+            if unlock:
+                st[1].discard(org)
+                if not st[1]:
+                    st[0] = ""
+                self._grant_waiters(rank, st)
+                return
+            if self._lock_compatible(st, mode):
+                st[0] = mode
+                st[1].add(org)
+                self._send_msg(org, _T_GRANT, {
+                    "win": self.win_id, "ep": -1, "rank": rank,
+                })
+            else:
+                st[2].append((org, mode))
+
+    @staticmethod
+    def _lock_compatible(st, mode: str) -> bool:
+        if not st[1]:
+            return True
+        return st[0] == LOCK_SHARED and mode == LOCK_SHARED
+
+    def _grant_waiters(self, rank: int, st) -> None:
+        while st[2]:
+            org, mode = st[2][0]
+            if not self._lock_compatible(st, mode):
+                break
+            st[2].pop(0)
+            st[0] = mode
+            st[1].add(org)
+            self._send_msg(org, _T_GRANT, {
+                "win": self.win_id, "ep": -1, "rank": rank,
+            })
+            if mode == LOCK_EXCLUSIVE:
+                break
+
+    # -- synchronization ---------------------------------------------------
+
+    def fence(self) -> None:
+        self._check_alive()
+        if self._sync not in (SyncType.NONE, SyncType.FENCE):
+            raise RMASyncError(
+                f"{self.name}: fence inside {self._sync.value} epoch"
+            )
+        if self._sync == SyncType.FENCE:
+            self._close_fence()
+        self._sync = SyncType.FENCE
+        self._epoch += 1
+        self._release_held()
+        SPC.record("osc_fence_calls")
+
+    def fence_end(self) -> None:
+        self._check_alive()
+        if self._sync != SyncType.FENCE:
+            raise RMASyncError(f"{self.name}: fence_end outside fence")
+        self._close_fence()
+        self._sync = SyncType.NONE
+        self._epoch += 1
+        self._release_held()
+
+    def _release_held(self) -> None:
+        held, self._held = self._held, []
+        for sub, msg in held:
+            self._dispatch(sub, msg)
+
+    def _close_fence(self) -> None:
+        # local ops complete on the device
+        self._inner._apply_pending()
+        # ship one batch per peer controller (empty counts as "none"),
+        # then wait until every peer's batch was applied here and every
+        # reply to OUR batches (get results + acks) came back
+        peers = [s for s in range(self.h.n_slices)
+                 if s != self.h.slice_id]
+        for s in peers:
+            self._flush_slice(s, self._epoch)
+        self._collect_replies(peers, self._epoch)
+        self._pump_until(
+            lambda: all(s in self._got_batches for s in peers),
+            "peer fence batches",
+        )
+        self._got_batches.clear()
+        self.comm.barrier()
+
+    def _collect_replies(self, slices, ep: int) -> None:
+        """Receive one reply per outstanding batch, filling result
+        slots in issue order."""
+        me = self._my_leader()
+        for s in slices:
+            slots = self._result_slots.pop(s, [])
+            rep = self.comm.recv(source=self._leader(s),
+                                 tag=self._tag(_T_REPLY), dest=me)
+            if rep.get("ep") != ep or rep.get("org") != s:
+                raise WinError(
+                    f"{self.name}: reply epoch mismatch {rep.get('ep')}"
+                    f" != {ep}"
+                )
+            vals = rep["vals"]
+            if len(vals) != len(slots):
+                raise WinError(
+                    f"{self.name}: {len(vals)} results for "
+                    f"{len(slots)} slots"
+                )
+            import jax
+
+            for slot, v in zip(slots, vals):
+                slot.append(jax.device_put(v) if v is not None else None)
+            SPC.record("osc_fabric_replies")
+
+    # passive target ------------------------------------------------------
+
+    def lock(self, target: int, lock_type: str = LOCK_SHARED) -> None:
+        self._check_alive()
+        if self._sync in (SyncType.FENCE, SyncType.PSCW):
+            raise RMASyncError(
+                f"{self.name}: lock inside {self._sync.value} epoch"
+            )
+        target = self.comm.check_rank(target)
+        s = self._slice_of(target)
+        if s == self.h.slice_id:
+            # local target: same lock manager, no messages (the inner
+            # Window lives in a permanent fence epoch and cannot host
+            # lock state itself)
+            def _try_local():
+                with self._lock_mu:
+                    st = self._lock_state.setdefault(target,
+                                                     ["", set(), []])
+                    if self._lock_compatible(st, lock_type):
+                        st[0] = lock_type
+                        st[1].add(self.h.slice_id)
+                        return True
+                return False
+
+            self._pump_until(_try_local, f"local lock on {target}")
+        else:
+            self._send_msg(s, _T_LOCK, {
+                "win": self.win_id, "ep": -1, "rank": target,
+                "mode": lock_type, "org": self.h.slice_id,
+            })
+            granted: list = []
+
+            def _check():
+                m = self.comm.pml.improbe(
+                    self.comm, self._leader(s), self._tag(_T_GRANT),
+                    dest=self._my_leader(),
+                )
+                if m is not None:
+                    granted.append(m.mrecv())
+                return bool(granted)
+
+            self._pump_until(_check, f"lock grant on rank {target}")
+        self._locks[target] = lock_type
+        self._sync = SyncType.LOCK
+        SPC.record("osc_lock_calls")
+
+    def unlock(self, target: int) -> None:
+        self._check_alive()
+        target = self.comm.check_rank(target)
+        if target not in self._locks:
+            raise RMASyncError(f"{self.name}: rank {target} not locked")
+        s = self._slice_of(target)
+        if s == self.h.slice_id:
+            self._inner._apply_pending(self._local_idx(target))
+            with self._lock_mu:
+                st = self._lock_state.setdefault(target, ["", set(), []])
+                st[1].discard(self.h.slice_id)
+                if not st[1]:
+                    st[0] = ""
+                self._grant_waiters(target, st)
+        else:
+            self._flush_slice(s, -1)
+            self._collect_replies([s], -1)
+            self._send_msg(s, _T_LOCK, {
+                "win": self.win_id, "ep": -1, "rank": target,
+                "mode": self._locks[target], "org": self.h.slice_id,
+                "unlock": True,
+            })
+        del self._locks[target]
+        if not self._locks:
+            self._sync = SyncType.NONE
+
+    def flush(self, target: Optional[int] = None) -> None:
+        self._check_alive()
+        if self._sync not in (SyncType.LOCK, SyncType.LOCK_ALL):
+            raise RMASyncError(f"{self.name}: flush outside lock epoch")
+        targets = ([target] if target is not None
+                   else list(self._locks))
+        slices = sorted({
+            self._slice_of(t) for t in targets
+            if self._slice_of(t) != self.h.slice_id
+        })
+        self._inner._apply_pending()
+        for s in slices:
+            if s in self._remote_pending or s in self._result_slots:
+                self._flush_slice(s, -1)
+                self._collect_replies([s], -1)
+
+    def free(self) -> None:
+        if self._remote_pending or any(self._result_slots.values()):
+            raise RMASyncError(
+                f"{self.name}: free with pending remote ops"
+            )
+        _progress.unregister(self._handle_arrivals)
+        self._freed = True
+        self._inner._pending.clear()
+        self._inner._sync = SyncType.NONE
+        self._inner.free()
+
+    def __repr__(self) -> str:
+        return (
+            f"<FabricWindow {self.name} local_blocks="
+            f"{self.h.comm.size}x{self.block_shape} "
+            f"sync={self._sync.value}>"
+        )

@@ -266,31 +266,47 @@ class Window(HasErrhandler):
     # -- epoch application -------------------------------------------------
 
     def _apply_pending(self, target_filter: Optional[int] = None) -> None:
-        """Apply queued ops in issue order as functional updates of the
-        window array (compiled scatter/gathers, device-resident)."""
+        """Apply queued ops in issue order as per-block functional
+        updates, each computed ON the target rank's device, then
+        reassemble the rank-major array from the single-device blocks —
+        no host staging, and no cross-device scatter (which jax rejects
+        outright under multi-process device sets)."""
         import jax
         import jax.numpy as jnp
 
         remaining = []
         arr = self._array
+        blocks: dict[int, Any] = {}  # target -> committed block view
+        dirty: set[int] = set()      # targets actually written
+
+        def load(t: int):
+            if t not in blocks:
+                blocks[t] = jax.device_put(arr[t], self.comm.devices[t])
+            return blocks[t]
+
+        def place(t: int, v):
+            return jax.device_put(jnp.asarray(v), self.comm.devices[t])
+
         for op in self._pending:
             if target_filter is not None and op.target != target_filter:
                 remaining.append(op)
                 continue
-            block = arr[op.target]
+            t = op.target
+            block = load(t)
             idx = op.index if op.index is not None else Ellipsis
             if op.kind == "put":
-                newb = block.at[idx].set(jnp.asarray(op.value))
-                arr = arr.at[op.target].set(newb)
+                blocks[t] = block.at[idx].set(place(t, op.value))
+                dirty.add(t)
             elif op.kind == "get":
                 op.result_slot.append(block[idx])
             elif op.kind == "acc":
                 cur = block[idx]
                 if op.op is REPLACE:
-                    upd = jnp.asarray(op.value)
+                    upd = place(t, op.value)
                 else:
-                    upd = op.op.combine(cur, jnp.asarray(op.value))
-                arr = arr.at[op.target].set(block.at[idx].set(upd))
+                    upd = op.op.combine(cur, place(t, op.value))
+                blocks[t] = block.at[idx].set(upd)
+                dirty.add(t)
             elif op.kind == "get_acc":
                 cur = block[idx]
                 op.result_slot.append(cur)
@@ -298,22 +314,34 @@ class Window(HasErrhandler):
                     pass
                 else:
                     if op.op is REPLACE:
-                        upd = jnp.asarray(op.value)
+                        upd = place(t, op.value)
                     else:
-                        upd = op.op.combine(cur, jnp.asarray(op.value))
-                    arr = arr.at[op.target].set(block.at[idx].set(upd))
+                        upd = op.op.combine(cur, place(t, op.value))
+                    blocks[t] = block.at[idx].set(upd)
+                    dirty.add(t)
             elif op.kind == "cswap":
                 cur = block[idx]
-                eq = cur == jnp.asarray(op.compare)
+                eq = cur == place(t, op.compare)
                 op.result_slot.append(cur)
-                upd = jnp.where(eq, jnp.asarray(op.value), cur)
-                arr = arr.at[op.target].set(block.at[idx].set(upd))
+                blocks[t] = block.at[idx].set(
+                    jnp.where(eq, place(t, op.value), cur)
+                )
+                dirty.add(t)
             else:  # pragma: no cover
                 raise WinError(f"unknown RMA op {op.kind}")
         self._pending = remaining
-        if arr is not self._array:
-            # Keep the window sharded rank-major.
-            self._array = self.comm.put_rank_major(arr)
+        if dirty:  # read-only epochs skip the reassembly entirely
+            n = self.comm.size
+            parts = [
+                blocks[i] if i in blocks
+                else jax.device_put(arr[i], self.comm.devices[i])
+                for i in range(n)
+            ]
+            self._array = jax.make_array_from_single_device_arrays(
+                (n,) + tuple(self.block_shape),
+                self.comm.rank_sharding(),
+                [p[None] for p in parts],
+            )
 
     def free(self) -> None:
         if self._pending:
@@ -442,8 +470,18 @@ class DynamicWindow:
         self._freed = True
 
 
-def create_window(comm, buffer, *, name: str = "") -> Window:
-    """MPI_Win_create equivalent (collective over comm)."""
+def _spans_processes(comm) -> bool:
+    return len({p.process_index for p in comm.procs}) > 1
+
+
+def create_window(comm, buffer, *, name: str = ""):
+    """MPI_Win_create equivalent (collective over comm). Spanning comms
+    get the fabric-backed window (active-message RMA across
+    controllers; reference: osc/rdma's network path)."""
+    if _spans_processes(comm):
+        from .fabric_window import FabricWindow
+
+        return FabricWindow(comm, buffer, name=name)
     return Window(comm, buffer, name=name)
 
 
@@ -453,9 +491,16 @@ def create_dynamic_window(comm, *, name: str = "") -> DynamicWindow:
 
 
 def allocate_window(comm, block_shape, dtype="float32", *, name: str = ""
-                    ) -> Window:
-    """MPI_Win_allocate: the window owns freshly zeroed memory."""
+                    ):
+    """MPI_Win_allocate: the window owns freshly zeroed memory (local
+    blocks only on spanning comms)."""
     import jax.numpy as jnp
 
+    if _spans_processes(comm):
+        from .fabric_window import FabricWindow
+
+        n_local = sum(1 for p in comm.procs if p.is_local)
+        buf = jnp.zeros((n_local,) + tuple(block_shape), dtype)
+        return FabricWindow(comm, buf, name=name)
     buf = jnp.zeros((comm.size,) + tuple(block_shape), dtype)
     return Window(comm, buf, name=name)
