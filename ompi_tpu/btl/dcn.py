@@ -61,6 +61,7 @@ class DcnEndpoint:
         if not self._ctx:
             raise DcnError(f"cannot bind DCN listener on {bind_ip}:{port}")
         self.address = (bind_ip, actual.value)
+        self.listeners: list[tuple[str, int]] = [self.address]
         # One knob for the eager/rndv split: the framework-registered
         # btl_dcn_eager_limit var (what the BML/PML layers also read).
         self._lib.dcn_set_eager(
@@ -71,6 +72,71 @@ class DcnEndpoint:
         self._closed = False
 
     # -- wiring ------------------------------------------------------------
+
+    def listen_on(self, ip: str, port: int = 0) -> tuple[str, int]:
+        """Bind an ADDITIONAL listener on a specific local interface
+        address (reference: btl/tcp opens a listening endpoint per
+        usable interface and publishes them all). Returns (ip, port)
+        and records it in `self.listeners`."""
+        actual = int(self._lib.dcn_listen_add(self._ctx, ip.encode(),
+                                              port))
+        if actual < 0:
+            raise DcnError(f"cannot bind extra DCN listener on {ip}")
+        self.listeners.append((ip, actual))
+        return (ip, actual)
+
+    def connect_pairs(self, pairs, *, cookie: int,
+                      timeout_ms: Optional[int] = None) -> int:
+        """Open one link per (local_ip | None, remote_ip, remote_port)
+        pair, all grouped under ONE peer (the multi-NIC endpoint:
+        distinct (local if, remote if) socket pairs, reference
+        btl_tcp_proc.c address matching). Returns the peer id."""
+        if not pairs:
+            raise DcnError("connect_pairs needs at least one pair")
+        if cookie <= 0:
+            raise DcnError("cookie must be > 0")
+        tmo = timeout_ms if timeout_ms is not None \
+            else _connect_timeout.value
+        peer = -1
+        failed = []
+        for local_ip, ip, port in pairs:
+            got = self._lib.dcn_connect_from(
+                self._ctx, peer,
+                (local_ip or "").encode(), ip.encode(), port, 1,
+                cookie, tmo,
+            )
+            if got < 0:
+                # CQ scores are heuristics, not reachability probes: a
+                # failed pair degrades the peer to fewer links instead
+                # of aborting (and orphaning) the connected ones
+                failed.append((local_ip, ip, port))
+                continue
+            peer = got
+        if peer < 0:
+            raise DcnError(f"all link pairs failed: {failed}")
+        if failed:
+            logger.warning("multi-NIC peer degraded: %d/%d pairs "
+                           "failed (%s)", len(failed), len(pairs),
+                           failed)
+        return int(peer)
+
+    def link_addrs(self, peer: int) -> list[tuple[str, str]]:
+        """(local 'ip:port', remote 'ip:port') per live link of a peer
+        — striping/multi-NIC observability."""
+        import ctypes
+
+        out = []
+        idx = 0
+        while True:
+            lo = ctypes.create_string_buffer(64)
+            ro = ctypes.create_string_buffer(64)
+            rc = self._lib.dcn_link_addr(self._ctx, peer, idx, lo, ro,
+                                         64)
+            if rc != 0:
+                break
+            out.append((lo.value.decode(), ro.value.decode()))
+            idx += 1
+        return out
 
     def connect(self, ip: str, port: int, *, cookie: int,
                 nlinks: Optional[int] = None) -> int:
@@ -320,6 +386,41 @@ class DcnBtl(BtlComponent):
             if idx == my_index or idx in self._peer_ids:
                 continue
             rec = (peer_records or {}).get(idx) or {}
+            # Multi-NIC: when the peer published several listeners,
+            # open links across distinct (local if, remote if) socket
+            # pairs by CQ score and stripe by the scores
+            # (reference: btl_tcp_proc.c pairing + bml_r2 weights).
+            listeners = [
+                l for l in rec.get("listeners", [])
+                if l.get("ip") and l["ip"] != "0.0.0.0"
+            ]
+            if len(listeners) > 1:
+                nlinks = max(1, _links.value)
+                pairs = interfaces.choose_link_pairs(
+                    locals_, listeners, nlinks)
+                if pairs:
+                    try:
+                        pid = ep.connect_pairs(
+                            [(lip, rip, rport)
+                             for lip, rip, rport, _ in pairs],
+                            cookie=my_index + 1,
+                        )
+                    except DcnError as exc:
+                        # every pair failed: fall back to the single
+                        # best-address path below
+                        logger.warning(
+                            "multi-NIC wiring to process %d failed "
+                            "(%s); falling back to single address",
+                            idx, exc)
+                    else:
+                        links = ep.peer_links(pid)
+                        weights = [q for _, _, _, q in pairs][:links]
+                        total = sum(weights) or 1.0
+                        ep.set_link_weights(
+                            pid, [q / total for q in weights])
+                        self._peer_ids[idx] = pid
+                        SPC.record("dcn_multinic_peers")
+                        continue
             best_ip, best_q = ip, -1.0
             # Interface alternatives are reachable only when the peer's
             # listener binds every interface; a single-address listener

@@ -171,6 +171,18 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
     lib.dcn_match_stat.argtypes = [P, ctypes.c_int]
     lib.dcn_receipt_len.restype = LL
     lib.dcn_receipt_len.argtypes = [P, LL]
+    lib.dcn_connect_from.restype = ctypes.c_int
+    lib.dcn_connect_from.argtypes = [
+        P, ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p,
+        ctypes.c_int, ctypes.c_int, LL, ctypes.c_int,
+    ]
+    lib.dcn_listen_add.restype = ctypes.c_int
+    lib.dcn_listen_add.argtypes = [P, ctypes.c_char_p, ctypes.c_int]
+    lib.dcn_link_addr.restype = ctypes.c_int
+    lib.dcn_link_addr.argtypes = [
+        P, ctypes.c_int, ctypes.c_int, ctypes.c_char_p,
+        ctypes.c_char_p, ctypes.c_int,
+    ]
     lib.dcn_destroy.restype = None
     lib.dcn_destroy.argtypes = [P]
 

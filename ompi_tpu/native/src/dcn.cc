@@ -119,6 +119,10 @@ struct Peer {
 struct Ctx {
   int epfd = -1;
   int listen_fd = -1;
+  // Multi-NIC: extra listeners, one per additional local interface
+  // (reference: btl/tcp opens a listening endpoint per usable
+  // interface and publishes them all in the modex).
+  std::vector<int> extra_listen;
   int wake_r = -1, wake_w = -1;
   uint16_t port = 0;
   std::atomic<int64_t> eager_limit{64 * 1024};
@@ -542,12 +546,24 @@ void do_write(Ctx* c, int fd) {
   arm(c, fd, false);
 }
 
-void accept_conn(Ctx* c) {
+// Hot path: one integer compare for data fds; the lock+scan only runs
+// when extra listeners exist (multi-NIC endpoints).
+std::atomic<int> g_has_extra{0};
+
+bool is_listener(Ctx* c, int fd) {
+  if (fd == c->listen_fd) return true;
+  if (!g_has_extra.load(std::memory_order_relaxed)) return false;
+  std::lock_guard<std::mutex> g(c->mu);
+  for (int l : c->extra_listen)
+    if (l == fd) return true;
+  return false;
+}
+
+void accept_conn(Ctx* c, int lfd) {
   for (;;) {
     sockaddr_in addr{};
     socklen_t alen = sizeof(addr);
-    int fd = accept(c->listen_fd, reinterpret_cast<sockaddr*>(&addr),
-                    &alen);
+    int fd = accept(lfd, reinterpret_cast<sockaddr*>(&addr), &alen);
     if (fd < 0) return;
     set_nonblock(fd);
     std::lock_guard<std::mutex> g(c->mu);
@@ -571,8 +587,8 @@ void loop_fn(Ctx* c) {
     int n = epoll_wait(c->epfd, evs, 64, 50);
     for (int i = 0; i < n; ++i) {
       int fd = evs[i].data.fd;
-      if (fd == c->listen_fd) {
-        accept_conn(c);
+      if (is_listener(c, fd)) {
+        accept_conn(c, fd);
         continue;
       }
       if (fd == c->wake_r) {
@@ -662,8 +678,13 @@ void* dcn_create(const char* bind_ip, int port, int* actual_port) {
   return c;
 }
 
-int dcn_connect(void* vc, const char* ip, int port, int nlinks,
-                long long cookie, int timeout_ms) {
+// Open `nlinks` sockets to ip:port, optionally bound to a specific
+// LOCAL source address (multi-NIC: the (local if, remote if) pairing
+// of btl_tcp_proc.c), and add them to peer `into_peer` (or a new peer
+// when into_peer < 0). Returns the peer id or -1.
+int dcn_connect_from(void* vc, int into_peer, const char* local_ip,
+                     const char* ip, int port, int nlinks,
+                     long long cookie, int timeout_ms) {
   Ctx* c = static_cast<Ctx*>(vc);
   if (nlinks < 1) nlinks = 1;
   if (timeout_ms <= 0) timeout_ms = 5000;
@@ -671,6 +692,17 @@ int dcn_connect(void* vc, const char* ip, int port, int nlinks,
   for (int i = 0; i < nlinks; ++i) {
     int fd = socket(AF_INET, SOCK_STREAM, 0);
     set_nonblock(fd);
+    if (local_ip && *local_ip) {
+      sockaddr_in la{};
+      la.sin_family = AF_INET;
+      la.sin_addr.s_addr = inet_addr(local_ip);
+      la.sin_port = 0;
+      if (bind(fd, reinterpret_cast<sockaddr*>(&la), sizeof(la)) != 0) {
+        close(fd);
+        for (int f : fds) close(f);
+        return -1;
+      }
+    }
     sockaddr_in addr{};
     addr.sin_family = AF_INET;
     addr.sin_addr.s_addr = inet_addr(ip);
@@ -695,8 +727,18 @@ int dcn_connect(void* vc, const char* ip, int port, int nlinks,
     fds.push_back(fd);
   }
   std::lock_guard<std::mutex> g(c->mu);
-  int pid = c->next_peer++;
-  Peer p;
+  int pid;
+  if (into_peer >= 0) {
+    if (c->peers.find(into_peer) == c->peers.end()) {
+      for (int f : fds) close(f);
+      return -1;
+    }
+    pid = into_peer;
+  } else {
+    pid = c->next_peer++;
+    c->peers[pid] = Peer{};
+  }
+  Peer& p = c->peers[pid];
   for (int fd : fds) {
     Link l;
     l.fd = fd;
@@ -709,9 +751,78 @@ int dcn_connect(void* vc, const char* ip, int port, int nlinks,
         make_frame(kEager, 0, cookie, 0, 0, nullptr, 0));
     arm(c, fd, true);
   }
-  c->peers[pid] = std::move(p);
+  // link count changed: stale striping weights no longer apply
+  if (p.weights.size() != p.link_fds.size()) {
+    p.weights.clear();
+    p.credit.clear();
+  }
   wake(c);
   return pid;
+}
+
+int dcn_connect(void* vc, const char* ip, int port, int nlinks,
+                long long cookie, int timeout_ms) {
+  return dcn_connect_from(vc, -1, nullptr, ip, port, nlinks, cookie,
+                          timeout_ms);
+}
+
+// Bind an additional listening socket (multi-NIC business card entry).
+// Returns the actual port or -1.
+int dcn_listen_add(void* vc, const char* bind_ip, int port) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr =
+      bind_ip && *bind_ip ? inet_addr(bind_ip) : htonl(INADDR_ANY);
+  addr.sin_port = htons(port);
+  if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) != 0 ||
+      listen(fd, 64) != 0) {
+    close(fd);
+    return -1;
+  }
+  socklen_t alen = sizeof(addr);
+  getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  set_nonblock(fd);
+  {
+    std::lock_guard<std::mutex> g(c->mu);
+    c->extra_listen.push_back(fd);
+  }
+  g_has_extra.store(1, std::memory_order_relaxed);
+  add_fd(c, fd);
+  return ntohs(addr.sin_port);
+}
+
+// Local/remote socket addresses of one link ("ip:port" strings), for
+// striping observability and the multi-NIC tests. Returns 0/-1.
+int dcn_link_addr(void* vc, int peer, int idx, char* local_out,
+                  char* remote_out, int cap) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->peers.find(peer);
+  if (it == c->peers.end()) return -1;
+  auto& fds = it->second.link_fds;
+  if (idx < 0 || idx >= (int)fds.size()) return -1;
+  sockaddr_in a{};
+  socklen_t alen = sizeof(a);
+  if (getsockname(fds[idx], reinterpret_cast<sockaddr*>(&a), &alen)
+      == 0) {
+    snprintf(local_out, cap, "%s:%d", inet_ntoa(a.sin_addr),
+             (int)ntohs(a.sin_port));
+  } else {
+    snprintf(local_out, cap, "?");
+  }
+  alen = sizeof(a);
+  if (getpeername(fds[idx], reinterpret_cast<sockaddr*>(&a), &alen)
+      == 0) {
+    snprintf(remote_out, cap, "%s:%d", inet_ntoa(a.sin_addr),
+             (int)ntohs(a.sin_port));
+  } else {
+    snprintf(remote_out, cap, "?");
+  }
+  return 0;
 }
 
 long long dcn_send(void* vc, int peer, long long tag, const void* buf,
@@ -971,6 +1082,7 @@ void dcn_destroy(void* vc) {
   if (c->loop.joinable()) c->loop.join();
   std::lock_guard<std::mutex> g(c->mu);
   for (auto& [fd, l] : c->links) close(fd);
+  for (int lf : c->extra_listen) close(lf);
   close(c->listen_fd);
   close(c->wake_r);
   close(c->wake_w);

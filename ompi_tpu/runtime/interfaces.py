@@ -161,6 +161,61 @@ def link_weights(locals_: list[Interface], remote_ip: str,
     return [s / total for s in scores]
 
 
+def choose_link_pairs(locals_: list[Interface],
+                      remote_listeners: list[dict],
+                      n: int) -> list[tuple[Optional[str], str, int,
+                                            float]]:
+    """Pick up to `n` (local_ip, remote_ip, remote_port, score) socket
+    pairs across DISTINCT interface combinations, best CQ score first
+    (reference: btl_tcp_proc.c matches local and remote address lists
+    pairwise; reachable/weighted scores the candidates). Prefers
+    spreading over unused local AND unused remote interfaces before
+    doubling up."""
+    cands = []
+    for li in locals_:
+        if li.ipv4 is None:
+            continue
+        for r in remote_listeners:
+            if not r.get("ip"):
+                continue
+            # loopback pairs only with loopback: a socket bound to
+            # 127.x cannot reach another host, and a REMOTE loopback
+            # listener would route to the local host (the guard the
+            # single-path code always had)
+            if li.loopback != ((_ip_int(r["ip"]) >> 24) == 127):
+                continue
+            q = connection_quality(li, r["ip"], r.get("speed", 0))
+            if q > 0:
+                cands.append((q, li.ipv4, r["ip"], int(r["port"])))
+    if not cands:
+        return []
+    cands.sort(key=lambda t: -t[0])
+    picked: list[tuple[Optional[str], str, int, float]] = []
+    used_local: set[str] = set()
+    used_remote: set[tuple[str, int]] = set()
+    # pass 1: fresh local AND fresh remote; pass 2: fresh on either
+    # end (use the peer's other listener before doubling a pair up);
+    # pass 3: anything
+    picked_set: set[tuple[str, str, int]] = set()
+    for mode in ("both", "either", "any"):
+        for q, lip, rip, rport in cands:
+            if len(picked) >= n:
+                return picked
+            fresh_l = lip not in used_local
+            fresh_r = (rip, rport) not in used_remote
+            if mode == "both" and not (fresh_l and fresh_r):
+                continue
+            if mode == "either" and not (fresh_l or fresh_r):
+                continue
+            if mode != "any" and (lip, rip, rport) in picked_set:
+                continue
+            picked.append((lip, rip, rport, q))
+            picked_set.add((lip, rip, rport))
+            used_local.add(lip)
+            used_remote.add((rip, rport))
+    return picked
+
+
 def modex_payload() -> list[dict]:
     """This host's interface list for the modex business card
     (reference: btl/tcp publishes its address list via PMIx)."""

@@ -87,15 +87,22 @@ def get(key: str, timeout_s: float = 60.0) -> Any:
 
 
 def publish_dcn_address(endpoint, process_index: int) -> None:
-    """PMIx_Put + Commit of this process's DCN business card: listener
-    address plus the NIC list (reference: btl/tcp publishes every usable
-    interface address via the modex, btl_tcp_proc.c consumes it for
-    address matching)."""
+    """PMIx_Put + Commit of this process's DCN business card: every
+    listener (one per bound interface) plus the NIC list (reference:
+    btl/tcp publishes every usable interface address via the modex,
+    btl_tcp_proc.c consumes it for address matching)."""
     from . import interfaces
 
+    ifaces = interfaces.modex_payload()
+    speed = {i["ip"]: i.get("speed", 0) for i in ifaces if i.get("ip")}
     put(f"dcn/{process_index}", {
         "ip": endpoint.address[0], "port": endpoint.address[1],
-        "ifaces": interfaces.modex_payload(),
+        "listeners": [
+            {"ip": ip, "port": port, "speed": speed.get(ip, 0)}
+            for ip, port in getattr(endpoint, "listeners",
+                                    [endpoint.address])
+        ],
+        "ifaces": ifaces,
     })
 
 

@@ -397,3 +397,64 @@ def test_modex_carries_iface_card():
         assert addrs[0] == ep.address
     finally:
         ep.close()
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r2 item 6: true multi-NIC endpoints — one listener per
+# interface, links across distinct (local if, remote if) pairs
+# (reference: btl_tcp_proc.c address matching; 127.0.0.1/127.0.0.2 are
+# distinct loopback addresses standing in for two NICs).
+# ---------------------------------------------------------------------------
+
+def test_multinic_links_bind_distinct_local_addresses():
+    import time
+
+    from ompi_tpu.btl.dcn import DcnEndpoint
+
+    a = DcnEndpoint(bind_ip="127.0.0.1")
+    b = DcnEndpoint(bind_ip="127.0.0.1")
+    try:
+        ip2, port2 = b.listen_on("127.0.0.2")
+        assert ("127.0.0.2", port2) in b.listeners
+        pid = a.connect_pairs(
+            [("127.0.0.1", b.address[0], b.address[1]),
+             ("127.0.0.2", "127.0.0.2", port2)],
+            cookie=9,
+        )
+        addrs = a.link_addrs(pid)
+        local_ips = sorted(la.split(":")[0] for la, _ in addrs)
+        remote_ips = sorted(ra.split(":")[0] for _, ra in addrs)
+        assert local_ips == ["127.0.0.1", "127.0.0.2"], addrs
+        assert remote_ips == ["127.0.0.1", "127.0.0.2"], addrs
+
+        # traffic flows over the grouped multi-NIC peer (both links)
+        a.set_link_weights(pid, [0.5, 0.5])
+        big = b"z" * (600 * 1024)  # rndv: FRAGs stripe over both links
+        a.send_bytes(pid, 5, big)
+        got = b.recv_bytes(timeout=30)
+        assert got[1] == 5 and got[2] == big
+        frags = [a.link_frags(pid, i) for i in range(2)]
+        assert all(f > 0 for f in frags), frags
+    finally:
+        a.close()
+        b.close()
+
+
+def test_choose_link_pairs_spreads_interfaces():
+    from ompi_tpu.runtime.interfaces import Interface, choose_link_pairs
+
+    locals_ = [
+        Interface(name="eth0", ipv4="10.0.0.1", netmask="255.255.255.0",
+                  up=True, loopback=False, speed_mbps=10000),
+        Interface(name="eth1", ipv4="10.0.1.1", netmask="255.255.255.0",
+                  up=True, loopback=False, speed_mbps=10000),
+    ]
+    remotes = [
+        {"ip": "10.0.0.2", "port": 1000, "speed": 10000},
+        {"ip": "10.0.1.2", "port": 1001, "speed": 10000},
+    ]
+    pairs = choose_link_pairs(locals_, remotes, 2)
+    assert len(pairs) == 2
+    # same-subnet pairing wins: eth0<->10.0.0.2, eth1<->10.0.1.2
+    got = sorted((lip, rip) for lip, rip, _, _ in pairs)
+    assert got == [("10.0.0.1", "10.0.0.2"), ("10.0.1.1", "10.0.1.2")]
