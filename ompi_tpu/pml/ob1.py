@@ -25,6 +25,8 @@ engine only pumps the matching state).
 
 from __future__ import annotations
 
+import threading
+
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -191,6 +193,13 @@ class Ob1Pml(PmlComponent):
         self._comm_state: dict[int, _CommP2P] = {}
         self._bml: dict[int, Bml] = {}
         self._fabric = None  # cross-process engine (pml/fabric)
+        # Matching mutex: posted/unexpected queues are check-then-act
+        # structures; concurrent isend/irecv/progress threads must
+        # match-or-park atomically or two threads can match one pending
+        # send to two recvs / lose a park entirely (the reference
+        # serializes matching with the ob1 matching lock;
+        # OPAL_THREAD_LOCK in pml_ob1_recvfrag.c).
+        self._mu = threading.RLock()
 
     # -- infrastructure ---------------------------------------------------
 
@@ -311,12 +320,14 @@ class Ob1Pml(PmlComponent):
         memchecker.check_defined(value, "send buffer")
         peruse.fire(peruse.PeruseEvent.REQ_ACTIVATE, request=req,
                     kind="send")
-        # Try to match an already-posted recv (order: post order).
-        if not self._match_posted(st, pending):
-            st.unexpected.append(pending)
-            peruse.fire(
-                peruse.PeruseEvent.QUEUE_UNEXPECTED, env=env
-            )
+        # Try to match an already-posted recv (order: post order);
+        # match-or-park is atomic under the matching mutex.
+        with self._mu:
+            if not self._match_posted(st, pending):
+                st.unexpected.append(pending)
+                peruse.fire(
+                    peruse.PeruseEvent.QUEUE_UNEXPECTED, env=env
+                )
         if eager:
             req._mark_sent(pending.transferred)
         return req
@@ -356,9 +367,11 @@ class Ob1Pml(PmlComponent):
 
         peruse.fire(peruse.PeruseEvent.REQ_ACTIVATE, request=req,
                     kind="recv")
-        if not self._match_unexpected(st, req):
-            st.posted.append(req)
-            peruse.fire(peruse.PeruseEvent.QUEUE_POSTED, request=req)
+        with self._mu:
+            if not self._match_unexpected(st, req):
+                st.posted.append(req)
+                peruse.fire(peruse.PeruseEvent.QUEUE_POSTED,
+                            request=req)
         return req
 
     def recv(self, comm, source: int, tag: int,
@@ -435,9 +448,10 @@ class Ob1Pml(PmlComponent):
         SPC.record("pml_remote_arrivals")
         from ..core import peruse
 
-        if not self._match_posted(st, pending):
-            st.unexpected.append(pending)
-            peruse.fire(peruse.PeruseEvent.QUEUE_UNEXPECTED, env=env)
+        with self._mu:
+            if not self._match_posted(st, pending):
+                st.unexpected.append(pending)
+                peruse.fire(peruse.PeruseEvent.QUEUE_UNEXPECTED, env=env)
 
     def _match_posted(self, st: _CommP2P, pending: _PendingSend) -> bool:
         from ..core.request import RequestState
@@ -478,13 +492,14 @@ class Ob1Pml(PmlComponent):
         )
 
         def scan() -> Optional[Status]:
-            for pending in st.unexpected:
-                if self._compatible(probe_req, pending.env):
-                    return Status(
-                        source=pending.env.src,
-                        tag=pending.env.tag,
-                        count=pending.env.nbytes,
-                    )
+            with self._mu:  # concurrent pops shift list positions
+                for pending in st.unexpected:
+                    if self._compatible(probe_req, pending.env):
+                        return Status(
+                            source=pending.env.src,
+                            tag=pending.env.tag,
+                            count=pending.env.nbytes,
+                        )
             return None
 
         fabric_armed = (
@@ -548,11 +563,12 @@ class Ob1Pml(PmlComponent):
             comm.check_rank(dest),
             tag,
         )
-        for i, pending in enumerate(st.unexpected):
-            if self._compatible(probe_req, pending.env):
-                st.unexpected.pop(i)
-                SPC.record("pml_improbe_hits")
-                return Message(self, comm, pending, dest)
+        with self._mu:  # match-and-remove must be atomic vs matching
+            for i, pending in enumerate(st.unexpected):
+                if self._compatible(probe_req, pending.env):
+                    st.unexpected.pop(i)
+                    SPC.record("pml_improbe_hits")
+                    return Message(self, comm, pending, dest)
         return None
 
 

@@ -1,0 +1,167 @@
+"""Concurrency hardening (VERDICT r2 item 8): >=4 threads per
+controller driving concurrent isend/irecv/progress across 2 processes
+— fabric locks, dcn completion queues, request completion paths under
+contention (reference bar: opal wait_sync multi-waiter semantics,
+opal/mca/threads/wait_sync.h)."""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ompi_tpu.native import build
+
+pytestmark = pytest.mark.skipif(
+    not build.available(), reason="native library unavailable")
+
+_WORKER = textwrap.dedent(r"""
+    import os, sys, threading
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.core import config
+    from ompi_tpu.pml import fabric
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    config.set("pml_fabric_pipeline_segment", 32 * 1024)
+    world = ompi_tpu.init()   # ranks 0,1 <-> 2,3
+    eng = fabric.wire_up()
+
+    N_THREADS = 4
+    N_MSGS = 25
+    my_ranks = (0, 1) if pid == 0 else (2, 3)
+    peer_ranks = (2, 3) if pid == 0 else (0, 1)
+    errors = []
+
+    def payload(t, i):
+        # mix fastbox (tiny), eager (mid), and rendezvous (big) sizes
+        size = (8, 3000, 40000)[i % 3]
+        return (np.arange(size, dtype=np.float32)
+                + 1000 * t + i).astype(np.float32)
+
+    def sender(t):
+        try:
+            src = my_ranks[t % 2]
+            dst = peer_ranks[t % 2]
+            reqs = []
+            for i in range(N_MSGS):
+                reqs.append(world.rank(src).isend(
+                    payload(t, i), dest=dst, tag=1000 + t * 100 + i))
+            for r in reqs:
+                r.wait(timeout=120)
+        except Exception as exc:   # noqa: BLE001
+            errors.append(("send", t, repr(exc)))
+
+    def receiver(t):
+        try:
+            dst = my_ranks[t % 2]
+            for i in range(N_MSGS):
+                out = world.rank(dst).recv(
+                    source=peer_ranks[t % 2], tag=1000 + t * 100 + i)
+                exp = payload(t, i)
+                got = np.asarray(out)
+                assert got.shape == exp.shape and np.allclose(got, exp), (
+                    t, i, got.shape)
+        except Exception as exc:   # noqa: BLE001
+            errors.append(("recv", t, repr(exc)))
+
+    threads = [threading.Thread(target=sender, args=(t,))
+               for t in range(N_THREADS)]
+    threads += [threading.Thread(target=receiver, args=(t,))
+                for t in range(N_THREADS)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=180)
+    alive = [th for th in threads if th.is_alive()]
+    assert not alive, f"threads wedged: {len(alive)}"
+    assert not errors, errors[:4]
+    world.barrier()
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_threaded_p2p_storm():
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(pid), str(nprocs),
+             coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-4000:]}"
+        assert "OK" in out
+
+
+def test_fabric_error_routed_to_owning_request():
+    """A send failure during CTS processing fails the rendezvous
+    sender's request (status.error) instead of surfacing in an
+    arbitrary waiter's progress pump."""
+    from types import SimpleNamespace
+
+    import numpy as np
+
+    from ompi_tpu.pml.fabric import FabricEngine, FabricError, K_CTS
+
+    ep = SimpleNamespace(poll_recv=lambda: None,
+                         poll_send_complete=lambda: None)
+    eng = FabricEngine(ep, my_index=0, n_processes=2)
+
+    class _Req:
+        def __init__(self):
+            self.status = SimpleNamespace(error=None)
+            self.completed = []
+
+        def _complete(self, result, status=None):
+            self.completed.append(result)
+            if status is not None:
+                self.status = status
+
+        def _mark_sent(self, value):
+            self.completed.append("sent")
+
+    req = _Req()
+    eng._rndv_out[(1, 5, 0)] = (np.ones(4), req)
+    # no wiring to process 1 -> _send raises inside _on_cts
+    eng._dispatch(1, {"k": K_CTS, "cid": 5, "seq": 0, "src": 2,
+                      "dst": 0, "tag": 3, "nb": 16})
+    assert isinstance(req.status.error, FabricError)
+    assert req.completed == [None]
