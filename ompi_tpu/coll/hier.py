@@ -396,9 +396,11 @@ class FabricSlice:
         self.leaders: dict[int, int] = {}
         self.local_ranks: list[int] = []
         self.rank_slice: list[int] = []  # comm rank -> slice index
+        self.members: list[list[int]] = [[] for _ in slices]  # per slice
         for r, p in enumerate(procs):
             s = slices.index(p.process_index)
             self.rank_slice.append(s)
+            self.members[s].append(r)
             self.leaders.setdefault(s, r)
             if p.process_index == my:
                 self.local_ranks.append(r)
@@ -447,6 +449,21 @@ class FabricSlice:
         return all(a <= b for a, b in
                    zip(self.rank_slice, self.rank_slice[1:]))
 
+    def ordered_schedule(self, opo) -> Optional[str]:
+        """None for commutative ops; the slice-ordered 'gather'
+        schedule for non-commutative ones — which equals MPI rank order
+        only when ranks ascend with slices, so anything else raises
+        (reference: non-commutative ops take the ordered path,
+        coll_tuned_decision_fixed.c:85)."""
+        if getattr(opo, "commutative", True):
+            return None
+        if not self.rank_ordered():
+            raise HierError(
+                "non-commutative ops on a spanning comm need ranks "
+                "contiguous per process and processes in rank order"
+            )
+        return "gather"
+
     def finish(self) -> None:
         """Drain outstanding leader isends (rendezvous sends complete
         when the peer's CTS arrives during its own exchange)."""
@@ -493,8 +510,203 @@ def comm_slice(comm) -> FabricSlice:
     return h
 
 
+# -- spanning-comm data-movement and prefix collectives ---------------------
+# (reference: every comm operation comes from the per-comm coll table,
+# coll_base_functions.h:45-66; these run leader exchanges over the
+# fabric p2p and the device tier inside each slice)
+
+def _np_bytes(arr: np.ndarray) -> bytes:
+    """Self-describing wire form (dtype+shape ride along)."""
+    import io
+
+    bio = io.BytesIO()
+    np.save(bio, np.ascontiguousarray(arr), allow_pickle=False)
+    return bio.getvalue()
+
+
+def _np_from(raw: bytes) -> np.ndarray:
+    import io
+
+    return np.load(io.BytesIO(raw), allow_pickle=False)
+
+
+def _hier_op(fn):
+    """Wrap a HierColl exchange method with the epoch/abort protocol."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(self, comm, *args, **kw):
+        h = comm_slice(comm)
+        tag = h.next_tag_base()
+        try:
+            out = fn(self, comm, h, tag, *args, **kw)
+            h.finish()
+        except BaseException:
+            h.abort_pending()
+            raise
+        return out
+
+    return wrapped
+
+
+class _HierDataOps:
+    """Mixin: the data-movement / prefix operations of HierColl."""
+
+    @_hier_op
+    def allgather(self, comm, h, tag, x):
+        x = h.local_rank_major(x)
+        arr = np.asarray(x)
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                h.send_bytes(s, tag, _np_bytes(arr))
+        parts = {h.slice_id: arr}
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                parts[s] = _np_from(h.recv_from(s, tag, timeout=60.0))
+        full = np.empty((comm.size,) + arr.shape[1:], arr.dtype)
+        for s, ranks in enumerate(h.members):
+            for i, r in enumerate(ranks):
+                full[r] = parts[s][i]
+        out = np.broadcast_to(full, (h.comm.size,) + full.shape)
+        SPC.record("hier_allgathers")
+        return h.comm.put_rank_major(np.ascontiguousarray(out))
+
+    @_hier_op
+    def gather(self, comm, h, tag, x, root):
+        import jax
+
+        x = h.local_rank_major(x)
+        arr = np.asarray(x)
+        root_slice = h.rank_slice[root]
+        if h.slice_id != root_slice:
+            h.send_bytes(root_slice, tag, _np_bytes(arr))
+            return None
+        full = np.empty((comm.size,) + arr.shape[1:], arr.dtype)
+        for s, ranks in enumerate(h.members):
+            part = arr if s == root_slice else _np_from(
+                h.recv_from(s, tag, timeout=60.0))
+            for i, r in enumerate(ranks):
+                full[r] = part[i]
+        SPC.record("hier_gathers")
+        return jax.device_put(full, comm.procs[root].device)
+
+    @_hier_op
+    def scatter(self, comm, h, tag, x, root):
+        root_slice = h.rank_slice[root]
+        if h.slice_id == root_slice:
+            arr = np.asarray(x)
+            if arr.shape[0] != comm.size:
+                from ..core.errors import ArgumentError
+
+                raise ArgumentError(
+                    f"scatter root buffer needs leading dim "
+                    f"{comm.size}, got {arr.shape}"
+                )
+            for s in range(h.n_slices):
+                if s != root_slice:
+                    h.send_bytes(s, tag, _np_bytes(arr[h.members[s]]))
+            local = arr[h.members[h.slice_id]]
+        else:
+            local = _np_from(h.recv_from(root_slice, tag, timeout=60.0))
+        SPC.record("hier_scatters")
+        return h.comm.put_rank_major(np.ascontiguousarray(local))
+
+    @_hier_op
+    def alltoall(self, comm, h, tag, x):
+        from ..core.errors import ArgumentError
+
+        x = h.local_rank_major(x)
+        arr = np.asarray(x)
+        if arr.ndim < 2 or arr.shape[1] != comm.size:
+            raise ArgumentError(
+                f"spanning alltoall needs (local_ranks, comm_size, ...) "
+                f"buffer, got {arr.shape}"
+            )
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                h.send_bytes(s, tag, _np_bytes(arr[:, h.members[s]]))
+        out = np.empty_like(arr)
+        mine = h.members[h.slice_id]
+        out[:, mine] = arr[:, mine].swapaxes(0, 1)
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                recv = _np_from(h.recv_from(s, tag, timeout=60.0))
+                out[:, h.members[s]] = recv.swapaxes(0, 1)
+        SPC.record("hier_alltoalls")
+        return h.comm.put_rank_major(np.ascontiguousarray(out))
+
+    @_hier_op
+    def reduce_scatter_block(self, comm, h, tag, x, op):
+        from ..core.errors import ArgumentError
+
+        opo = op_lookup(op)
+        x = h.local_rank_major(x)
+        if x.ndim < 2 or x.shape[1] != comm.size:
+            raise ArgumentError(
+                f"spanning reduce_scatter_block needs (local_ranks, "
+                f"comm_size, ...) buffer, got {x.shape}"
+            )
+        schedule = h.ordered_schedule(opo)
+        partial = phase1_local_reduce(h, x, opo)
+        full = phase2_exchange(h, partial, opo, timeout=60.0,
+                               schedule=schedule, tag_base=tag)
+        SPC.record("hier_reduce_scatters")
+        return h.comm.put_rank_major(
+            np.ascontiguousarray(full[h.members[h.slice_id]]))
+
+    def _prefix(self, comm, h, tag, x, op, *, inclusive: bool):
+        opo = op_lookup(op)
+        if not h.rank_ordered():
+            raise HierError(
+                "scan on a spanning comm needs ranks contiguous per "
+                "process and processes in rank order (prefix order IS "
+                "rank order)"
+            )
+        x = h.local_rank_major(x)
+        arr = np.asarray(x)
+        n_local = arr.shape[0]
+        # local inclusive prefix + slice total
+        pref = np.empty_like(arr)
+        acc = arr[0]
+        pref[0] = acc
+        for i in range(1, n_local):
+            acc = opo.np_reduce(acc, arr[i])
+            pref[i] = acc
+        total = acc
+        # slice totals flow upward: every lower slice's total folds into
+        # my offset in slice order
+        for s in range(h.slice_id + 1, h.n_slices):
+            h.send_bytes(s, tag, _np_bytes(total))
+        offset = None
+        for s in range(h.slice_id):
+            t = _np_from(h.recv_from(s, tag, timeout=60.0))
+            offset = t if offset is None else opo.np_reduce(offset, t)
+        if inclusive:
+            out = pref if offset is None else np.stack(
+                [opo.np_reduce(offset, p) for p in pref])
+        else:
+            rows = []
+            for i in range(n_local):
+                prev = offset if i == 0 else (
+                    pref[i - 1] if offset is None
+                    else opo.np_reduce(offset, pref[i - 1]))
+                rows.append(np.zeros_like(arr[0]) if prev is None
+                            else prev)
+            out = np.stack(rows)
+        SPC.record("hier_scans" if inclusive else "hier_exscans")
+        return h.comm.put_rank_major(np.ascontiguousarray(out))
+
+    @_hier_op
+    def scan(self, comm, h, tag, x, op="sum"):
+        return self._prefix(comm, h, tag, x, op, inclusive=True)
+
+    @_hier_op
+    def exscan(self, comm, h, tag, x, op="sum"):
+        return self._prefix(comm, h, tag, x, op, inclusive=False)
+
+
 @COLL.register
-class HierColl(CollComponent):
+class HierColl(_HierDataOps, CollComponent):
     NAME = "hier"
     PRIORITY = 85  # above tuned (80): device tiers cannot cross controllers
     DESCRIPTION = ("two-level ICI+DCN collectives for process-spanning "
@@ -516,19 +728,7 @@ class HierColl(CollComponent):
     def allreduce(self, comm, x, op):
         h = comm_slice(comm)
         opo = op_lookup(op)
-        schedule = None
-        if not getattr(opo, "commutative", True):
-            # The rd/ring exchanges combine in arrival/XOR order; only
-            # the gather schedule folds slices in ascending order, which
-            # equals MPI rank order when ranks ascend with slices
-            # (reference: non-commutative ops take the ordered path,
-            # coll_tuned_decision_fixed.c:85).
-            if not h.rank_ordered():
-                raise HierError(
-                    "non-commutative ops on a spanning comm need ranks "
-                    "contiguous per process and processes in rank order"
-                )
-            schedule = "gather"
+        schedule = h.ordered_schedule(opo)
         try:
             out = allreduce(h, h.local_rank_major(x), op,
                             schedule=schedule,
@@ -573,11 +773,7 @@ class HierColl(CollComponent):
         h = comm_slice(comm)
         x = h.local_rank_major(x)
         opo = op_lookup(op)
-        if not getattr(opo, "commutative", True) and not h.rank_ordered():
-            raise HierError(
-                "non-commutative ops on a spanning comm need ranks "
-                "contiguous per process and processes in rank order"
-            )
+        h.ordered_schedule(opo)  # layout guard for non-commutative ops
         partial = phase1_local_reduce(h, x, opo)
         root_slice = h.rank_slice[root]
         tag = h.next_tag_base()

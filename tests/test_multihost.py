@@ -428,3 +428,121 @@ def test_two_process_vtable_collectives():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed:\n{err[-3000:]}"
         assert "OK" in out
+
+
+_DATAOPS_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.pml import fabric
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    world = ompi_tpu.init()     # ranks 0,1 on p0; 2,3 on p1
+    fabric.wire_up()
+    n, nl = world.size, 2
+    my = (0, 1) if pid == 0 else (2, 3)
+
+    def blk(r):
+        return np.arange(3, dtype=np.float32) + 10 * r
+
+    local = np.stack([blk(r) for r in my])
+
+    # allgather: every local rank row holds ALL blocks in rank order
+    out = np.asarray(world.allgather(local))
+    assert out.shape == (nl, n, 3), out.shape
+    for row in out:
+        np.testing.assert_array_equal(row, np.stack(
+            [blk(r) for r in range(n)]))
+
+    # gather at remote-or-local root
+    g = world.gather(local, root=2)
+    if pid == 1:
+        np.testing.assert_array_equal(
+            np.asarray(g), np.stack([blk(r) for r in range(n)]))
+    else:
+        assert g is None
+
+    # scatter from root rank 1 (process 0)
+    sendbuf = (np.stack([blk(r) for r in range(n)]) * 2
+               if pid == 0 else None)
+    sc = np.asarray(world.scatter(sendbuf, root=1))
+    np.testing.assert_array_equal(sc, local * 2)
+
+    # alltoall: out[j_loc][src] == x_src[src_loc][global j]
+    x = np.stack([
+        np.stack([np.full(2, 100 * r + d, np.float32)
+                  for d in range(n)])
+        for r in my
+    ])
+    a2a = np.asarray(world.alltoall(x))
+    for j_loc, j in enumerate(my):
+        for src in range(n):
+            np.testing.assert_array_equal(
+                a2a[j_loc, src], np.full(2, 100 * src + j))
+
+    # reduce_scatter_block: each rank keeps the summed block it owns
+    contrib = np.stack([
+        np.stack([np.full(2, r + 1.0, np.float32) * (d + 1)
+                  for d in range(n)])
+        for r in my
+    ])
+    rs = np.asarray(world.reduce_scatter_block(contrib))
+    total = sum(r + 1.0 for r in range(n))
+    for j_loc, j in enumerate(my):
+        np.testing.assert_array_equal(rs[j_loc],
+                                      np.full(2, total * (j + 1)))
+
+    # scan / exscan (rank-ordered prefix across processes)
+    sc_in = np.stack([np.full(2, float(r + 1), np.float32) for r in my])
+    inc = np.asarray(world.scan(sc_in))
+    exc = np.asarray(world.exscan(sc_in))
+    for j_loc, j in enumerate(my):
+        np.testing.assert_array_equal(
+            inc[j_loc], np.full(2, sum(range(1, j + 2)), np.float32))
+        np.testing.assert_array_equal(
+            exc[j_loc], np.full(2, sum(range(1, j + 1)), np.float32))
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def test_two_process_vtable_data_collectives():
+    """Spanning comms get the full data-movement family through the
+    vtable: allgather/gather/scatter/alltoall/reduce_scatter_block/
+    scan/exscan over DCN."""
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _DATAOPS_WORKER, str(pid),
+             str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-4000:]}"
+        assert "OK" in out
