@@ -59,6 +59,7 @@ _T_BATCH = 0   # op batch (fence flush or unlock flush)
 _T_REPLY = 1   # per-batch reply: get/fetch results + application ack
 _T_LOCK = 2    # lock request
 _T_GRANT = 3   # lock grant
+_T_POST = 4    # PSCW exposure-epoch notification (post -> origins)
 
 def _enc_index(idx) -> Any:
     """dss-able encoding of a window index (None | int | slice | tuple
@@ -124,6 +125,11 @@ class FabricWindow:
         self._lock_mu = threading.RLock()
         # fence arrival accounting (driven by the handler)
         self._got_batches: set[int] = set()
+        # PSCW accounting: counters, not sets — back-to-back epochs
+        # from the same peer must not coalesce
+        self._pscw_done: dict[int, int] = {}    # origin -> completions
+        self._post_tokens: dict[int, int] = {}  # target -> posts seen
+        self._pscw_origins: list[int] = []
         self._held: list = []  # future-epoch messages
         self._in_handler = False
         self._freed = False
@@ -168,6 +174,12 @@ class FabricWindow:
             if target not in self._locks:
                 raise RMASyncError(
                     f"{self.name}: target {target} is not locked"
+                )
+        if self._sync == SyncType.PSCW and target is not None:
+            if target not in self._pscw_targets:
+                raise RMASyncError(
+                    f"{self.name}: target {target} is outside the "
+                    f"start() group {self._pscw_targets}"
                 )
 
     # -- RMA operations ----------------------------------------------------
@@ -276,7 +288,7 @@ class FabricWindow:
         try:
             pml = self.comm.pml
             me = self._my_leader()
-            for sub in (_T_BATCH, _T_LOCK):
+            for sub in (_T_BATCH, _T_LOCK, _T_POST):
                 while True:
                     m = pml.improbe(self.comm, -1, self._tag(sub),
                                     dest=me)
@@ -294,12 +306,15 @@ class FabricWindow:
             # another window's traffic shares no tags; this is a bug
             raise WinError(f"{self.name}: foreign window message {msg}")
         if sub == _T_BATCH:
-            if msg["ep"] != -1 and msg["ep"] != self._epoch:
+            if msg["ep"] not in (-1, -2) and msg["ep"] != self._epoch:
                 self._held.append((sub, msg))  # future fence epoch
                 return
             self._apply_batch(msg)
         elif sub == _T_LOCK:
             self._handle_lock_req(msg)
+        elif sub == _T_POST:
+            org = msg["org"]
+            self._post_tokens[org] = self._post_tokens.get(org, 0) + 1
 
     def _apply_batch(self, msg: dict) -> None:
         org = msg["org"]
@@ -326,7 +341,10 @@ class FabricWindow:
             "win": self.win_id, "ep": msg["ep"],
             "org": self.h.slice_id, "vals": vals,
         })
-        if msg["ep"] != -1:
+        if msg["ep"] == -2:
+            # PSCW completion marker: the origin's access epoch closed
+            self._pscw_done[org] = self._pscw_done.get(org, 0) + 1
+        elif msg["ep"] != -1:
             self._got_batches.add(org)
 
     # -- lock manager (targets owned by this controller) -------------------
@@ -514,6 +532,90 @@ class FabricWindow:
         del self._locks[target]
         if not self._locks:
             self._sync = SyncType.NONE
+
+    # generalized active target (PSCW) -------------------------------------
+
+    def start(self, group) -> None:
+        """Open an access epoch to the ranks in `group`
+        (MPI_Win_start; reference: osc_rdma PSCW sync,
+        osc_rdma_sync.h:24-30)."""
+        self._check_alive()
+        if self._sync != SyncType.NONE:
+            raise RMASyncError(
+                f"{self.name}: start inside {self._sync.value} epoch"
+            )
+        self._pscw_targets = [self.comm.check_rank(r)
+                              for r in self._group_ranks(group)]
+        # MPI_Win_start may not access the window before the matching
+        # MPI_Win_post: consume one post token per remote target slice
+        # (tokens are counters, so repeated epochs pair up correctly)
+        for s in sorted({self._slice_of(t) for t in self._pscw_targets
+                         if self._slice_of(t) != self.h.slice_id}):
+            self._pump_until(
+                lambda s=s: self._post_tokens.get(s, 0) > 0,
+                f"post() from slice {s}",
+            )
+            self._post_tokens[s] -= 1
+        self._sync = SyncType.PSCW
+        SPC.record("osc_pscw_starts")
+
+    def complete(self) -> None:
+        """Close the access epoch: local ops apply, remote ops ship as
+        PSCW batches (applied immediately at the passive target and
+        counted by its wait())."""
+        self._check_alive()
+        if self._sync != SyncType.PSCW:
+            raise RMASyncError(f"{self.name}: complete without start")
+        self._inner._apply_pending()
+        slices = sorted({
+            self._slice_of(t) for t in self._pscw_targets
+            if self._slice_of(t) != self.h.slice_id
+        })
+        for s in slices:
+            self._flush_slice(s, -2)  # ep=-2: the PSCW marker
+        self._collect_replies(slices, -2)
+        self._sync = SyncType.NONE
+        self._pscw_targets = []
+
+    def post(self, group) -> None:
+        """Expose the window to `group`'s origins (MPI_Win_post)."""
+        self._check_alive()
+        if self._pscw_origins:
+            raise RMASyncError(
+                f"{self.name}: post() with an un-waited exposure epoch"
+            )
+        # NOTE: do not clear _pscw_done here — a fast origin's
+        # complete() marker may land before the exposure side posts
+        self._pscw_origins = sorted({
+            self._slice_of(self.comm.check_rank(r))
+            for r in self._group_ranks(group)
+        } - {self.h.slice_id})
+        for s in self._pscw_origins:
+            self._send_msg(s, _T_POST, {
+                "win": self.win_id, "ep": -2, "org": self.h.slice_id,
+            })
+
+    def wait(self) -> None:
+        """Exposure-side wait: every posted origin's complete() batch
+        has arrived and been applied."""
+        self._check_alive()
+        expected = self._pscw_origins
+        self._pump_until(
+            lambda: all(self._pscw_done.get(s, 0) > 0 for s in expected),
+            "PSCW origin completions",
+        )
+        # consume this epoch's markers (repeated epochs pair up)
+        for s in expected:
+            self._pscw_done[s] -= 1
+        self._pscw_origins = []
+
+    def _group_ranks(self, group):
+        """Comm ranks of a PSCW group (a Group of world ranks or a
+        plain iterable of comm ranks)."""
+        if hasattr(group, "world_ranks"):
+            comm_wr = list(self.comm.group.world_ranks)
+            return [comm_wr.index(w) for w in group.world_ranks]
+        return list(group)
 
     def flush(self, target: Optional[int] = None) -> None:
         self._check_alive()

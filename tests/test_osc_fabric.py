@@ -94,6 +94,28 @@ _WORKER = textwrap.dedent(r"""
 
     world.barrier()
 
+    # PSCW: p0 starts an access epoch to p1's ranks; p1 posts/waits
+    if pid == 0:
+        win.start([2, 3])   # blocks until p1's post()
+        win.put(np.full(3, 41.0, np.float32), target=2)
+        win.accumulate(np.full(3, 1.0, np.float32), target=2, op="sum")
+        win.complete()
+        # back-to-back second epoch: markers must not coalesce
+        win.start([2, 3])
+        win.accumulate(np.full(3, 8.0, np.float32), target=2, op="sum")
+        win.complete()
+        world.rank(0).send(np.float32(0.0), dest=2, tag=501)
+    else:
+        win.post([0, 1])
+        win.wait()   # returns once p0's first complete() applied
+        win.post([0, 1])
+        win.wait()
+        local = np.asarray(win.array)
+        assert np.allclose(local[0], 50.0), local   # 41 + 1 + 8
+        world.rank(2).recv(source=0, tag=501)
+
+    world.barrier()
+
     # local-target lock (the lock manager serves our own slice too)
     if pid == 1:
         win.lock(3, osc.LOCK_EXCLUSIVE)
