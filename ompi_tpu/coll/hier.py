@@ -270,16 +270,23 @@ def _allreduce_pipelined(h: SliceHandle, x, op, *, timeout: float,
     ]
     SPC.record("hier_pipelined_allreduces")
     rounds_span = h.n_slices + 2  # tag namespace per segment
-    out_segs = []
+    bcasts = []
     for s, dev_red in enumerate(reduced):
         partial = np.asarray(jax.device_get(dev_red))
-        out_segs.append(phase2_exchange(
+        seg_out = phase2_exchange(
             h, partial, op, timeout=timeout, schedule=schedule,
             tag_base=tag_base + s * rounds_span,
-        ))
+        )
+        # Phase 3 per segment, enqueued IMMEDIATELY: the intra-slice
+        # bcast of segment s runs on the devices (async dispatch) while
+        # segment s+1 is still on the wire — exchange/bcast overlap,
+        # not just phase-1/wire overlap (the reference's segmented ring
+        # pipelines all three stages the same way,
+        # coll_base_allreduce.c:618-717).
+        bcasts.append(phase3_local_bcast(h, seg_out.reshape(-1)))
         SPC.record("hier_segments")
-    full = np.concatenate([seg.reshape(-1) for seg in out_segs])
-    return phase3_local_bcast(h, full.reshape(x.shape[1:]))
+    full = jnp.concatenate(bcasts, axis=1)
+    return full.reshape((n,) + x.shape[1:])
 
 
 def phase1_local_reduce(h: SliceHandle, x, op="sum") -> np.ndarray:

@@ -31,6 +31,14 @@ class ProgressEngine:
         self._low_priority: list[ProgressFn] = []
         self._lock = threading.RLock()
         self._call_count = 0
+        # multi-waiter coordination (reference: wait_sync.h) — one
+        # thread pumps, the rest sleep on completion notifications.
+        # REENTRANT: a progress callback may itself block (e.g. a
+        # passive RMA handler sending a rendezvous reply) and its nested
+        # wait must still be able to pump — non-reentrancy here would
+        # halt progress permanently.
+        self._pumper = threading.RLock()
+        self._wait_cv = threading.Condition()
 
     def register(self, fn: ProgressFn, low_priority: bool = False) -> None:
         with self._lock:
@@ -59,22 +67,45 @@ class ProgressEngine:
             events += fn()
         return events
 
+    def notify_completion(self) -> None:
+        """Wake sleeping waiters: a request completed (called from
+        Request._complete — the wait_sync 'signal' side)."""
+        with self._wait_cv:
+            self._wait_cv.notify_all()
+
     def progress_until(
         self,
         predicate: Callable[[], bool],
         timeout: float | None = None,
     ) -> bool:
-        """Spin the engine until predicate() or timeout. Yields when idle
-        (the reference sched_yield()s, opal_progress.c flow)."""
+        """Drive the engine until predicate() or timeout. With several
+        blocked threads, ONE pumps the callbacks while the others sleep
+        on a condition variable that request completion notifies — the
+        reference's multi-waiter wait_sync design
+        (opal/mca/threads/wait_sync.h) instead of N spinning threads."""
         deadline = None if timeout is None else time.monotonic() + timeout
         while not predicate():
-            events = self.progress()
-            if predicate():
-                return True
-            if deadline is not None and time.monotonic() >= deadline:
-                return False
-            if events == 0:
-                time.sleep(0)  # yield the GIL / scheduler
+            if self._pumper.acquire(blocking=False):
+                try:
+                    events = self.progress()
+                finally:
+                    self._pumper.release()
+                if predicate():
+                    return True
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
+                if events == 0:
+                    time.sleep(0)  # yield the GIL / scheduler
+            else:
+                # someone else is pumping: sleep until a completion
+                # fires (bounded so a missed wakeup degrades to a tick)
+                with self._wait_cv:
+                    if not predicate():
+                        self._wait_cv.wait(timeout=0.002)
+                if predicate():
+                    return True
+                if deadline is not None and time.monotonic() >= deadline:
+                    return False
         return True
 
 

@@ -72,7 +72,9 @@ class Request:
             self.status = status
         self.state = RequestState.COMPLETE
         from . import peruse
+        from . import progress as _progress
 
+        _progress.ENGINE.notify_completion()  # wake sleeping waiters
         peruse.fire(peruse.PeruseEvent.REQ_COMPLETE, request=self)
         from . import memchecker
 

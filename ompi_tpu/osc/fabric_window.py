@@ -130,6 +130,7 @@ class FabricWindow:
         self._pscw_done: dict[int, int] = {}    # origin -> completions
         self._post_tokens: dict[int, int] = {}  # target -> posts seen
         self._pscw_origins: list[int] = []
+        self._pscw_posted = False
         self._held: list = []  # future-epoch messages
         self._in_handler = False
         self._freed = False
@@ -314,7 +315,9 @@ class FabricWindow:
             self._handle_lock_req(msg)
         elif sub == _T_POST:
             org = msg["org"]
-            self._post_tokens[org] = self._post_tokens.get(org, 0) + 1
+            with self._lock_mu:
+                self._post_tokens[org] = (
+                    self._post_tokens.get(org, 0) + 1)
 
     def _apply_batch(self, msg: dict) -> None:
         org = msg["org"]
@@ -343,7 +346,8 @@ class FabricWindow:
         })
         if msg["ep"] == -2:
             # PSCW completion marker: the origin's access epoch closed
-            self._pscw_done[org] = self._pscw_done.get(org, 0) + 1
+            with self._lock_mu:
+                self._pscw_done[org] = self._pscw_done.get(org, 0) + 1
         elif msg["ep"] != -1:
             self._got_batches.add(org)
 
@@ -551,11 +555,17 @@ class FabricWindow:
         # (tokens are counters, so repeated epochs pair up correctly)
         for s in sorted({self._slice_of(t) for t in self._pscw_targets
                          if self._slice_of(t) != self.h.slice_id}):
-            self._pump_until(
-                lambda s=s: self._post_tokens.get(s, 0) > 0,
-                f"post() from slice {s}",
-            )
-            self._post_tokens[s] -= 1
+
+            def _take(s=s):
+                # consume atomically vs the handler's increment (which
+                # runs on whichever thread pumps progress)
+                with self._lock_mu:
+                    if self._post_tokens.get(s, 0) > 0:
+                        self._post_tokens[s] -= 1
+                        return True
+                return False
+
+            self._pump_until(_take, f"post() from slice {s}")
         self._sync = SyncType.PSCW
         SPC.record("osc_pscw_starts")
 
@@ -580,7 +590,7 @@ class FabricWindow:
     def post(self, group) -> None:
         """Expose the window to `group`'s origins (MPI_Win_post)."""
         self._check_alive()
-        if self._pscw_origins:
+        if self._pscw_posted:
             raise RMASyncError(
                 f"{self.name}: post() with an un-waited exposure epoch"
             )
@@ -594,20 +604,29 @@ class FabricWindow:
             self._send_msg(s, _T_POST, {
                 "win": self.win_id, "ep": -2, "org": self.h.slice_id,
             })
+        self._pscw_posted = True
 
     def wait(self) -> None:
         """Exposure-side wait: every posted origin's complete() batch
         has arrived and been applied."""
         self._check_alive()
+        if not self._pscw_posted:
+            raise RMASyncError(f"{self.name}: wait() without post()")
         expected = self._pscw_origins
-        self._pump_until(
-            lambda: all(self._pscw_done.get(s, 0) > 0 for s in expected),
-            "PSCW origin completions",
-        )
-        # consume this epoch's markers (repeated epochs pair up)
-        for s in expected:
-            self._pscw_done[s] -= 1
+
+        def _all_done():
+            with self._lock_mu:
+                if not all(self._pscw_done.get(s, 0) > 0
+                           for s in expected):
+                    return False
+                # consume this epoch's markers (repeated epochs pair up)
+                for s in expected:
+                    self._pscw_done[s] -= 1
+                return True
+
+        self._pump_until(_all_done, "PSCW origin completions")
         self._pscw_origins = []
+        self._pscw_posted = False
 
     def _group_ranks(self, group):
         """Comm ranks of a PSCW group (a Group of world ranks or a

@@ -165,3 +165,35 @@ def test_fabric_error_routed_to_owning_request():
                       "dst": 0, "tag": 3, "nb": 16})
     assert isinstance(req.status.error, FabricError)
     assert req.completed == [None]
+
+
+def test_progress_multi_waiter_wait_sync():
+    """Multiple threads blocked in progress_until: one pumps, the rest
+    sleep and are woken by completion notifications (reference:
+    opal/mca/threads/wait_sync.h multi-waiter design)."""
+    import threading
+    import time
+
+    from ompi_tpu.core import progress as prog
+    from ompi_tpu.core.request import Request
+
+    reqs = [Request() for _ in range(6)]
+    done = []
+
+    def waiter(i):
+        ok = prog.ENGINE.progress_until(lambda: reqs[i].done, timeout=20)
+        done.append((i, ok))
+
+    threads = [threading.Thread(target=waiter, args=(i,))
+               for i in range(len(reqs))]
+    for t in threads:
+        t.start()
+    time.sleep(0.05)
+    for r in reqs:          # complete from the main thread
+        r._complete("x")
+        time.sleep(0.005)
+    for t in threads:
+        t.join(timeout=30)
+    assert not any(t.is_alive() for t in threads)
+    assert sorted(i for i, ok in done) == list(range(6))
+    assert all(ok for _, ok in done), done
