@@ -722,14 +722,16 @@ class HierColl(_HierDataOps, CollComponent):
     def available(self, comm=None, **_) -> bool:
         if comm is None:
             return False
+        import jax
+
+        from ..runtime.proc import spans_processes
+
         try:
+            if not spans_processes(comm):
+                return False
             idxs = {p.process_index for p in comm.procs}
         except Exception:
             return False
-        if len(idxs) <= 1:
-            return False
-        import jax
-
         return jax.process_index() in idxs and _fabric_wired()
 
     def allreduce(self, comm, x, op):

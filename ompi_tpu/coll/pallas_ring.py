@@ -1118,6 +1118,21 @@ def reduce_block(b: jax.Array, axis_name: str, op: Any, root: int = 0
     return tree_reduce(b, axis_name, op, root=root)
 
 
+def allreduce_block_rsag(b: jax.Array, axis_name: str, op: Any
+                         ) -> jax.Array:
+    """Two-phase allreduce composed from the standalone reduce-scatter
+    and allgather ring kernels. Communication-equivalent to the fused
+    ring (2(n-1) steps, 1/n payload each) — NOT the reference's
+    log(n) halving/doubling Rabenseifner (coll_base_allreduce.c:970) —
+    but it exercises the standalone kernels as a pipeline stage pair,
+    which is how TP layers consume them (psum_scatter + all_gather)."""
+    n = jax.lax.axis_size(axis_name)
+    segs, pad, shape = _split_ring(b, n)
+    own = ring_reduce_scatter(segs, axis_name, op)
+    out = ring_allgather(own, axis_name)
+    return _unsplit_ring(out, pad, shape)
+
+
 def bcast_block(b: jax.Array, axis_name: str, root: int = 0
                 ) -> jax.Array:
     """shard_map body: every rank ends with root's block (binomial

@@ -43,7 +43,17 @@ class SyncColl(XlaColl):
         self._counts: dict[int, int] = {}
 
     def available(self, **ctx: Any) -> bool:
-        return _enable.value
+        if not _enable.value:
+            return False
+        comm = ctx.get("comm")
+        if comm is not None:
+            from ..runtime.proc import spans_processes
+
+            # the XlaColl lowering cannot cross controller processes;
+            # spanning comms must keep coll/hier (priority 85 < 90)
+            if spans_processes(comm):
+                return False
+        return True
 
     def _maybe_barrier(self, comm) -> None:
         n = self._counts.get(comm.cid, 0) + 1

@@ -101,6 +101,7 @@ def _pallas_algos() -> None:
     ALLREDUCE_ALGOS["pallas_bidir"] = pr.allreduce_block_bidir
     ALLREDUCE_ALGOS["pallas_rd"] = _pallas_rd_guarded
     ALLREDUCE_ALGOS["pallas_ring_chunked"] = pr.allreduce_block_chunked
+    ALLREDUCE_ALGOS["pallas_rsag"] = pr.allreduce_block_rsag
     BCAST_ALGOS["pallas_binomial"] = pr.bcast_block
     ALLGATHER_ALGOS["pallas_ring"] = pr.ring_allgather
 

@@ -471,7 +471,9 @@ class DynamicWindow:
 
 
 def _spans_processes(comm) -> bool:
-    return len({p.process_index for p in comm.procs}) > 1
+    from ..runtime.proc import spans_processes
+
+    return spans_processes(comm)
 
 
 def create_window(comm, buffer, *, name: str = ""):

@@ -50,3 +50,10 @@ def proc_from_device(rank: int, device) -> Proc:
         core_on_chip=getattr(device, "core_on_chip", None),
         slice_index=getattr(device, "slice_index", 0) or 0,
     )
+
+
+def spans_processes(comm) -> bool:
+    """True when the communicator's ranks live on more than one
+    controller process (the cross-process surface: coll/hier, fabric
+    p2p, osc/fabric_window)."""
+    return len({pr.process_index for pr in comm.procs}) > 1

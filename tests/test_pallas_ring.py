@@ -444,3 +444,44 @@ def test_tuned_rules_select_pallas_rd(comm, tmp_path):
         config.set("coll_tuned_rules_file", "")
         config.set("coll_tuned_prefer_native", True)
         config.set("coll_select", "")
+
+
+def test_rabenseifner_composition_matches_oracle(mesh):
+    """pallas_rsag = ring reduce-scatter + ring allgather composed
+    (the standalone kernels as a TP-style pipeline pair)."""
+    n = 8
+    contrib = np.random.default_rng(31).standard_normal(
+        (n, 3 * 128 + 9)).astype(np.float32)
+    f = shard_map(
+        lambda x: pr.allreduce_block_rsag(x[0], "x", "sum")[None],
+        mesh=mesh, in_specs=P("x"), out_specs=P("x"), check_vma=False,
+    )
+    out = np.asarray(jax.jit(f)(jnp.asarray(contrib)))
+    for r in range(n):
+        np.testing.assert_allclose(out[r], contrib.sum(0),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_tuned_rules_select_pallas_rsag(comm, tmp_path):
+    import json
+
+    from ompi_tpu.core import config
+    from ompi_tpu.core.counters import SPC
+
+    rules = {"allreduce": [{"algorithm": "pallas_rsag"}]}
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps(rules))
+    config.set("coll_tuned_rules_file", str(p))
+    config.set("coll_tuned_prefer_native", False)
+    config.set("coll_select", "tuned,xla,basic")
+    try:
+        c = comm.dup()
+        data = np.ones((c.size, 40), np.float32)
+        out = np.asarray(c.allreduce(c.put_rank_major(data)))
+        np.testing.assert_allclose(out, c.size)
+        assert SPC.snapshot().get(
+            "coll_allreduce_algo_pallas_rsag", 0) >= 1
+    finally:
+        config.set("coll_tuned_rules_file", "")
+        config.set("coll_tuned_prefer_native", True)
+        config.set("coll_select", "")
