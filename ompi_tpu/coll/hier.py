@@ -724,15 +724,12 @@ class HierColl(_HierDataOps, CollComponent):
             return False
         import jax
 
-        from ..runtime.proc import spans_processes
-
         try:
-            if not spans_processes(comm):
-                return False
             idxs = {p.process_index for p in comm.procs}
         except Exception:
             return False
-        return jax.process_index() in idxs and _fabric_wired()
+        return (len(idxs) > 1 and jax.process_index() in idxs
+                and _fabric_wired())
 
     def allreduce(self, comm, x, op):
         h = comm_slice(comm)

@@ -147,6 +147,19 @@ class FabricWindow:
     def block_shape(self):
         return self._inner.block_shape
 
+    def _set_array(self, arr) -> None:
+        """Replace this controller's LOCAL blocks (SHMEM collectives
+        deliver local rank-major results on spanning comms)."""
+        self._inner._set_array(arr)
+
+    def _local_idx_or_raise(self, pe: int) -> int:
+        if self.h.rank_slice[pe] != self.h.slice_id:
+            raise WinError(
+                f"{self.name}: PE {pe} lives on another controller; "
+                "use get()/put() for remote symmetric access"
+            )
+        return self._local_idx(pe)
+
     def _tag(self, sub: int) -> int:
         return _TAG_BASE + (self.win_id % 0xFFFF) * 8 + sub
 
@@ -635,6 +648,28 @@ class FabricWindow:
             comm_wr = list(self.comm.group.world_ranks)
             return [comm_wr.index(w) for w in group.world_ranks]
         return list(group)
+
+    def lock_all(self) -> None:
+        """Shared lock on every rank (MPI_Win_lock_all) — the SHMEM
+        standing epoch. Grants are acquired per remote rank through the
+        same lock manager as lock()."""
+        self._check_alive()
+        if self._sync != SyncType.NONE:
+            raise RMASyncError(f"{self.name}: lock_all inside epoch")
+        for r in range(self.comm.size):
+            self._sync = SyncType.NONE  # let lock() see a clean state
+            self.lock(r, LOCK_SHARED)
+        self._sync = SyncType.LOCK_ALL
+
+    def unlock_all(self) -> None:
+        self._check_alive()
+        if self._sync != SyncType.LOCK_ALL:
+            raise RMASyncError(
+                f"{self.name}: unlock_all without lock_all")
+        self._sync = SyncType.LOCK
+        for r in list(self._locks):
+            self.unlock(r)
+        self._sync = SyncType.NONE
 
     def flush(self, target: Optional[int] = None) -> None:
         self._check_alive()

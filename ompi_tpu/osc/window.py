@@ -92,6 +92,11 @@ class Window(HasErrhandler):
     def block_shape(self):
         return self._array.shape[1:]
 
+    def _set_array(self, arr) -> None:
+        """Replace the window contents wholesale (SHMEM collectives);
+        keeps the rank-major sharding."""
+        self._array = self.comm.put_rank_major(arr)
+
     def _check_alive(self):
         if self._freed:
             raise WinError(f"{self.name} has been freed")

@@ -21,7 +21,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from ..core.errors import ArgumentError
-from ..osc.window import LOCK_SHARED, Window
+from ..osc.window import LOCK_SHARED, Window, create_window
 
 
 class SymmetricArray:
@@ -41,7 +41,10 @@ class SymmetricArray:
         return self._win.block_shape
 
     def local(self, pe: int):
-        """PE pe's block (SHMEM local address view)."""
+        """PE pe's block (SHMEM local address view). On spanning
+        comms only this controller's PEs have a local view."""
+        if hasattr(self._win, "_local_idx_or_raise"):
+            return self._win.array[self._win._local_idx_or_raise(pe)]
         return self._win.array[pe]
 
 
@@ -62,10 +65,16 @@ class ShmemContext:
         """shmem_malloc: collective; same block on every PE."""
         import jax.numpy as jnp
 
-        buf = jnp.full(
-            (self.comm.size,) + tuple(shape), fill, dtype
-        )
-        win = Window(self.comm, buf, name=f"shmem{len(self._heap)}")
+        from ..runtime.proc import spans_processes
+
+        n_blocks = self.comm.size
+        if spans_processes(self.comm):
+            # each controller allocates its LOCAL PEs' blocks; remote
+            # PEs are reached through the fabric window's RMA
+            n_blocks = sum(1 for p in self.comm.procs if p.is_local)
+        buf = jnp.full((n_blocks,) + tuple(shape), fill, dtype)
+        win = create_window(self.comm, buf,
+                            name=f"shmem{len(self._heap)}")
         # SHMEM has no epochs: keep a standing lock_all so one-sided ops
         # are always legal; fence/quiet flush it.
         win.lock_all()
@@ -136,7 +145,8 @@ class ShmemContext:
 
     def broadcast(self, sym: SymmetricArray, root: int) -> None:
         self.quiet(sym)
-        sym._win._array = self.comm.bcast(sym._win.array, root=root)
+        sym._win._set_array(self.comm.bcast(sym._win.array,
+                                            root=root))
 
     def collect(self, sym: SymmetricArray):
         """fcollect: concatenation of every PE's block, everywhere."""
@@ -146,7 +156,7 @@ class ShmemContext:
     def reduce_all(self, sym: SymmetricArray, op="sum") -> None:
         """to_all reduction: every PE's block becomes the reduction."""
         self.quiet(sym)
-        sym._win._array = self.comm.allreduce(sym._win.array, op)
+        sym._win._set_array(self.comm.allreduce(sym._win.array, op))
 
 
 def init(comm=None) -> ShmemContext:

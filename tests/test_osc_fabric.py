@@ -11,6 +11,8 @@ import textwrap
 
 import pytest
 
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
 from ompi_tpu.native import build
 
 pytestmark = pytest.mark.skipif(
@@ -147,7 +149,7 @@ def test_two_process_window_put_fence_get():
             [sys.executable, "-c", _WORKER, str(pid), str(nprocs),
              coord],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd="/root/repo",
+            env=env, cwd=_REPO,
         )
         for pid in range(nprocs)
     ]
@@ -174,3 +176,90 @@ def test_rma_index_encoding_roundtrip():
                 (2, slice(0, 4, None))):
         enc = _enc_index(idx)
         assert _dec_index(enc) == idx
+
+
+_SHMEM_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1]); nprocs = int(sys.argv[2]); coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.pgas import shmem
+    from ompi_tpu.pml import fabric
+
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=nprocs, process_id=pid,
+                               local_device_ids=[0, 1])
+    world = ompi_tpu.init()   # PEs 0,1 on p0; 2,3 on p1
+    fabric.wire_up()
+
+    ctx = shmem.ShmemContext(world)
+    sym = ctx.malloc((4,), "float32", fill=0)
+
+    if pid == 0:
+        # put into a REMOTE PE's symmetric block + atomic on it
+        ctx.put(sym, np.full(4, 5.0, np.float32), pe=2)
+        ctx.atomic_add(sym, np.full(4, 2.0, np.float32), pe=2)
+        got = np.asarray(ctx.get(sym, pe=2))
+        assert np.allclose(got, 7.0), got
+        world.rank(0).send(np.float32(1), dest=2, tag=600)
+    else:
+        world.rank(2).recv(source=0, tag=600)  # pumps -> ops applied
+        local = np.asarray(sym._win.array)
+        assert np.allclose(local[0], 7.0), local
+    world.barrier()
+
+    # SHMEM collectives over the spanning comm (scoll/mpi pattern):
+    # reduce_all folds every PE's block in place, locally rank-major
+    sym2 = ctx.malloc((2,), "float32", fill=float(pid + 1))
+    ctx.reduce_all(sym2, op="sum")
+    vals = np.asarray(sym2._win.array)
+    assert np.allclose(vals, 2 * (1.0 + 2.0)), vals  # 2 PEs per proc
+    # local() maps global PEs to this controller's blocks; remote raises
+    mine = (0, 1) if pid == 0 else (2, 3)
+    assert np.allclose(np.asarray(sym2.local(mine[0])), 6.0)
+    try:
+        sym2.local(2 if pid == 0 else 0)
+        raise SystemExit("expected WinError for remote PE")
+    except Exception as exc:
+        assert "another controller" in str(exc), exc
+    ctx.free(sym2)
+
+    world.barrier()
+    ctx.free(sym)
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def test_two_process_shmem_symmetric_heap():
+    """OSHMEM across controllers: the symmetric heap rides the fabric
+    window (reference: oshmem memheap + spml over the network)."""
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _SHMEM_WORKER, str(pid),
+             str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd=_REPO,
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "OK" in out

@@ -446,7 +446,7 @@ def test_tuned_rules_select_pallas_rd(comm, tmp_path):
         config.set("coll_select", "")
 
 
-def test_rabenseifner_composition_matches_oracle(mesh):
+def test_rsag_composition_matches_oracle(mesh):
     """pallas_rsag = ring reduce-scatter + ring allgather composed
     (the standalone kernels as a TP-style pipeline pair)."""
     n = 8
