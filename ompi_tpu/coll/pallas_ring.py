@@ -44,7 +44,8 @@ from ..ops.op import Op
 __all__ = [
     "ring_allgather", "ring_reduce_scatter", "ring_allreduce",
     "ring_allreduce_bidir", "ring_allreduce_chunked", "ring_allreduce_rd",
-    "tree_bcast", "tree_reduce", "ppermute_shift",
+    "tree_bcast", "tree_reduce", "linear_gather", "linear_scatter",
+    "ppermute_shift",
 ]
 
 _interpret_var = config.register(
@@ -1011,6 +1012,163 @@ def ring_alltoall(x: jax.Array, axis_name: str) -> jax.Array:
     return out.reshape((n,) + shape)
 
 
+def _gather_kernel(axis_name: str, n: int, root: int, x_ref, out_ref,
+                   send_sems, recv_sems, ready_sem):
+    """Linear gather-to-root (reference: coll_base_gather.c,
+    ompi_coll_base_gather_intra_basic_linear): every non-root rank
+    remote-DMAs its block into root's out[me]; root initializes its own
+    row, grants a readiness credit to each sender (its out buffer is
+    live), then parks on one recv semaphore per sender. Distinct
+    semaphore slots per sender — the writers are unordered peers, so a
+    shared slot could let one fast sender satisfy another's wait (same
+    reasoning as the pairwise alltoall kernel)."""
+    me = jax.lax.axis_index(axis_name)
+    rel = jax.lax.rem(me - root + n, n)
+
+    @pl.when(rel == 0)
+    def _root():
+        out_ref[me] = x_ref[:]
+        for s in range(1, n):
+            pltpu.semaphore_signal(
+                ready_sem, inc=1, device_id=jax.lax.rem(root + s, n),
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+        for s in range(1, n):
+            src_dev = jax.lax.rem(root + s, n)
+            pltpu.make_async_remote_copy(
+                src_ref=x_ref, dst_ref=out_ref.at[src_dev],
+                send_sem=send_sems.at[s - 1],
+                recv_sem=recv_sems.at[s - 1],
+                device_id=src_dev,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            ).wait_recv()
+
+    @pl.when(rel != 0)
+    def _sender():
+        pltpu.semaphore_wait(ready_sem, 1)
+        # slot rel-1 matches the descriptor root waits on
+        for s in range(1, n):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref, dst_ref=out_ref.at[me],
+                send_sem=send_sems.at[s - 1],
+                recv_sem=recv_sems.at[s - 1],
+                device_id=root,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+            @pl.when(rel == s)
+            def _go(rdma=rdma):
+                rdma.start()
+                rdma.wait_send()
+
+
+def linear_gather(x: jax.Array, axis_name: str, root: int = 0
+                  ) -> jax.Array:
+    """Inside shard_map: local block (chunk,) -> (n, chunk), rows
+    defined at root only (MPI gather semantics)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x[None]
+    flat, pad, shape = _pad_chunk(x)
+    kernel = functools.partial(_gather_kernel, axis_name, n, int(root))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((n, flat.size), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=10,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape((n,) + shape)
+
+
+def _scatter_kernel(axis_name: str, n: int, root: int, x_ref, out_ref,
+                    send_sems, recv_sems):
+    """Linear scatter-from-root (reference: coll_base_scatter.c,
+    ompi_coll_base_scatter_intra_basic_linear): root pushes row s of its
+    buffer into rank (root+s)'s out. No readiness handshake needed —
+    receivers never write their landing buffer, they only read it after
+    the recv semaphore fires, so an early-landing DMA is harmless."""
+    me = jax.lax.axis_index(axis_name)
+    rel = jax.lax.rem(me - root + n, n)
+
+    @pl.when(rel == 0)
+    def _root():
+        out_ref[:] = x_ref[me]
+        rdmas = []
+        for s in range(1, n):
+            dst_dev = jax.lax.rem(root + s, n)
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[dst_dev], dst_ref=out_ref,
+                send_sem=send_sems.at[s - 1],
+                recv_sem=recv_sems.at[s - 1],
+                device_id=dst_dev,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+            rdma.start()
+            rdmas.append(rdma)
+        for rdma in rdmas:
+            rdma.wait_send()
+
+    @pl.when(rel != 0)
+    def _receiver():
+        for s in range(1, n):
+            rdma = pltpu.make_async_remote_copy(
+                src_ref=x_ref.at[me], dst_ref=out_ref,
+                send_sem=send_sems.at[s - 1],
+                recv_sem=recv_sems.at[s - 1],
+                device_id=root,
+                device_id_type=pltpu.DeviceIdType.LOGICAL,
+            )
+
+            @pl.when(rel == s)
+            def _take(rdma=rdma):
+                rdma.wait_recv()
+
+
+def linear_scatter(x: jax.Array, axis_name: str, root: int = 0
+                   ) -> jax.Array:
+    """Inside shard_map: (n, chunk) buffer (significant at root) ->
+    own block (chunk,)."""
+    n = jax.lax.axis_size(axis_name)
+    if n == 1:
+        return x[0]
+    shape = x.shape[1:]
+    flat = x.reshape(n, -1)
+    pad = (-flat.shape[1]) % 128
+    if pad:
+        flat = jnp.pad(flat, ((0, 0), (0, pad)))
+    kernel = functools.partial(_scatter_kernel, axis_name, n, int(root))
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((flat.shape[1],), flat.dtype,
+                                       vma=frozenset({axis_name})),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.SemaphoreType.DMA((n - 1,)),
+            pltpu.SemaphoreType.DMA((n - 1,)),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=11,
+        ),
+        interpret=_interpret(),
+    )(flat)
+    if pad:
+        out = out[:-pad]
+    return out.reshape(shape)
+
+
 def ppermute_shift(x: jax.Array, axis_name: str, shift: int = 1
                    ) -> jax.Array:
     """One ring hop as a Pallas remote DMA — the building block for
@@ -1140,6 +1298,20 @@ def bcast_block(b: jax.Array, axis_name: str, root: int = 0
     return tree_bcast(b, axis_name, root=root)
 
 
+def gather_block(b: jax.Array, axis_name: str, root: int = 0
+                 ) -> jax.Array:
+    """shard_map body: own block -> (n, ...) gathered rows (defined at
+    root), linear gather over ICI DMA."""
+    return linear_gather(b, axis_name, root=root)
+
+
+def scatter_block(b: jax.Array, axis_name: str, root: int = 0
+                  ) -> jax.Array:
+    """shard_map body: (n, ...) buffer (significant at root) -> own
+    block, linear scatter over ICI DMA."""
+    return linear_scatter(b, axis_name, root=root)
+
+
 @COLL.register
 class PallasColl(CollComponent):
     NAME = "pallas"
@@ -1231,6 +1403,43 @@ class PallasColl(CollComponent):
             check_vma=False,
         )
         return plan(x)
+
+    def gather(self, comm, x, root):
+        """Linear gather over ICI DMA; rows defined at root
+        (reference: coll_base_gather.c basic_linear)."""
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x[:, None][root]
+        key = ("gather", "pallas", root, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: gather_block(b, "ranks", root=root),
+            check_vma=False,
+        )
+        return plan(x)[root]
+
+    def scatter(self, comm, x, root):
+        """Linear scatter over ICI DMA (reference: coll_base_scatter.c
+        basic_linear). Root's (size, ...) buffer is staged rank-major
+        (replicated rows) so the kernel sees it on-device."""
+        from ..core.errors import ArgumentError
+
+        arr = jnp.asarray(x)
+        if arr.shape[0] != comm.size:
+            raise ArgumentError(
+                f"scatter needs (size, ...) buffer, got {arr.shape}"
+            )
+        if comm.size == 1:
+            # rank-major (1,)+row result, matching XlaColl/TunedColl
+            return comm.put_rank_major(arr)
+        stacked = comm.put_rank_major(
+            jnp.broadcast_to(arr[None], (comm.size,) + arr.shape)
+        )
+        key = ("scatter", "pallas", root, stacked.shape, str(stacked.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: scatter_block(b, "ranks", root=root),
+            check_vma=False,
+        )
+        return plan(stacked)
 
     def alltoall(self, comm, x):
         x = rank_major_check(comm, x, min_ndim=2)

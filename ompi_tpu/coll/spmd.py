@@ -546,6 +546,140 @@ def scatter_native(x: jax.Array, axis_name: str, root: int = 0) -> jax.Array:
     return jnp.take(rooted, rank, axis=0)
 
 
+def _pow2_rows(n: int) -> int:
+    return 1 << (n - 1).bit_length() if n > 1 else 1
+
+
+def gather_binomial(x: jax.Array, axis_name: str, root: int = 0
+                    ) -> jax.Array:
+    """Binomial-tree gather to root: round k has the (root-relative)
+    ranks whose lowest set bit is 2^k forward their accumulated 2^k-row
+    subtree block to vrank-2^k — total traffic matches MPI's binomial
+    gather (each round moves statically-sized 2^k-row slabs, placed with
+    dynamic offsets), unlike an allgather which moves n rows everywhere.
+
+    Reference: coll_base_gather.c (ompi_coll_base_gather_intra_binomial).
+    Result rows are defined only at root (MPI semantics); output is in
+    rank order."""
+    n = _size(axis_name)
+    if n == 1:
+        return x[None]
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+    np2 = _pow2_rows(n)
+    # Accumulator in vrank space, padded to a power of two so every
+    # subtree slab [vr, vr + 2^k) is in bounds.
+    out = jnp.zeros((np2,) + x.shape, x.dtype)
+    zeros = (0,) * x.ndim
+    out = lax.dynamic_update_slice(out, x[None], (vrank,) + zeros)
+    for k in range((n - 1).bit_length()):
+        blk = 1 << k
+        # Senders this round: vranks that are odd multiples of 2^k.
+        perm = []
+        for vr in range(blk, n, 2 * blk):
+            perm.append(((vr + root) % n, (vr - blk + root) % n))
+        payload = lax.dynamic_slice(
+            out, (vrank,) + zeros, (blk,) + x.shape
+        )
+        recvd = lax.ppermute(payload, axis_name, perm)
+        receives = (vrank % (2 * blk) == 0) & (vrank + blk < n)
+        merged = lax.dynamic_update_slice(
+            out, recvd, (vrank + blk,) + zeros
+        )
+        out = jnp.where(receives, merged, out)
+    # vrank-space row j holds rank ((j + root) % n)'s block.
+    idx = (jnp.arange(n) - root) % n
+    return jnp.take(out, idx, axis=0)
+
+
+def scatter_binomial(x: jax.Array, axis_name: str, root: int = 0
+                     ) -> jax.Array:
+    """Binomial-tree scatter from root — the gather tree run in reverse:
+    rounds go from the widest slab down; in round k every current
+    holder of a 2^(k+1)-row slab forwards its upper 2^k rows to
+    vrank+2^k. Per-round traffic is the statically-sized slab.
+
+    Reference: coll_base_scatter.c (ompi_coll_base_scatter_intra_binomial).
+    Input (n, ...) significant at root; every rank returns its row."""
+    n = _size(axis_name)
+    if n == 1:
+        return x[0]
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+    np2 = _pow2_rows(n)
+    zeros = (0,) * (x.ndim - 1)
+    # Rotate root's buffer into vrank space and pad to a power of two.
+    idx = (jnp.arange(np2) + root) % n  # row j <- rank (j+root)%n's data
+    buf = jnp.take(x, idx, axis=0)
+    for k in reversed(range((n - 1).bit_length())):
+        blk = 1 << k
+        perm = []
+        for vr in range(0, n, 2 * blk):
+            if vr + blk < n:
+                perm.append(((vr + root) % n, (vr + blk + root) % n))
+        # A holder at this level sits at a multiple of 2^(k+1); its
+        # outgoing slab is rows [vrank + blk, vrank + 2*blk).
+        send_lo = jnp.minimum(vrank + blk, np2 - blk)
+        payload = lax.dynamic_slice(
+            buf, (send_lo,) + zeros, (blk,) + x.shape[1:]
+        )
+        recvd = lax.ppermute(payload, axis_name, perm)
+        receives = vrank % (2 * blk) == blk
+        merged = lax.dynamic_update_slice(buf, recvd, (vrank,) + zeros)
+        buf = jnp.where(receives, merged, buf)
+    return lax.dynamic_slice(
+        buf, (vrank,) + zeros, (1,) + x.shape[1:]
+    )[0]
+
+
+def reduce_scatter_recursive_halving(
+    x: jax.Array, axis_name: str, op: Op
+) -> jax.Array:
+    """Recursive-halving reduce-scatter (power-of-two ranks): log2(n)
+    rounds; round k exchanges half the active window with the partner
+    at distance n/2^(k+1) and folds the received half — each round's
+    payload is a statically-sized slab at a rank-dependent offset.
+
+    Reference: coll_base_reduce_scatter.c
+    (ompi_coll_base_reduce_scatter_intra_basic_recursivehalving).
+    Non-power-of-two or non-commutative inputs fall back to the ring."""
+    n = _size(axis_name)
+    if x.shape[0] != n:
+        raise ArgumentError(
+            f"reduce_scatter input leading dim {x.shape[0]} != ranks {n}"
+        )
+    if n == 1:
+        return x[0]
+    if n & (n - 1) or not op.commutative or _op_mod._is_joint(op):
+        return reduce_scatter_ring(x, axis_name, op)
+    rank = _rank(axis_name)
+    zeros = (0,) * (x.ndim - 1)
+    buf = x
+    lo = jnp.zeros((), jnp.int32)  # active window start (length n>>k)
+    half = n // 2
+    while half >= 1:
+        partner_dist = half
+        partner = rank ^ partner_dist
+        # Keep the half containing our own row; send the other half.
+        keep_upper = (rank & partner_dist) != 0
+        send_lo = jnp.where(keep_upper, lo, lo + half)
+        keep_lo = jnp.where(keep_upper, lo + half, lo)
+        payload = lax.dynamic_slice(
+            buf, (send_lo,) + zeros, (half,) + x.shape[1:]
+        )
+        perm = [(i, i ^ partner_dist) for i in range(n)]
+        recvd = lax.ppermute(payload, axis_name, perm)
+        kept = lax.dynamic_slice(
+            buf, (keep_lo,) + zeros, (half,) + x.shape[1:]
+        )
+        buf = lax.dynamic_update_slice(
+            buf, op.combine(kept, recvd), (keep_lo,) + zeros
+        )
+        lo = keep_lo
+        half //= 2
+    return lax.dynamic_slice(buf, (lo,) + zeros, (1,) + x.shape[1:])[0]
+
+
 def scan_native(x: jax.Array, axis_name: str, op: Op) -> jax.Array:
     """Inclusive prefix reduction over ranks.
 

@@ -61,6 +61,21 @@ _force_allgather = _V("allgather_algorithm", type=str, default="",
                       description="Force an allgather algorithm by name")
 _force_bcast = _V("bcast_algorithm", type=str, default="",
                   description="Force a bcast algorithm by name")
+_force_reduce = _V("reduce_algorithm", type=str, default="",
+                   description="Force a reduce algorithm by name")
+_force_reduce_scatter = _V("reduce_scatter_algorithm", type=str, default="",
+                           description="Force a reduce_scatter algorithm "
+                                       "by name")
+_force_gather = _V("gather_algorithm", type=str, default="",
+                   description="Force a gather algorithm by name")
+_force_scatter = _V("scatter_algorithm", type=str, default="",
+                    description="Force a scatter algorithm by name")
+_gather_binomial_max = _V("gather_binomial_max_bytes", type=int,
+                          default=6 << 10,
+                          description="Gather: per-rank bytes below which "
+                                      "the binomial tree is used "
+                                      "(reference: small-block binomial, "
+                                      "coll_tuned_decision_fixed.c)")
 _alltoall_small = _V("alltoall_small_msg", type=int, default=256,
                      description="Alltoall: bytes/dest below which bruck "
                                  "is used")
@@ -104,6 +119,10 @@ def _pallas_algos() -> None:
     ALLREDUCE_ALGOS["pallas_rsag"] = pr.allreduce_block_rsag
     BCAST_ALGOS["pallas_binomial"] = pr.bcast_block
     ALLGATHER_ALGOS["pallas_ring"] = pr.ring_allgather
+    REDUCE_ALGOS["pallas_tree"] = pr.reduce_block
+    REDUCE_SCATTER_ALGOS["pallas_ring"] = pr.ring_reduce_scatter
+    GATHER_ALGOS["pallas_linear"] = pr.gather_block
+    SCATTER_ALGOS["pallas_linear"] = pr.scatter_block
 
 
 def is_pallas_algo(name: str) -> bool:
@@ -124,6 +143,27 @@ ALLTOALL_ALGOS: dict[str, Callable] = {
 BCAST_ALGOS: dict[str, Callable] = {
     "native": spmd.bcast_native,
     "binomial": spmd.bcast_binomial,
+}
+
+REDUCE_ALGOS: dict[str, Callable] = {
+    "native": spmd.reduce_native,
+    "binomial": spmd.reduce_binomial,
+}
+
+REDUCE_SCATTER_ALGOS: dict[str, Callable] = {
+    "native": spmd.reduce_scatter_native,
+    "ring": spmd.reduce_scatter_ring,
+    "recursive_halving": spmd.reduce_scatter_recursive_halving,
+}
+
+GATHER_ALGOS: dict[str, Callable] = {
+    "native": spmd.gather_native,
+    "binomial": spmd.gather_binomial,
+}
+
+SCATTER_ALGOS: dict[str, Callable] = {
+    "native": spmd.scatter_native,
+    "binomial": spmd.scatter_binomial,
 }
 
 
@@ -238,6 +278,74 @@ def decide_bcast(nbytes: int, nranks: int) -> str:
     return "native"
 
 
+def decide_reduce(op: Op, nbytes: int, nranks: int) -> str:
+    """Reference: coll_tuned_reduce_decision / decision_fixed — binomial
+    for small messages, pipelined chains above; non-commutative ops take
+    the ordered path. Here 'native' (the XLA allreduce + root slice) is
+    the large-message answer: XLA already emits the ICI-optimal
+    schedule."""
+    forced = _force_reduce.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("reduce", nbytes, nranks)
+        if got:
+            return got
+    if not op.commutative or _is_joint(op):
+        return "native"  # ordered handling lives in the algo fallback
+    if _prefer_native.value and op.xla_reduce is not None:
+        return "native"
+    if nbytes < _small.value:
+        return "binomial"
+    return "native"
+
+
+def decide_reduce_scatter(op: Op, nbytes: int, nranks: int) -> str:
+    """Reference: coll_base_reduce_scatter.c decision — recursive
+    halving for small commutative power-of-two cases, ring for large."""
+    forced = _force_reduce_scatter.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("reduce_scatter", nbytes, nranks)
+        if got:
+            return got
+    if _prefer_native.value and op.xla_reduce is not None:
+        return "native"
+    pof2 = nranks & (nranks - 1) == 0
+    if op.commutative and pof2 and nbytes < _small.value:
+        return "recursive_halving"
+    return "ring"
+
+
+def decide_gather(nbytes: int, nranks: int) -> str:
+    forced = _force_gather.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("gather", nbytes, nranks)
+        if got:
+            return got
+    if nbytes < _gather_binomial_max.value and nranks >= 4:
+        return "binomial"
+    return "native"
+
+
+def decide_scatter(nbytes: int, nranks: int) -> str:
+    forced = _force_scatter.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("scatter", nbytes, nranks)
+        if got:
+            return got
+    return "native"
+
+
 @COLL.register
 class TunedColl(XlaColl):
     """Decision layer over the full algorithm space. Inherits the
@@ -331,3 +439,119 @@ class TunedColl(XlaColl):
         plan = compile_plan(comm, key, lambda b: fn(b, "ranks", root=root),
                             check_vma=not is_pallas_algo(algo))
         return plan(x)
+
+    def reduce(self, comm, x, op, root):
+        op = op_lookup(op)
+        if comm.size == 1:
+            return super().reduce(comm, x, op, root)
+        algo = decide_reduce(op, _nbytes(x), comm.size)
+        is_plain_array = hasattr(x, "dtype") and hasattr(x, "shape")
+        if algo == "native" or not is_plain_array:
+            return super().reduce(comm, x, op, root)
+        if is_pallas_algo(algo):
+            _pallas_algos()
+        fn = REDUCE_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown reduce algorithm {algo!r}; known: "
+                f"{sorted(REDUCE_ALGOS)}"
+            )
+        x = rank_major_check(comm, x)
+        from ..core.counters import SPC
+
+        SPC.record(f"coll_reduce_algo_{algo}")
+        key = ("reduce", algo, op.cache_key, root, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: fn(b, "ranks", op, root=root),
+            check_vma=not is_pallas_algo(algo),
+        )
+        return plan(x)[root]
+
+    def reduce_scatter_block(self, comm, x, op):
+        op = op_lookup(op)
+        x = rank_major_check(comm, x, min_ndim=2)
+        if x.shape[1] != comm.size:
+            raise ArgumentError(
+                f"reduce_scatter_block needs (size, size, ...) buffer, "
+                f"got {x.shape}"
+            )
+        if comm.size == 1:
+            return x[:, 0]
+        per_rank = (x.size // (comm.size * comm.size)) * x.dtype.itemsize
+        algo = decide_reduce_scatter(op, per_rank, comm.size)
+        if algo == "native":
+            return super().reduce_scatter_block(comm, x, op)
+        if is_pallas_algo(algo):
+            _pallas_algos()
+        fn = REDUCE_SCATTER_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown reduce_scatter algorithm {algo!r}; known: "
+                f"{sorted(REDUCE_SCATTER_ALGOS)}"
+            )
+        from ..core.counters import SPC
+
+        SPC.record(f"coll_reduce_scatter_algo_{algo}")
+        key = ("reduce_scatter_block", algo, op.cache_key, x.shape,
+               str(x.dtype))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks", op),
+                            check_vma=not is_pallas_algo(algo))
+        return plan(x)
+
+    def gather(self, comm, x, root):
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return x[:, None][root]
+        algo = decide_gather(_nbytes(x), comm.size)
+        if algo == "native":
+            return super().gather(comm, x, root)
+        if is_pallas_algo(algo):
+            _pallas_algos()
+        fn = GATHER_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown gather algorithm {algo!r}; known: "
+                f"{sorted(GATHER_ALGOS)}"
+            )
+        from ..core.counters import SPC
+
+        SPC.record(f"coll_gather_algo_{algo}")
+        key = ("gather", algo, root, x.shape, str(x.dtype))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks", root=root),
+                            check_vma=not is_pallas_algo(algo))
+        return plan(x)[root]
+
+    def scatter(self, comm, x, root):
+        arr = jnp.asarray(x)
+        if arr.shape[0] != comm.size:
+            raise ArgumentError(
+                f"scatter needs (size, ...) buffer, got {arr.shape}"
+            )
+        if comm.size == 1:
+            return comm.put_rank_major(arr)
+        algo = decide_scatter(
+            (arr.size // comm.size) * arr.dtype.itemsize, comm.size
+        )
+        if algo == "native":
+            return super().scatter(comm, x, root)
+        if is_pallas_algo(algo):
+            _pallas_algos()
+        fn = SCATTER_ALGOS.get(algo)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown scatter algorithm {algo!r}; known: "
+                f"{sorted(SCATTER_ALGOS)}"
+            )
+        from ..core.counters import SPC
+
+        SPC.record(f"coll_scatter_algo_{algo}")
+        # Algorithm-form scatter runs inside the mesh: stage root's
+        # buffer as replicated rank-major rows so the traced tree sees
+        # it on-device (only root's copy is semantically significant).
+        stacked = comm.put_rank_major(
+            jnp.broadcast_to(arr[None], (comm.size,) + arr.shape)
+        )
+        key = ("scatter", algo, root, stacked.shape, str(stacked.dtype))
+        plan = compile_plan(comm, key, lambda b: fn(b, "ranks", root=root),
+                            check_vma=not is_pallas_algo(algo))
+        return plan(stacked)

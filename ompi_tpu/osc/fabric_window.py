@@ -153,6 +153,7 @@ class FabricWindow:
         self._inner._set_array(arr)
 
     def _local_idx_or_raise(self, pe: int) -> int:
+        pe = self.comm.check_rank(pe)
         if self.h.rank_slice[pe] != self.h.slice_id:
             raise WinError(
                 f"{self.name}: PE {pe} lives on another controller; "

@@ -51,18 +51,29 @@ def sweep_op(comm, opname: str, algos: dict, min_bytes: int,
     size = min_bytes
     while size <= max_bytes:
         elems = max(1, size // 4)
-        if opname == "alltoall":
-            data = np.ones((n, n, max(1, elems // n)), np.float32)
+        if opname in ("alltoall", "reduce_scatter", "scatter"):
+            # Per-destination layout (ranks, dests/rows, chunk). The
+            # decide_* functions for these ops consult rules with the
+            # PER-CHUNK byte count, so the emitted band must be keyed
+            # by the chunk size actually measured — not the total —
+            # or the rules would select winners measured at n-times-
+            # larger messages.
+            chunk = max(1, elems // n)
+            data = np.ones((n, n, chunk), np.float32)
+            band = chunk * 4
         else:
             data = np.ones((n, elems), np.float32)
+            band = size
         x = comm.put_rank_major(data)
         times = {}
         for name, fn in algos.items():
             key = ("tune", opname, name, x.shape, str(x.dtype))
             try:
-                if opname in ("allreduce",):
+                if opname in ("allreduce", "reduce_scatter"):
                     per_rank = lambda b, f=fn: f(b, "ranks", op)
-                elif opname == "bcast":
+                elif opname == "reduce":
+                    per_rank = lambda b, f=fn: f(b, "ranks", op, root=0)
+                elif opname in ("bcast", "gather", "scatter"):
                     per_rank = lambda b, f=fn: f(b, "ranks", root=0)
                 else:
                     per_rank = lambda b, f=fn: f(b, "ranks")
@@ -74,7 +85,7 @@ def sweep_op(comm, opname: str, algos: dict, min_bytes: int,
                 continue  # algorithm invalid for this shape/rank count
         if times:
             best = min(times, key=times.get)
-            winners.append((size, best, times))
+            winners.append((band, best, times))
         size *= 4
     # collapse consecutive same-winner bands into max_bytes rules
     rules: list[dict] = []
@@ -95,6 +106,10 @@ def tune(comm, ops=None, min_bytes: int = 256,
         ALLREDUCE_ALGOS,
         ALLTOALL_ALGOS,
         BCAST_ALGOS,
+        GATHER_ALGOS,
+        REDUCE_ALGOS,
+        REDUCE_SCATTER_ALGOS,
+        SCATTER_ALGOS,
         _pallas_algos,
     )
 
@@ -107,6 +122,10 @@ def tune(comm, ops=None, min_bytes: int = 256,
         "allgather": ALLGATHER_ALGOS,
         "alltoall": ALLTOALL_ALGOS,
         "bcast": BCAST_ALGOS,
+        "reduce": REDUCE_ALGOS,
+        "reduce_scatter": REDUCE_SCATTER_ALGOS,
+        "gather": GATHER_ALGOS,
+        "scatter": SCATTER_ALGOS,
     }
     ops = ops or list(spaces)
     out = {}
@@ -120,7 +139,8 @@ def tune(comm, ops=None, min_bytes: int = 256,
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ompi_tpu.tools.tune")
     ap.add_argument("--out", required=True)
-    ap.add_argument("--ops", default="allreduce,allgather,alltoall,bcast")
+    ap.add_argument("--ops", default="allreduce,allgather,alltoall,bcast,"
+                                     "reduce,reduce_scatter,gather,scatter")
     ap.add_argument("--min-bytes", type=int, default=256)
     ap.add_argument("--max-bytes", type=int, default=1 << 20)
     ap.add_argument("--iters", type=int, default=5)

@@ -256,6 +256,56 @@ def test_scatter_gather_roundtrip():
         np.testing.assert_allclose(got[r], vals[root], rtol=1e-6)
 
 
+@pytest.mark.parametrize("root", [0, 3])
+@pytest.mark.parametrize("n", [8, 6, 5])
+def test_gather_binomial(root, n):
+    vals = rank_values(n, shape=(3,), seed=41)
+    outs = run_spmd(
+        lambda x: spmd.gather_binomial(x, "ranks", root=root), vals, n=n
+    )
+    got = np.concatenate(outs).reshape(n, n, 3)
+    # Only root's rows are defined (MPI gather semantics).
+    np.testing.assert_allclose(got[root], np.stack(vals), rtol=1e-6)
+
+
+@pytest.mark.parametrize("root", [0, 3])
+@pytest.mark.parametrize("n", [8, 6, 5])
+def test_scatter_binomial(root, n):
+    vals = [v.reshape(n, 2) for v in rank_values(n, shape=(n * 2,), seed=43)]
+    outs = run_spmd(
+        lambda x: spmd.scatter_binomial(x, "ranks", root=root), vals, n=n
+    )
+    got = np.concatenate(outs).reshape(n, 2)
+    # Every rank receives its row of ROOT's buffer.
+    np.testing.assert_allclose(got, vals[root], rtol=1e-6)
+
+
+@pytest.mark.parametrize("n", [8, 4, 5])
+def test_reduce_scatter_recursive_halving(n):
+    vals = [v.reshape(n, 4) for v in rank_values(n, shape=(n * 4,), seed=47)]
+    expected = np.sum(vals, axis=0)  # (n, 4); rank i gets row i
+    outs = run_spmd(
+        lambda x: spmd.reduce_scatter_recursive_halving(x, "ranks", ops.SUM),
+        vals, n=n,
+    )
+    got = np.concatenate(outs).reshape(n, 4)
+    np.testing.assert_allclose(got, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_binomial_scatter_gather_roundtrip():
+    n = 8
+    root = 5
+    vals = [v.reshape(n, 3) for v in rank_values(n, shape=(n * 3,), seed=53)]
+
+    def fn(x):
+        mine = spmd.scatter_binomial(x, "ranks", root=root)
+        return spmd.gather_binomial(mine, "ranks", root=root)
+
+    outs = run_spmd(fn, vals, n=n)
+    got = np.concatenate(outs).reshape(n, n, 3)
+    np.testing.assert_allclose(got[root], vals[root], rtol=1e-6)
+
+
 def test_barrier():
     outs = run_spmd(lambda x: spmd.barrier("ranks") + 0 * x[0].astype(jnp.int32),
                     rank_values(8, shape=(1,)))

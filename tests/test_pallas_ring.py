@@ -485,3 +485,106 @@ def test_tuned_rules_select_pallas_rsag(comm, tmp_path):
         config.set("coll_tuned_rules_file", "")
         config.set("coll_tuned_prefer_native", True)
         config.set("coll_select", "")
+
+
+# -- linear gather/scatter kernels (reference: coll_base_{gather,
+#    scatter}.c basic_linear) ------------------------------------------------
+
+
+def test_linear_gather_lands_at_root(mesh):
+    n = 8
+    contrib = np.random.default_rng(31).standard_normal(
+        (n, 19)).astype(np.float32)
+    for root in (0, 5):
+        f = shard_map(
+            lambda x: pr.linear_gather(x[0], "x", root=root)[None],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+            check_vma=False,
+        )
+        out = np.asarray(jax.jit(f)(jnp.asarray(contrib)))
+        # out[r] = rank r's (n, 19) view; root's rows are the gather
+        np.testing.assert_allclose(out[root], contrib, rtol=1e-6)
+
+
+def test_linear_scatter_delivers_rows(mesh):
+    n = 8
+    buf = np.random.default_rng(32).standard_normal(
+        (n, 21)).astype(np.float32)
+    for root in (0, 3):
+        # every rank feeds the same (n, 21) buffer (significant at root)
+        stacked = np.broadcast_to(buf, (n, n, 21)).copy()
+        f = shard_map(
+            lambda x: pr.linear_scatter(x[0], "x", root=root)[None],
+            mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+            check_vma=False,
+        )
+        out = np.asarray(jax.jit(f)(jnp.asarray(stacked)))
+        np.testing.assert_allclose(out, buf, rtol=1e-6)
+
+
+def test_pallas_component_gather_scatter(comm):
+    from ompi_tpu.core import config
+
+    config.set("coll_select", "pallas,xla,basic")
+    config.set("coll_pallas_priority", 100)
+    try:
+        c = comm.dup()
+        rng = np.random.default_rng(33)
+        data = rng.standard_normal((c.size, 9)).astype(np.float32)
+        out = np.asarray(c.gather(c.put_rank_major(data), root=1))
+        np.testing.assert_allclose(out, data, rtol=1e-6)
+        assert any(k[0] == "gather" and "pallas" in k
+                   for k in c._plan_cache)
+
+        buf = rng.standard_normal((c.size, 7)).astype(np.float32)
+        out = np.asarray(c.scatter(buf, root=2))
+        np.testing.assert_allclose(out, buf, rtol=1e-6)
+        assert any(k[0] == "scatter" and "pallas" in k
+                   for k in c._plan_cache)
+    finally:
+        config.set("coll_select", "")
+        config.set("coll_pallas_priority", 30)
+
+
+def test_tuned_reduce_scatter_gather_decisions(comm):
+    """tuned's new decision spaces: forced algorithms for reduce,
+    reduce_scatter, gather and scatter dispatch through the named
+    algorithm (SPC-asserted) and stay correct."""
+    from ompi_tpu.core import config
+    from ompi_tpu.core.counters import SPC
+
+    c = comm.dup()
+    rng = np.random.default_rng(34)
+    cases = [
+        ("coll_tuned_reduce_algorithm", "binomial",
+         "coll_reduce_algo_binomial",
+         lambda: np.asarray(
+             c.reduce(c.put_rank_major(
+                 rng.standard_normal((c.size, 11)).astype(np.float32)),
+                 op="sum", root=0))),
+        ("coll_tuned_reduce_scatter_algorithm", "recursive_halving",
+         "coll_reduce_scatter_algo_recursive_halving",
+         lambda: np.asarray(
+             c.reduce_scatter_block(c.put_rank_major(
+                 rng.standard_normal(
+                     (c.size, c.size, 5)).astype(np.float32)), "sum"))),
+        ("coll_tuned_gather_algorithm", "binomial",
+         "coll_gather_algo_binomial",
+         lambda: np.asarray(
+             c.gather(c.put_rank_major(
+                 rng.standard_normal((c.size, 6)).astype(np.float32)),
+                 root=3))),
+        ("coll_tuned_scatter_algorithm", "binomial",
+         "coll_scatter_algo_binomial",
+         lambda: np.asarray(
+             c.scatter(rng.standard_normal(
+                 (c.size, 4)).astype(np.float32), root=1))),
+    ]
+    for var, algo, counter, call in cases:
+        config.set(var, algo)
+        try:
+            before = SPC.snapshot().get(counter, 0)
+            call()
+            assert SPC.snapshot().get(counter, 0) > before, counter
+        finally:
+            config.set(var, "")

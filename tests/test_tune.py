@@ -64,6 +64,32 @@ def test_tuned_consumes_generated_rules(tmp_path):
         config.set("coll_tuned_rules_file", "")
 
 
+def test_tune_new_decision_spaces():
+    """The sweep covers the reduce / reduce_scatter / gather / scatter
+    spaces added for parity with coll_tuned_*_decision.c, and winners
+    come from the registered algorithm sets."""
+    from ompi_tpu.coll.tuned import (
+        GATHER_ALGOS, REDUCE_ALGOS, REDUCE_SCATTER_ALGOS, SCATTER_ALGOS,
+    )
+    from ompi_tpu.tools import tune
+
+    comm = mt.world()
+    rules = tune.tune(
+        comm, ops=["reduce", "reduce_scatter", "gather", "scatter"],
+        min_bytes=256, max_bytes=1024, iters=1,
+    )
+    spaces = {
+        "reduce": REDUCE_ALGOS,
+        "reduce_scatter": REDUCE_SCATTER_ALGOS,
+        "gather": GATHER_ALGOS,
+        "scatter": SCATTER_ALGOS,
+    }
+    for opname, space in spaces.items():
+        assert rules[opname], opname
+        for rule in rules[opname]:
+            assert rule["algorithm"] in space, (opname, rule)
+
+
 def test_tune_cli(tmp_path):
     from ompi_tpu.tools import tune
 
