@@ -231,19 +231,14 @@ def _fabric_loopback() -> dict:
             pid_ab = a.connect(b.address[0], b.address[1], cookie=1)
 
             def xfer(payload: bytes, iters: int) -> list:
+                # blocking receive: parks on the engine's completion
+                # condition variable (a busy-poller would steal the
+                # transport threads' cycles on small-core hosts)
                 times = []
                 for _ in range(iters):
                     t0 = time.perf_counter()
                     a.send_bytes(pid_ab, 1, payload)
-                    deadline = t0 + 10.0
-                    while True:
-                        got = b.poll_recv()
-                        if got is not None:
-                            break
-                        if time.perf_counter() > deadline:
-                            raise TimeoutError(
-                                "loopback frame lost (10s deadline)"
-                            )
+                    b.recv_bytes(10.0)
                     times.append(time.perf_counter() - t0)
                 return times
 
@@ -251,10 +246,15 @@ def _fabric_loopback() -> dict:
             small = xfer(b"x" * 64, 500)
             big_payload = b"x" * (4 << 20)
             big = xfer(big_payload, 20)
+            huge_payload = b"x" * (64 << 20)
+            huge = xfer(huge_payload, 5)
             return {
                 "p50_64B_us": round(float(np.median(small)) * 1e6, 1),
                 "gbps_4MiB": round(
                     len(big_payload) / float(np.median(big)) / 1e9, 2
+                ),
+                "gbps_64MiB_rndv": round(
+                    len(huge_payload) / float(np.median(huge)) / 1e9, 2
                 ),
             }
         finally:

@@ -18,6 +18,7 @@ multi-host wire (the modex analog exchanges host:port pairs).
 from __future__ import annotations
 
 import time
+from collections import deque
 from typing import Any, Optional
 
 import numpy as np
@@ -69,6 +70,9 @@ class DcnEndpoint:
             config.get("btl_dcn_eager_limit", DcnBtl.EAGER_LIMIT),
         )
         self._pool = mempool.shared_pool()
+        # Zero-copy send pins: msgid -> buffer, released at completion.
+        self._send_refs: dict[int, Any] = {}
+        self._pending_send_done: deque[int] = deque(maxlen=4096)
         self._closed = False
 
     # -- wiring ------------------------------------------------------------
@@ -158,29 +162,28 @@ class DcnEndpoint:
 
     def send_bytes(self, peer: int, tag: int, data) -> int:
         buf = np.ascontiguousarray(np.frombuffer(data, np.uint8))
-        msgid = self._lib.dcn_send(
+        msgid = self._lib.dcn_send_ref(
             self._ctx, peer, tag, buf.ctypes.data, buf.nbytes
         )
         if msgid < 0:
             raise DcnError(f"send to unknown peer {peer}")
+        # Zero-copy contract: the engine references `buf` directly for
+        # rendezvous payloads; pin it until the completion id pops.
+        # Every send also drains finished completions so non-polling
+        # callers don't keep flushed payloads pinned (ids are preserved
+        # for explicit pollers in a BOUNDED queue — oldest dropped).
+        self._send_refs[int(msgid)] = buf
+        while True:
+            done = int(self._lib.dcn_poll_send(self._ctx))
+            if not done:
+                break
+            self._send_refs.pop(done, None)
+            self._pending_send_done.append(done)
         SPC.record("dcn_send_bytes", buf.nbytes)
-        # Payload copies are reclaimed by the engine at completion;
-        # the completion queue is left for explicit pollers.
         return int(msgid)
 
-    def poll_recv(self) -> Optional[tuple[int, int, bytes]]:
-        """(peer, tag, payload) of one completed message, or None."""
-        import ctypes
-
-        peer = ctypes.c_int(0)
-        tag = ctypes.c_longlong(0)
-        length = ctypes.c_longlong(0)
-        msgid = self._lib.dcn_poll_recv(
-            self._ctx, ctypes.byref(peer), ctypes.byref(tag),
-            ctypes.byref(length),
-        )
-        if msgid == 0:
-            return None
+    def _consume_receipt(self, msgid: int, peer, tag, length
+                         ) -> tuple[int, int, bytes]:
         try:
             block = self._pool.alloc(max(1, length.value))
         except mempool.PoolExhausted:
@@ -201,19 +204,51 @@ class DcnEndpoint:
         SPC.record("dcn_recv_bytes", length.value)
         return int(peer.value), int(tag.value), payload
 
+    def poll_recv(self) -> Optional[tuple[int, int, bytes]]:
+        """(peer, tag, payload) of one completed message, or None."""
+        import ctypes
+
+        peer = ctypes.c_int(0)
+        tag = ctypes.c_longlong(0)
+        length = ctypes.c_longlong(0)
+        msgid = self._lib.dcn_poll_recv(
+            self._ctx, ctypes.byref(peer), ctypes.byref(tag),
+            ctypes.byref(length),
+        )
+        if msgid == 0:
+            return None
+        return self._consume_receipt(msgid, peer, tag, length)
+
     def recv_bytes(self, timeout: float = 10.0) -> tuple[int, int, bytes]:
+        """Blocking receive: parks on the engine's completion condition
+        variable (in <=100 ms slices so Ctrl-C stays responsive) instead
+        of burning a core busy-polling."""
+        import ctypes
+
         deadline = time.monotonic() + timeout
+        peer = ctypes.c_int(0)
+        tag = ctypes.c_longlong(0)
+        length = ctypes.c_longlong(0)
         while True:
-            out = self.poll_recv()
-            if out is not None:
-                return out
+            remaining = deadline - time.monotonic()
+            slice_ms = max(1, min(100, int(remaining * 1000)))
+            msgid = self._lib.dcn_wait_recv(
+                self._ctx, slice_ms, ctypes.byref(peer),
+                ctypes.byref(tag), ctypes.byref(length),
+            )
+            if msgid:
+                return self._consume_receipt(msgid, peer, tag, length)
             if time.monotonic() >= deadline:
                 raise DcnError("recv timeout")
-            time.sleep(0.0002)
 
     def poll_send_complete(self) -> Optional[int]:
-        msgid = self._lib.dcn_poll_send(self._ctx)
-        return int(msgid) if msgid else None
+        if self._pending_send_done:
+            return self._pending_send_done.popleft()
+        msgid = int(self._lib.dcn_poll_send(self._ctx))
+        if not msgid:
+            return None
+        self._send_refs.pop(msgid, None)
+        return msgid
 
     def set_link_weights(self, peer: int, weights) -> None:
         """Per-link FRAG striping proportions for a peer (reference:
@@ -333,6 +368,8 @@ class DcnEndpoint:
     def close(self) -> None:
         if not self._closed:
             self._lib.dcn_destroy(self._ctx)
+            self._send_refs.clear()
+            self._pending_send_done.clear()
             self._closed = True
 
     def __del__(self) -> None:

@@ -312,6 +312,10 @@ def decide_reduce_scatter(op: Op, nbytes: int, nranks: int) -> str:
         got = rules.decide("reduce_scatter", nbytes, nranks)
         if got:
             return got
+    if not op.commutative or _is_joint(op):
+        # ring/halving accumulate out of rank order; the native path's
+        # ordered gather-reduce fallback is the only correct one
+        return "native"
     if _prefer_native.value and op.xla_reduce is not None:
         return "native"
     pof2 = nranks & (nranks - 1) == 0
@@ -335,6 +339,13 @@ def decide_gather(nbytes: int, nranks: int) -> str:
 
 
 def decide_scatter(nbytes: int, nranks: int) -> str:
+    """Default is ALWAYS native: on a single controller scatter is a
+    pure reshard (put_rank_major), while the algorithm-form path must
+    first stage the buffer replicated n-ways just to tear it apart
+    again. The tree algorithms exist for parity with
+    coll_base_scatter.c and are reachable only by forced var or rules
+    file (e.g. for spanning-comm reuse where the staging is the
+    transport anyway)."""
     forced = _force_scatter.value
     if forced:
         return forced

@@ -131,9 +131,16 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
                                 ctypes.c_int, LL, ctypes.c_int]
     lib.dcn_send.restype = LL
     lib.dcn_send.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL]
+    lib.dcn_send_ref.restype = LL
+    lib.dcn_send_ref.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL]
     lib.dcn_poll_recv.restype = LL
     lib.dcn_poll_recv.argtypes = [
         P, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(LL),
+        ctypes.POINTER(LL),
+    ]
+    lib.dcn_wait_recv.restype = LL
+    lib.dcn_wait_recv.argtypes = [
+        P, ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(LL),
         ctypes.POINTER(LL),
     ]
     lib.dcn_read.restype = LL

@@ -30,12 +30,14 @@
 #include <poll.h>
 #include <sys/epoll.h>
 #include <sys/socket.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <algorithm>
 #include <array>
 #include <atomic>
 #include <condition_variable>
+#include <chrono>
 #include <cstdint>
 #include <cstdio>
 #include <deque>
@@ -69,8 +71,17 @@ struct FrameHeader {
 
 struct OutFrame {
   FrameHeader hdr;
-  std::vector<char> payload;
+  std::vector<char> payload;   // owned payload (eager/control frames)
+  // Zero-copy rendezvous: FRAG frames reference the OutMsg's buffer
+  // instead of copying 128K per frame (the buffer outlives the frame:
+  // it is only reclaimed once every frame of the message has been
+  // fully flushed — see do_write's completion bookkeeping).
+  const char* ext = nullptr;
   size_t sent = 0;  // bytes of (header+payload) already written
+  const char* data() const { return ext ? ext : payload.data(); }
+  size_t len() const {
+    return ext ? (size_t)hdr.payload_len : payload.size();
+  }
 };
 
 struct Link {
@@ -79,6 +90,11 @@ struct Link {
   std::deque<OutFrame> outq;
   // incoming reassembly of the current frame
   std::vector<char> inbuf;
+  // Zero-copy rendezvous receive: FRAG payloads land directly in the
+  // InMsg buffer at their offset (stable: std::map nodes don't move,
+  // the vector is sized once at RNDV_REQ, and the message cannot
+  // complete while this frag's bytes are still uncounted).
+  char* ext_dst = nullptr;
   size_t need = sizeof(FrameHeader);
   bool in_header = true;
   FrameHeader cur;
@@ -97,6 +113,10 @@ struct OutMsg {
   int peer;
   int64_t tag;
   std::vector<char> data;  // rndv only (frags stream from it)
+  // Zero-copy send: when the caller guarantees the buffer stays alive
+  // until the send completion is polled (dcn_send_ref contract), frags
+  // reference it directly and `data` stays empty.
+  const char* ext = nullptr;
   int64_t total_len = 0;
   bool rndv = false;
   bool acked = false;
@@ -130,6 +150,11 @@ struct Ctx {
   std::atomic<bool> stop{false};
 
   std::mutex mu;
+  // Signaled on every completion push (recv_done / send_done /
+  // matched_done) so callers can block in dcn_wait_recv instead of
+  // busy-polling — on small-core hosts a spinning poller steals the
+  // very cycles the transport threads need.
+  std::condition_variable cv;
   std::unordered_map<int, Link> links;  // fd -> link
   std::map<int, Peer> peers;            // peer id -> links
   int next_peer = 0;
@@ -165,11 +190,52 @@ struct Ctx {
   std::map<std::array<int64_t, 4>, int64_t> match_expect;
   std::map<std::array<int64_t, 4>,
            std::map<int64_t, std::pair<int, int64_t>>> match_held;
+  // Rendezvous landing-buffer reuse (reference: mpool/free-list
+  // fragment reuse): a fresh multi-MB vector per message costs an
+  // mmap + page-fault + memset sweep every time; recycling consumed
+  // buffers makes repeat transfers run at wire speed. Reuse requires
+  // size >= needed (shrink-resize never re-initializes), so steady
+  // same-size streams hit every time.
+  std::deque<std::vector<char>> buf_cache;
   // stats
   std::atomic<int64_t> bytes_sent{0}, bytes_recv{0};
   std::atomic<int64_t> eager_sends{0}, rndv_sends{0}, frags_sent{0};
   std::atomic<int64_t> offload_matches{0}, offload_unexpected{0};
 };
+
+constexpr size_t kBufCacheMin = 1 << 20;  // cache buffers >= 1 MiB
+constexpr size_t kBufCacheMax = 4;        // entries
+
+// mu held. Take a recycled landing buffer of at least `need` bytes,
+// resized (shrunk) to exactly `need`, or a fresh one. BEST fit, not
+// first fit: handing a 64 MiB buffer to a 2 MiB message would strand
+// its capacity behind a shrunken size() and defeat the cache for the
+// next large message.
+std::vector<char> take_buf(Ctx* c, size_t need) {
+  auto best = c->buf_cache.end();
+  for (auto it = c->buf_cache.begin(); it != c->buf_cache.end(); ++it) {
+    if (it->size() >= need &&
+        (best == c->buf_cache.end() || it->size() < best->size())) {
+      best = it;
+    }
+  }
+  if (best != c->buf_cache.end()) {
+    std::vector<char> v = std::move(*best);
+    c->buf_cache.erase(best);
+    v.resize(need);
+    return v;
+  }
+  std::vector<char> v;
+  v.resize(need);
+  return v;
+}
+
+// mu held. Return a consumed landing buffer to the cache.
+void recycle_buf(Ctx* c, std::vector<char>&& v) {
+  if (v.size() < kBufCacheMin) return;
+  if (c->buf_cache.size() >= kBufCacheMax) c->buf_cache.pop_front();
+  c->buf_cache.push_back(std::move(v));
+}
 
 // The envelope layout shared with pml/fabric's fast-frame header
 // (struct format "<IiiiiqB8s6i"): magic u32 | cid i32 | src i32 |
@@ -208,6 +274,11 @@ void set_nonblock(int fd) {
   fcntl(fd, F_SETFL, fl | O_NONBLOCK);
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  // Deep socket buffers keep the rendezvous frag stream pipelined:
+  // the writer can stay several frags ahead of the reader's drain.
+  int buf = 4 * 1024 * 1024;
+  setsockopt(fd, SOL_SOCKET, SO_SNDBUF, &buf, sizeof(buf));
+  setsockopt(fd, SOL_SOCKET, SO_RCVBUF, &buf, sizeof(buf));
 }
 
 void arm(Ctx* c, int fd, bool want_write) {
@@ -278,16 +349,29 @@ OutFrame make_frame(FrameKind k, int64_t msgid, int64_t tag,
   return f;
 }
 
+// Zero-copy variant: the frame references `data` (the OutMsg buffer)
+// instead of owning a copy. Caller guarantees the buffer outlives the
+// frame (OutMsg.data is cleared only after all its frames flushed).
+OutFrame make_frame_ref(FrameKind k, int64_t msgid, int64_t tag,
+                        int64_t total, int64_t off, const char* data,
+                        int64_t len) {
+  OutFrame f;
+  f.hdr = {kMagic, (uint32_t)k, msgid, tag, total, off, len};
+  f.ext = data;
+  return f;
+}
+
 // mu held. Push rndv fragments for an acked message (all at once; the
 // socket layer trickles them out as the peer drains).
 void schedule_frags(Ctx* c, int64_t msgid, OutMsg& m) {
-  while (m.next_offset < (int64_t)m.data.size()) {
+  const char* base = m.ext ? m.ext : m.data.data();
+  while (m.next_offset < m.total_len) {
     int64_t len =
-        std::min<int64_t>(kFragBytes, m.data.size() - m.next_offset);
+        std::min<int64_t>(kFragBytes, m.total_len - m.next_offset);
     enqueue_frame(c, m.peer,
-                  make_frame(kFrag, msgid, m.tag, m.data.size(),
-                             m.next_offset, m.data.data() + m.next_offset,
-                             len));
+                  make_frame_ref(kFrag, msgid, m.tag, m.total_len,
+                                 m.next_offset, base + m.next_offset,
+                                 len));
     m.next_offset += len;
     c->frags_sent++;
   }
@@ -311,6 +395,7 @@ void match_one(Ctx* c, std::pair<int, int64_t> key,
       c->inflight_in.erase(it);
       c->posted.erase(pit);
       c->matched_done.push_back({handle, receipt});
+      c->cv.notify_all();
       c->offload_matches++;
       return;
     }
@@ -360,6 +445,7 @@ void route_completed(Ctx* c, std::pair<int, int64_t> key) {
     }
   }
   c->recv_done.push_back(key);
+  c->cv.notify_all();
 }
 
 void handle_frame(Ctx* c, Link& l) {
@@ -386,7 +472,7 @@ void handle_frame(Ctx* c, Link& l) {
       InMsg m;
       m.peer = l.peer;
       m.tag = h.tag;
-      m.data.resize(h.total_len);
+      m.data = take_buf(c, h.total_len);
       m.announced_rndv = true;
       c->inflight_in.emplace(std::make_pair(l.peer, h.msgid),
                              std::move(m));
@@ -409,7 +495,11 @@ void handle_frame(Ctx* c, Link& l) {
       if (it != c->inflight_in.end()) {
         InMsg& m = it->second;
         if (h.offset + h.payload_len <= (int64_t)m.data.size()) {
-          memcpy(m.data.data() + h.offset, l.inbuf.data(), h.payload_len);
+          // ext_dst set: the payload was read straight into m.data
+          // (zero-copy); otherwise it staged through l.inbuf.
+          if (!l.ext_dst)
+            memcpy(m.data.data() + h.offset, l.inbuf.data(),
+                   h.payload_len);
           m.received += h.payload_len;
           c->bytes_recv += h.payload_len;
           if (m.received >= (int64_t)m.data.size()) {
@@ -467,9 +557,27 @@ void do_read(Ctx* c, int fd) {
           return;
         }
         l.in_header = false;
-        l.inbuf.clear();
-        l.inbuf.resize(l.cur.payload_len);
         l.need = l.cur.payload_len;
+        l.ext_dst = nullptr;
+        if (l.cur.kind == kFrag) {
+          // Zero-copy: land the frag payload directly at its offset in
+          // the message buffer. Safe across EAGAIN resumes: incomplete
+          // rendezvous entries are never erased or moved (std::map
+          // nodes are stable, the vector was sized once at RNDV_REQ,
+          // and the message cannot complete with this frag's bytes
+          // still uncounted).
+          auto it = c->inflight_in.find(
+              std::make_pair(l.peer, l.cur.msgid));
+          if (it != c->inflight_in.end() &&
+              l.cur.offset + l.cur.payload_len <=
+                  (int64_t)it->second.data.size()) {
+            l.ext_dst = it->second.data.data() + l.cur.offset;
+          }
+        }
+        if (!l.ext_dst) {
+          l.inbuf.clear();
+          l.inbuf.resize(l.cur.payload_len);
+        }
         if (l.need == 0) {
           handle_frame(c, l);
           l.in_header = true;
@@ -478,7 +586,8 @@ void do_read(Ctx* c, int fd) {
       }
     } else {
       size_t have = l.cur.payload_len - l.need;
-      ssize_t n = read(fd, l.inbuf.data() + have, l.need);
+      char* dst = l.ext_dst ? l.ext_dst : l.inbuf.data();
+      ssize_t n = read(fd, dst + have, l.need);
       if (n <= 0) {
         if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
         drop_link(c, fd);
@@ -487,6 +596,7 @@ void do_read(Ctx* c, int fd) {
       l.need -= n;
       if (l.need == 0) {
         handle_frame(c, l);
+        l.ext_dst = nullptr;
         l.in_header = true;
         l.need = sizeof(FrameHeader);
       }
@@ -503,26 +613,30 @@ void do_write(Ctx* c, int fd) {
     OutFrame& f = l.outq.front();
     const char* hdr = reinterpret_cast<const char*>(&f.hdr);
     size_t hdr_n = sizeof(FrameHeader);
-    while (f.sent < hdr_n) {
-      ssize_t n = write(fd, hdr + f.sent, hdr_n - f.sent);
+    size_t total = hdr_n + f.len();
+    while (f.sent < total) {
+      // One writev per round trip: header remainder + payload remainder
+      // in a single syscall (the payload may be external — zero-copy
+      // rendezvous frags reference the OutMsg buffer).
+      iovec iov[2];
+      int cnt = 0;
+      if (f.sent < hdr_n)
+        iov[cnt++] = {const_cast<char*>(hdr) + f.sent, hdr_n - f.sent};
+      size_t poff = f.sent > hdr_n ? f.sent - hdr_n : 0;
+      if (f.len() > poff)
+        iov[cnt++] = {const_cast<char*>(f.data()) + poff,
+                      f.len() - poff};
+      ssize_t n = writev(fd, iov, cnt);
       if (n <= 0) {
         if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
         drop_link(c, fd);
         return;
       }
+      size_t hdr_part = f.sent < hdr_n
+                            ? std::min<size_t>(n, hdr_n - f.sent)
+                            : 0;
+      c->bytes_sent += n - hdr_part;
       f.sent += n;
-    }
-    while (f.sent < hdr_n + f.payload.size()) {
-      size_t off = f.sent - hdr_n;
-      ssize_t n = write(fd, f.payload.data() + off,
-                        f.payload.size() - off);
-      if (n <= 0) {
-        if (n < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) return;
-        drop_link(c, fd);
-        return;
-      }
-      f.sent += n;
-      c->bytes_sent += n;
     }
     // frame fully written: completion bookkeeping for data frames.
     // Frags stripe over links, so "last offset written" is NOT "all
@@ -537,7 +651,9 @@ void do_write(Ctx* c, int fd) {
           // until dcn_poll_send so completion ids are never lost
           it->second.data.clear();
           it->second.data.shrink_to_fit();
+          it->second.ext = nullptr;  // caller may free after poll
           c->send_done.push_back(f.hdr.msgid);
+          c->cv.notify_all();
         }
       }
     }
@@ -835,15 +951,19 @@ long long dcn_send(void* vc, int peer, long long tag, const void* buf,
   m.peer = peer;
   m.tag = tag;
   m.total_len = len;
-  m.data.assign(static_cast<const char*>(buf),
-                static_cast<const char*>(buf) + len);
   if (len <= c->eager_limit.load()) {
+    // eager: the single owned copy lives in the frame itself — no
+    // intermediate OutMsg staging buffer
     c->eager_sends++;
     c->inflight_out.emplace(id, std::move(m));
-    OutMsg& om = c->inflight_out[id];
-    enqueue_frame(c, peer, make_frame(kEager, id, tag, len, 0,
-                                      om.data.data(), len));
+    enqueue_frame(c, peer,
+                  make_frame(kEager, id, tag, len, 0,
+                             static_cast<const char*>(buf), len));
   } else {
+    // rendezvous: own one copy (the caller may free `buf` on return);
+    // frags reference this buffer zero-copy until fully flushed
+    m.data.assign(static_cast<const char*>(buf),
+                  static_cast<const char*>(buf) + len);
     m.rndv = true;
     c->rndv_sends++;
     c->inflight_out.emplace(id, std::move(m));
@@ -854,12 +974,43 @@ long long dcn_send(void* vc, int peer, long long tag, const void* buf,
   return id;
 }
 
-// Poll one completed incoming message: returns msgid (>0) and fills
-// peer/tag/len, or 0 when none. Payload is fetched with dcn_read.
-long long dcn_poll_recv(void* vc, int* peer, long long* tag,
-                        long long* len) {
+// Zero-copy send: like dcn_send, but for rendezvous-sized payloads the
+// engine references `buf` directly instead of copying it. CONTRACT:
+// the caller must keep `buf` alive and unmodified until this msgid
+// comes back from dcn_poll_send (the Python wrapper pins the buffer
+// object). Eager-sized payloads are copied as usual (the frame owns
+// the single copy) so the contract is trivially met.
+long long dcn_send_ref(void* vc, int peer, long long tag,
+                       const void* buf, long long len) {
   Ctx* c = static_cast<Ctx*>(vc);
   std::lock_guard<std::mutex> g(c->mu);
+  if (c->peers.find(peer) == c->peers.end()) return -1;
+  int64_t id = c->next_msgid++;
+  OutMsg m;
+  m.peer = peer;
+  m.tag = tag;
+  m.total_len = len;
+  if (len <= c->eager_limit.load()) {
+    c->eager_sends++;
+    c->inflight_out.emplace(id, std::move(m));
+    enqueue_frame(c, peer,
+                  make_frame(kEager, id, tag, len, 0,
+                             static_cast<const char*>(buf), len));
+  } else {
+    m.ext = static_cast<const char*>(buf);
+    m.rndv = true;
+    c->rndv_sends++;
+    c->inflight_out.emplace(id, std::move(m));
+    enqueue_frame(c, peer,
+                  make_frame(kRndvReq, id, tag, len, 0, nullptr, 0));
+  }
+  wake(c);
+  return id;
+}
+
+// mu held. Pop one completed incoming message into a receipt, or 0.
+static long long pop_recv_locked(Ctx* c, int* peer, long long* tag,
+                                 long long* len) {
   while (!c->recv_done.empty()) {
     auto key = c->recv_done.front();
     c->recv_done.pop_front();
@@ -876,6 +1027,33 @@ long long dcn_poll_recv(void* vc, int* peer, long long* tag,
   return 0;
 }
 
+// Poll one completed incoming message: returns msgid (>0) and fills
+// peer/tag/len, or 0 when none. Payload is fetched with dcn_read.
+long long dcn_poll_recv(void* vc, int* peer, long long* tag,
+                        long long* len) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  return pop_recv_locked(c, peer, tag, len);
+}
+
+// Blocking poll: park on the completion condition variable for up to
+// timeout_ms instead of spinning — on small-core hosts a busy-polling
+// caller steals the cycles the transport threads need (the reference's
+// analog is opal_progress yielding via sched_yield, opal_progress.c).
+long long dcn_wait_recv(void* vc, int timeout_ms, int* peer,
+                        long long* tag, long long* len) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::unique_lock<std::mutex> lk(c->mu);
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    long long receipt = pop_recv_locked(c, peer, tag, len);
+    if (receipt) return receipt;
+    if (c->cv.wait_until(lk, deadline) == std::cv_status::timeout)
+      return pop_recv_locked(c, peer, tag, len);
+  }
+}
+
 long long dcn_read(void* vc, long long msgid, void* buf,
                    long long maxlen) {
   Ctx* c = static_cast<Ctx*>(vc);
@@ -884,6 +1062,7 @@ long long dcn_read(void* vc, long long msgid, void* buf,
   if (it == c->recv_ready.end()) return -1;
   long long n = std::min<long long>(maxlen, it->second.data.size());
   memcpy(buf, it->second.data.data(), n);
+  recycle_buf(c, std::move(it->second.data));
   c->recv_ready.erase(it);
   return n;
 }

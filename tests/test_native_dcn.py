@@ -102,6 +102,61 @@ def test_striping_uses_multiple_links(pair):
     assert a.stats()["links"] >= 2
 
 
+def test_wait_recv_blocks_and_times_out(pair):
+    """recv_bytes parks on the engine's completion condition variable:
+    a short timeout with no traffic raises; a send issued before the
+    wait is delivered without any busy-poll loop."""
+    import time
+
+    a, b, peer_b = pair
+    t0 = time.monotonic()
+    with pytest.raises(dcn_mod.DcnError):
+        b.recv_bytes(timeout=0.15)
+    waited = time.monotonic() - t0
+    assert 0.1 < waited < 2.0  # actually blocked, not spun or hung
+    a.send_bytes(peer_b, tag=3, data=b"hello-cv")
+    peer, tag, got = b.recv_bytes(timeout=5.0)
+    assert tag == 3 and got == b"hello-cv"
+
+
+def test_zero_copy_rndv_integrity_and_buffer_reuse(pair):
+    """The zero-copy rendezvous path (sender frags reference the pinned
+    Python buffer; receiver frags land directly in the recycled message
+    buffer) must deliver byte-exact payloads across repeated
+    different-pattern transfers — corruption here would mean a freed
+    or reused buffer was transmitted."""
+    a, b, peer_b = pair
+    n = 3 << 20  # rendezvous regime (> 64K eager limit)
+    for seed in range(4):
+        payload = np.random.default_rng(seed).integers(
+            0, 256, n, dtype=np.uint8
+        ).tobytes()
+        a.send_bytes(peer_b, tag=seed, data=payload)
+        peer, tag, got = b.recv_bytes(timeout=10.0)
+        assert tag == seed
+        assert got == payload
+    assert a.stats()["rndv_sends"] == 4
+
+
+def test_send_ref_pins_released_on_completion(pair):
+    """Pinned zero-copy send buffers are released once the completion
+    id is polled (directly or via the internal drain)."""
+    a, b, peer_b = pair
+    payload = b"z" * (1 << 20)
+    msgid = a.send_bytes(peer_b, tag=1, data=payload)
+    assert msgid in a._send_refs
+    b.recv_bytes(10.0)
+    # flush: completion appears after the engine wrote all frags
+    import time
+
+    deadline = time.monotonic() + 5
+    done = None
+    while done is None and time.monotonic() < deadline:
+        done = a.poll_send_complete()
+    assert done == msgid
+    assert msgid not in a._send_refs
+
+
 def test_unknown_peer_raises(pair):
     a, _, _ = pair
     with pytest.raises(dcn_mod.DcnError):
