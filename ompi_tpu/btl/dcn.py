@@ -72,7 +72,10 @@ class DcnEndpoint:
         self._pool = mempool.shared_pool()
         # Zero-copy send pins: msgid -> buffer, released at completion.
         self._send_refs: dict[int, Any] = {}
-        self._pending_send_done: deque[int] = deque(maxlen=4096)
+        # Lossless: ids already drained from the engine must stay
+        # claimable by explicit pollers (an int per unclaimed send —
+        # negligible next to the payloads; cleared on close()).
+        self._pending_send_done: deque[int] = deque()
         self._closed = False
 
     # -- wiring ------------------------------------------------------------
@@ -170,8 +173,8 @@ class DcnEndpoint:
         # Zero-copy contract: the engine references `buf` directly for
         # rendezvous payloads; pin it until the completion id pops.
         # Every send also drains finished completions so non-polling
-        # callers don't keep flushed payloads pinned (ids are preserved
-        # for explicit pollers in a BOUNDED queue — oldest dropped).
+        # callers don't keep flushed payloads pinned; drained ids are
+        # preserved losslessly for explicit pollers.
         self._send_refs[int(msgid)] = buf
         while True:
             done = int(self._lib.dcn_poll_send(self._ctx))

@@ -396,7 +396,15 @@ def _selfdma_chunked_kernel(axis_name: str, seg: int, n_segs: int,
     HBM->VMEM prefetch, one self-targeted remote DMA (the ICI machinery
     with device_id == me), VMEM->HBM writeback — double-buffered. This
     is the bench's on-chip Mosaic proof path: a 1-rank allreduce is the
-    identity, but every DMA engine the n>1 schedule uses runs for real."""
+    identity, but every DMA engine the n>1 schedule uses runs for real.
+
+    3-stage software pipeline: the remote DMA of segment si is waited
+    only at iteration si+1, so IN(si+1), RDMA(si) and OUT(si-1) are all
+    in flight together (a back-to-back start/wait serialized the three
+    engines and capped the measured HBM rate at ~half the roofline).
+    Slot hazards: RDMA(si) needs comm_buf[si%2] free -> OUT(si-2)
+    waited; IN(si+1) needs x_buf[(si+1)%2] free -> RDMA(si-1) waited;
+    OUT(si) needs RDMA(si) waited."""
     def in_dma(si):
         return pltpu.make_async_copy(
             x_hbm.at[0, pl.ds(si * seg, seg)], x_buf.at[si % 2],
@@ -407,24 +415,29 @@ def _selfdma_chunked_kernel(axis_name: str, seg: int, n_segs: int,
             comm_buf.at[si % 2], out_hbm.at[0, pl.ds(si * seg, seg)],
             out_sem.at[si % 2])
 
-    in_dma(0).start()
-    if n_segs > 1:
-        in_dma(1).start()
-    for si in range(n_segs):
+    def rdma(si):
         slot = si % 2
-        in_dma(si).wait()
-        if si >= 2:
-            out_dma(si - 2).wait()  # comm_buf[slot] reader must finish
-        rdma = pltpu.make_async_remote_copy(
+        return pltpu.make_async_remote_copy(
             src_ref=x_buf.at[slot], dst_ref=comm_buf.at[slot],
             send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
             device_id=jax.lax.axis_index(axis_name),
             device_id_type=pltpu.DeviceIdType.LOGICAL)
-        rdma.start()
-        rdma.wait()
-        if si + 2 < n_segs:
-            in_dma(si + 2).start()  # x_buf[slot] free after rdma send
-        out_dma(si).start()
+
+    in_dma(0).start()
+    if n_segs > 1:
+        in_dma(1).start()
+    for si in range(n_segs):
+        in_dma(si).wait()
+        if si >= 2:
+            out_dma(si - 2).wait()  # comm_buf[si%2] reader must finish
+        rdma(si).start()
+        if si >= 1:
+            rdma(si - 1).wait()
+            out_dma(si - 1).start()
+            if si + 1 < n_segs:
+                in_dma(si + 1).start()  # x_buf slot freed by the wait
+    rdma(n_segs - 1).wait()
+    out_dma(n_segs - 1).start()
     for si in range(max(0, n_segs - 2), n_segs):
         out_dma(si).wait()
 

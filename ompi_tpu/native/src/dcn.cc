@@ -203,8 +203,9 @@ struct Ctx {
   std::atomic<int64_t> offload_matches{0}, offload_unexpected{0};
 };
 
-constexpr size_t kBufCacheMin = 1 << 20;  // cache buffers >= 1 MiB
-constexpr size_t kBufCacheMax = 4;        // entries
+constexpr size_t kBufCacheMin = 1 << 20;          // cache buffers >= 1 MiB
+constexpr size_t kBufCacheMax = 4;                // entries
+constexpr size_t kBufCacheBytes = 256 << 20;      // total byte budget
 
 // mu held. Take a recycled landing buffer of at least `need` bytes,
 // resized (shrunk) to exactly `need`, or a fresh one. BEST fit, not
@@ -230,10 +231,19 @@ std::vector<char> take_buf(Ctx* c, size_t need) {
   return v;
 }
 
-// mu held. Return a consumed landing buffer to the cache.
+// mu held. Return a consumed landing buffer to the cache, bounded by
+// entry count AND total bytes (4 burst-sized giants must not pin RSS
+// for the context's lifetime).
 void recycle_buf(Ctx* c, std::vector<char>&& v) {
-  if (v.size() < kBufCacheMin) return;
-  if (c->buf_cache.size() >= kBufCacheMax) c->buf_cache.pop_front();
+  if (v.size() < kBufCacheMin || v.capacity() > kBufCacheBytes) return;
+  size_t total = v.capacity();
+  for (const auto& b : c->buf_cache) total += b.capacity();
+  while (!c->buf_cache.empty() &&
+         (c->buf_cache.size() >= kBufCacheMax ||
+          total > kBufCacheBytes)) {
+    total -= c->buf_cache.front().capacity();
+    c->buf_cache.pop_front();
+  }
   c->buf_cache.push_back(std::move(v));
 }
 
