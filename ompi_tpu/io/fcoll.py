@@ -113,6 +113,22 @@ def _domains(accesses: Sequence[Access], n_ranks: int
             for i in range(n) if lo + i * span < hi]
 
 
+def _merged_runs(accesses: Sequence[Access]) -> list[list[int]]:
+    """Sorted, merged [off, len] coverage intervals across all ranks."""
+    runs = sorted(
+        (r for a in accesses for r in a.runs), key=lambda r: r[0]
+    )
+    if not runs:
+        return []
+    merged = [list(runs[0])]
+    for off, ln in runs[1:]:
+        if off <= merged[-1][0] + merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], off + ln - merged[-1][0])
+        else:
+            merged.append([off, ln])
+    return merged
+
+
 class _RunCursor:
     """Walks one rank's runs, mapping file-byte ranges back to positions
     in that rank's packed buffer."""
@@ -160,9 +176,16 @@ class TwoPhaseFcoll(FcollComponent):
     DESCRIPTION = "aggregator-based two-phase collective IO"
 
     def available(self, **ctx: Any) -> bool:
-        # A single access can't aggregate; fall through to individual.
+        # A single access can't usefully aggregate; defer to individual
+        # — unless this component was explicitly forced (fcoll_select),
+        # where aggregation still runs correctly on one access and a
+        # selection error would be wrong (hit on size-1 worlds).
         accesses = ctx.get("accesses")
-        return accesses is None or len(accesses) > 1
+        if accesses is None or len(accesses) > 1:
+            return True
+        spec = config.get("fcoll_select", "") or ""
+        return self.NAME in {p.strip() for p in spec.split(",")
+                             if p.strip()}
 
     def write_all(self, fh, accesses, buffers) -> None:
         domains = _domains(accesses, len(accesses))
@@ -244,20 +267,9 @@ class DynamicFcoll(TwoPhaseFcoll):
 
     @staticmethod
     def _domains_by_volume(accesses, n_ranks):
-        runs = sorted(
-            (r for a in accesses for r in a.runs), key=lambda r: r[0]
-        )
-        if not runs:
+        merged = _merged_runs(accesses)
+        if not merged:
             return []
-        # merge overlapping/adjacent runs into covered intervals
-        merged = [list(runs[0])]
-        for off, ln in runs[1:]:
-            if off <= merged[-1][0] + merged[-1][1]:
-                merged[-1][1] = max(
-                    merged[-1][1], off + ln - merged[-1][0]
-                )
-            else:
-                merged.append([off, ln])
         total = sum(ln for _, ln in merged)
         n = _num_aggr.value or max(1, n_ranks // 4)
         per = -(-total // n)
@@ -388,6 +400,81 @@ class VulcanFcoll(DynamicFcoll):
                     moved += ln
             SPC.record("io_two_phase_exchange_bytes", moved)
         return out
+
+
+_stripe_bytes = config.register(
+    "fcoll", "dynamic_gen2", "stripe_bytes", type=int,
+    default=4 * 1024 * 1024,
+    description="Aggregator stripe size for dynamic_gen2 (reference: "
+                "the filesystem stripe — Lustre stripe size / object "
+                "part size — that gen2 aligns aggregator domains to)",
+)
+
+
+@FCOLL.register
+class DynamicGen2Fcoll(VulcanFcoll):
+    """Stripe-aligned aggregation (reference: ompi/mca/fcoll/dynamic_gen2
+    — the successor to dynamic that cuts aggregator domains on
+    FILESYSTEM STRIPE boundaries and deals stripes to aggregators
+    cyclically, so each file stripe is written by exactly one
+    aggregator and aggregator load stays balanced under any access
+    pattern). Differences from the siblings:
+
+    - two_phase cuts [min,max) evenly, dynamic cuts at run boundaries
+      by volume; gen2 cuts at stripe boundaries (``stripe_bytes``) and
+      skips stripes no rank touches (sparse efficiency);
+    - stripes are assigned round-robin (stripe i -> aggregator
+      i mod naggr), the reference's cyclic distribution; the
+      per-aggregator stripe counts are SPC-recorded for balance
+      observability;
+    - the cycle loop inherits vulcan's two-deep overlap pipeline.
+
+    Opt-in via ``io_fcoll_select=dynamic_gen2`` (the reference selects
+    gen2 by priority/hints on striped filesystems)."""
+
+    NAME = "dynamic_gen2"
+    PRIORITY = 10
+    DESCRIPTION = "stripe-aligned cyclic aggregation (gen2)"
+
+    @staticmethod
+    def _stripe_domains(accesses) -> list[tuple[int, int]]:
+        merged = _merged_runs(accesses)
+        if not merged:
+            return []
+        stripe = max(1, _stripe_bytes.value)
+        hi = merged[-1][0] + merged[-1][1]
+        # O(touched stripes): walk the merged coverage intervals and
+        # emit each interval's stripe-aligned sub-ranges, never
+        # iterating across untouched holes. Consecutive intervals that
+        # fall in the same stripe dedupe via `last`.
+        out: list[tuple[int, int]] = []
+        last = -1
+        for off, ln in merged:
+            for s in range((off // stripe) * stripe, off + ln, stripe):
+                if s == last:
+                    continue
+                out.append((s, min(s + stripe, hi)))
+                last = s
+        return out
+
+    def _record_assignment(self, domains, n_ranks: int) -> None:
+        naggr = _num_aggr.value or max(1, n_ranks // 4)
+        n = len(domains)
+        for i in range(min(naggr, n)):
+            # cyclic deal: aggregator i owns stripes i, i+naggr, ...
+            SPC.record(f"io_gen2_aggr{i}_stripes",
+                       n // naggr + (1 if i < n % naggr else 0))
+        SPC.record("io_gen2_stripes", n)
+
+    def write_all(self, fh, accesses, buffers) -> None:
+        domains = self._stripe_domains(accesses)
+        self._record_assignment(domains, len(accesses))
+        self._run_domains_write(fh, accesses, buffers, domains)
+
+    def read_all(self, fh, accesses):
+        domains = self._stripe_domains(accesses)
+        self._record_assignment(domains, len(accesses))
+        return self._run_domains_read(fh, accesses, domains)
 
 
 def select(accesses=None) -> FcollComponent:
