@@ -19,8 +19,18 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..coll import spmd
+from ..core import config
 
 _NEG = -1e30
+
+_impl_var = config.register(
+    "parallel", "sp", "impl", type=str, default="xla",
+    description="Ring attention implementation: 'xla' (ppermute ring, "
+                "compiler-scheduled overlap, any shape) or 'pallas' "
+                "(fused kernel with guaranteed DMA/compute overlap; "
+                "needs tile-aligned T/Dh and VMEM-resident blocks, "
+                "falls back to xla otherwise)",
+)
 
 
 def ring_attention(
@@ -29,9 +39,27 @@ def ring_attention(
     v: jax.Array,  # (T, H, Dh) local values
     axis_name: str = "sp",
     causal: bool = True,
+    impl: str | None = None,
 ) -> jax.Array:
     """Exact attention over the full (sharded) sequence. Returns the
     (T, H, Dh) outputs for this rank's query block."""
+    chosen = impl or _impl_var.value
+    if chosen not in ("xla", "pallas"):
+        from ..core.errors import ArgumentError
+
+        raise ArgumentError(
+            f"unknown ring attention impl {chosen!r}; known: xla, pallas"
+        )
+    if chosen == "pallas":
+        from ..coll import pallas_attn
+
+        if pallas_attn.supported(q):
+            return pallas_attn.ring_attention_block(
+                q, k, v, axis_name, causal=causal
+            )
+        # unaligned or VMEM-overflowing shapes: the fused kernel can't
+        # take them — stream through the XLA path instead of failing
+        # at trace time
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     T, H, Dh = q.shape
