@@ -264,6 +264,114 @@ def _fabric_loopback() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_FABRIC_PERF_WORKER = r"""
+import json, os, sys, time
+pid = int(sys.argv[1]); nprocs = int(sys.argv[2]); coord = sys.argv[3]
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.pml import fabric
+
+jax.distributed.initialize(coordinator_address=coord,
+                           num_processes=nprocs, process_id=pid,
+                           local_device_ids=[0, 1])
+world = ompi_tpu.init()
+fabric.wire_up()
+small = np.float32(1.0)
+big = np.ones((2 << 20,), np.float32)  # 8 MiB rendezvous payload
+
+if pid == 0:
+    world.rank(0).send(small, dest=2, tag=1)      # warm the wire
+    world.rank(0).recv(source=2, tag=2)
+    rtts = []
+    for i in range(200):
+        t0 = time.perf_counter()
+        world.rank(0).send(small, dest=2, tag=3)
+        world.rank(0).recv(source=2, tag=4)
+        rtts.append(time.perf_counter() - t0)
+    world.rank(0).send(big, dest=2, tag=5)        # warm rndv + compile
+    world.rank(0).recv(source=2, tag=6)
+    bws = []
+    for i in range(6):
+        t0 = time.perf_counter()
+        world.rank(0).send(big, dest=2, tag=7)
+        world.rank(0).recv(source=2, tag=8)       # tiny ack = delivery
+        bws.append(time.perf_counter() - t0)
+    print("FABRICPERF " + json.dumps({
+        "p50_small_rtt_us": round(float(np.median(rtts)) * 1e6, 1),
+        "gbps_8MiB_mpi": round(
+            big.nbytes / float(np.median(bws)) / 1e9, 2),
+    }), flush=True)
+else:
+    world.rank(2).recv(source=0, tag=1)
+    world.rank(2).send(small, dest=0, tag=2)
+    for i in range(200):
+        world.rank(2).recv(source=0, tag=3)
+        world.rank(2).send(small, dest=0, tag=4)
+    world.rank(2).recv(source=0, tag=5)
+    world.rank(2).send(small, dest=0, tag=6)
+    for i in range(6):
+        world.rank(2).recv(source=0, tag=7)
+        world.rank(2).send(small, dest=0, tag=8)
+print("WORKER %d OK" % pid, flush=True)
+"""
+
+
+def _fabric_2proc() -> dict:
+    """MPI-level p2p perf ACROSS two controller processes (pml/fabric
+    over the DCN engine, loopback): small-message ping-pong RTT (the
+    fastbox/eager regime) and 8 MiB rendezvous bandwidth (pipelined
+    DATA segments). Host/CPU subprocesses — no TPU in the path."""
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    try:
+        from ompi_tpu.native import build
+
+        if not build.available():
+            return {"skipped": "native library unavailable"}
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        coord = f"127.0.0.1:{s.getsockname()[1]}"
+        s.close()
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _FABRIC_PERF_WORKER, str(pid),
+                 "2", coord],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, env=env, cwd=here,
+            )
+            for pid in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=300)
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rc, out, err in outs:
+            if rc != 0:
+                return {"error": f"worker rc={rc}: {err[-400:]}"}
+        for _, out, _ in outs:
+            for line in out.splitlines():
+                if line.startswith("FABRICPERF "):
+                    return json.loads(line[len("FABRICPERF "):])
+        return {"error": "no FABRICPERF line in worker output"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def bench_single_chip() -> dict:
     import jax
     import jax.numpy as jnp
@@ -351,6 +459,7 @@ def bench_single_chip() -> dict:
                              "message latency regime)",
             "pallas": _pallas_proof(device),
             "fabric_loopback": _fabric_loopback(),
+            "fabric_2proc_mpi": _fabric_2proc(),
         },
     }
 

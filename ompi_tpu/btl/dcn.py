@@ -244,6 +244,19 @@ class DcnEndpoint:
             if time.monotonic() >= deadline:
                 raise DcnError("recv timeout")
 
+    def wait_event(self, timeout: float) -> bool:
+        """Park until ANY engine completion (recv/send/matched) is
+        pending or `timeout` seconds lapse, consuming nothing — the
+        progress engine's idle hook. True when something fired."""
+        ms = max(1, int(timeout * 1000))
+        return bool(self._lib.dcn_wait_event(self._ctx, ms))
+
+    def notify(self) -> None:
+        """Wake a parked wait_event waiter (the progress engine pokes
+        this when a non-DCN completion fires elsewhere)."""
+        if not self._closed:
+            self._lib.dcn_notify(self._ctx)
+
     def poll_send_complete(self) -> Optional[int]:
         if self._pending_send_done:
             return self._pending_send_done.popleft()

@@ -39,6 +39,8 @@ class ProgressEngine:
         # halt progress permanently.
         self._pumper = threading.RLock()
         self._wait_cv = threading.Condition()
+        # (hook, wake) pairs; wake pokes a parked hook from outside
+        self._idle_hooks: list[tuple] = []
 
     def register(self, fn: ProgressFn, low_priority: bool = False) -> None:
         with self._lock:
@@ -52,6 +54,36 @@ class ProgressEngine:
                 self._callbacks.remove(fn)
             if fn in self._low_priority:
                 self._low_priority.remove(fn)
+
+    def register_idle(self, fn: Callable[[float], bool],
+                      wake: Callable[[], None] | None = None) -> None:
+        """Register an idle hook: fn(budget_seconds) may BLOCK until a
+        component-level event fires or the budget lapses, returning True
+        if it blocked (or an event is pending). The pumping waiter calls
+        hooks when a sweep found zero events — a transport that can park
+        on a kernel primitive (DCN's completion condition variable)
+        turns the wait loop's spin into a sleep, which matters on
+        small-core hosts where the spinner starves the transport threads
+        (reference analog: opal_progress's sched_yield idle path)."""
+        with self._lock:
+            if all(f is not fn for f, _ in self._idle_hooks):
+                self._idle_hooks.append((fn, wake))
+
+    def unregister_idle(self, fn: Callable[[float], bool]) -> None:
+        with self._lock:
+            self._idle_hooks = [(f, w) for f, w in self._idle_hooks
+                                if f is not fn]
+
+    def _idle(self, budget: float) -> None:
+        with self._lock:
+            hooks = list(self._idle_hooks)
+        for fn, _ in hooks:
+            try:
+                if fn(budget):
+                    return
+            except Exception:  # idle is best-effort; never break a wait
+                continue
+        time.sleep(0)  # no hook blocked: yield the GIL / scheduler
 
     def progress(self) -> int:
         """One sweep over registered callbacks; returns events completed."""
@@ -69,9 +101,19 @@ class ProgressEngine:
 
     def notify_completion(self) -> None:
         """Wake sleeping waiters: a request completed (called from
-        Request._complete — the wait_sync 'signal' side)."""
+        Request._complete — the wait_sync 'signal' side). Also pokes
+        idle hooks' wake channels — the pumper may be parked on a
+        component primitive (DCN's condition variable) that this
+        completion would otherwise not touch."""
         with self._wait_cv:
             self._wait_cv.notify_all()
+        with self._lock:
+            wakes = [w for _, w in self._idle_hooks if w is not None]
+        for w in wakes:
+            try:
+                w()
+            except Exception:
+                pass
 
     def progress_until(
         self,
@@ -95,7 +137,7 @@ class ProgressEngine:
                 if deadline is not None and time.monotonic() >= deadline:
                     return False
                 if events == 0:
-                    time.sleep(0)  # yield the GIL / scheduler
+                    self._idle(0.001)
             else:
                 # someone else is pumping: sleep until a completion
                 # fires (bounded so a missed wakeup degrades to a tick)
@@ -122,3 +164,12 @@ def register(fn: ProgressFn, low_priority: bool = False) -> None:
 
 def unregister(fn: ProgressFn) -> None:
     ENGINE.unregister(fn)
+
+
+def register_idle(fn: Callable[[float], bool],
+                  wake: Callable[[], None] | None = None) -> None:
+    ENGINE.register_idle(fn, wake)
+
+
+def unregister_idle(fn: Callable[[float], bool]) -> None:
+    ENGINE.unregister_idle(fn)

@@ -143,6 +143,10 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
         P, ctypes.c_int, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(LL),
         ctypes.POINTER(LL),
     ]
+    lib.dcn_wait_event.restype = ctypes.c_int
+    lib.dcn_wait_event.argtypes = [P, ctypes.c_int]
+    lib.dcn_notify.restype = None
+    lib.dcn_notify.argtypes = [P]
     lib.dcn_read.restype = LL
     lib.dcn_read.argtypes = [P, LL, ctypes.c_void_p, LL]
     lib.dcn_poll_send.restype = LL

@@ -155,6 +155,15 @@ struct Ctx {
   // busy-polling — on small-core hosts a spinning poller steals the
   // very cycles the transport threads need.
   std::condition_variable cv;
+  // Teardown safety: waiters parked on `cv` (dcn_wait_event /
+  // dcn_wait_recv) are counted; dcn_destroy sets `closing`, wakes
+  // them, and drains the count before freeing the Ctx — otherwise a
+  // parked waiter would wake on a destroyed condition variable.
+  int waiters = 0;
+  bool closing = false;
+  // External poke channel (dcn_notify): lets the progress engine wake
+  // a parked idle waiter when a NON-DCN completion fires elsewhere.
+  int64_t poke_gen = 0;
   std::unordered_map<int, Link> links;  // fd -> link
   std::map<int, Peer> peers;            // peer id -> links
   int next_peer = 0;
@@ -315,9 +324,9 @@ void wake(Ctx* c) {
 // links: eager and control frames ride link 0 so same-peer eager
 // messages stay ordered (the reference gets ordering from ob1 sequence
 // numbers; pinning is the transport-level equivalent).
-void enqueue_frame(Ctx* c, int peer, OutFrame&& f) {
+int enqueue_frame(Ctx* c, int peer, OutFrame&& f) {
   auto it = c->peers.find(peer);
-  if (it == c->peers.end() || it->second.link_fds.empty()) return;
+  if (it == c->peers.end() || it->second.link_fds.empty()) return -1;
   Peer& p = it->second;
   int fd;
   if (f.hdr.kind == kFrag) {
@@ -348,6 +357,7 @@ void enqueue_frame(Ctx* c, int peer, OutFrame&& f) {
   }
   c->links[fd].outq.push_back(std::move(f));
   arm(c, fd, true);
+  return fd;
 }
 
 OutFrame make_frame(FrameKind k, int64_t msgid, int64_t tag,
@@ -614,8 +624,8 @@ void do_read(Ctx* c, int fd) {
   }
 }
 
-void do_write(Ctx* c, int fd) {
-  std::lock_guard<std::mutex> g(c->mu);
+// mu held. Drain a link's output queue until empty or EAGAIN.
+void flush_locked(Ctx* c, int fd) {
   auto lit = c->links.find(fd);
   if (lit == c->links.end()) return;
   Link& l = lit->second;
@@ -670,6 +680,11 @@ void do_write(Ctx* c, int fd) {
     l.outq.pop_front();
   }
   arm(c, fd, false);
+}
+
+void do_write(Ctx* c, int fd) {
+  std::lock_guard<std::mutex> g(c->mu);
+  flush_locked(c, fd);
 }
 
 // Hot path: one integer compare for data fds; the lock+scan only runs
@@ -961,14 +976,15 @@ long long dcn_send(void* vc, int peer, long long tag, const void* buf,
   m.peer = peer;
   m.tag = tag;
   m.total_len = len;
+  int wfd = -1;
   if (len <= c->eager_limit.load()) {
     // eager: the single owned copy lives in the frame itself — no
     // intermediate OutMsg staging buffer
     c->eager_sends++;
     c->inflight_out.emplace(id, std::move(m));
-    enqueue_frame(c, peer,
-                  make_frame(kEager, id, tag, len, 0,
-                             static_cast<const char*>(buf), len));
+    wfd = enqueue_frame(c, peer,
+                        make_frame(kEager, id, tag, len, 0,
+                                   static_cast<const char*>(buf), len));
   } else {
     // rendezvous: own one copy (the caller may free `buf` on return);
     // frags reference this buffer zero-copy until fully flushed
@@ -977,9 +993,13 @@ long long dcn_send(void* vc, int peer, long long tag, const void* buf,
     m.rndv = true;
     c->rndv_sends++;
     c->inflight_out.emplace(id, std::move(m));
-    enqueue_frame(c, peer,
-                  make_frame(kRndvReq, id, tag, len, 0, nullptr, 0));
+    wfd = enqueue_frame(c, peer,
+                        make_frame(kRndvReq, id, tag, len, 0, nullptr, 0));
   }
+  // Write-through (reference: btl_tcp tries the send from the caller
+  // before falling back to the event loop): skip one thread handoff —
+  // on small-core hosts each handoff is a scheduler quantum.
+  if (wfd >= 0) flush_locked(c, wfd);
   wake(c);
   return id;
 }
@@ -1000,20 +1020,22 @@ long long dcn_send_ref(void* vc, int peer, long long tag,
   m.peer = peer;
   m.tag = tag;
   m.total_len = len;
+  int wfd = -1;
   if (len <= c->eager_limit.load()) {
     c->eager_sends++;
     c->inflight_out.emplace(id, std::move(m));
-    enqueue_frame(c, peer,
-                  make_frame(kEager, id, tag, len, 0,
-                             static_cast<const char*>(buf), len));
+    wfd = enqueue_frame(c, peer,
+                        make_frame(kEager, id, tag, len, 0,
+                                   static_cast<const char*>(buf), len));
   } else {
     m.ext = static_cast<const char*>(buf);
     m.rndv = true;
     c->rndv_sends++;
     c->inflight_out.emplace(id, std::move(m));
-    enqueue_frame(c, peer,
-                  make_frame(kRndvReq, id, tag, len, 0, nullptr, 0));
+    wfd = enqueue_frame(c, peer,
+                        make_frame(kRndvReq, id, tag, len, 0, nullptr, 0));
   }
+  if (wfd >= 0) flush_locked(c, wfd);  // write-through, see dcn_send
   wake(c);
   return id;
 }
@@ -1046,6 +1068,41 @@ long long dcn_poll_recv(void* vc, int* peer, long long* tag,
   return pop_recv_locked(c, peer, tag, len);
 }
 
+// Park until ANY completion (recv / send / matched) is pending or the
+// timeout lapses, WITHOUT consuming anything — the progress engine's
+// idle hook: a blocked MPI wait sleeps here instead of spinning, and
+// the next progress() pass drains whatever fired. Returns 1 when
+// something is pending.
+int dcn_wait_event(void* vc, int timeout_ms) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::unique_lock<std::mutex> lk(c->mu);
+  if (c->closing) return 0;
+  int64_t gen = c->poke_gen;
+  auto ready = [&] {
+    return c->closing || c->poke_gen != gen || !c->recv_done.empty() ||
+           !c->send_done.empty() || !c->matched_done.empty();
+  };
+  if (ready()) return 1;
+  c->waiters++;
+  c->cv.wait_for(lk, std::chrono::milliseconds(timeout_ms), ready);
+  c->waiters--;
+  if (c->closing) {
+    c->cv.notify_all();  // unblock the destroy drain
+    return 0;
+  }
+  return ready() ? 1 : 0;
+}
+
+// Wake any parked dcn_wait_event waiter without queueing anything —
+// the progress engine pokes this when a non-DCN completion fires so a
+// blocked MPI wait is not quantized to the idle budget.
+void dcn_notify(void* vc) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  c->poke_gen++;
+  c->cv.notify_all();
+}
+
 // Blocking poll: park on the completion condition variable for up to
 // timeout_ms instead of spinning — on small-core hosts a busy-polling
 // caller steals the cycles the transport threads need (the reference's
@@ -1058,9 +1115,17 @@ long long dcn_wait_recv(void* vc, int timeout_ms, int* peer,
                   std::chrono::milliseconds(timeout_ms);
   for (;;) {
     long long receipt = pop_recv_locked(c, peer, tag, len);
-    if (receipt) return receipt;
-    if (c->cv.wait_until(lk, deadline) == std::cv_status::timeout)
+    if (receipt || c->closing) {
+      if (c->closing) c->cv.notify_all();
+      return receipt;
+    }
+    c->waiters++;
+    auto st = c->cv.wait_until(lk, deadline);
+    c->waiters--;
+    if (st == std::cv_status::timeout) {
+      if (c->closing) c->cv.notify_all();
       return pop_recv_locked(c, peer, tag, len);
+    }
   }
 }
 
@@ -1269,13 +1334,20 @@ void dcn_destroy(void* vc) {
   c->stop.store(true);
   wake(c);
   if (c->loop.joinable()) c->loop.join();
-  std::lock_guard<std::mutex> g(c->mu);
-  for (auto& [fd, l] : c->links) close(fd);
-  for (int lf : c->extra_listen) close(lf);
-  close(c->listen_fd);
-  close(c->wake_r);
-  close(c->wake_w);
-  close(c->epfd);
+  {
+    std::unique_lock<std::mutex> lk(c->mu);
+    // Drain parked cv waiters BEFORE freeing: a waiter waking on a
+    // destroyed condition variable / mutex is undefined behavior.
+    c->closing = true;
+    c->cv.notify_all();
+    while (c->waiters > 0) c->cv.wait(lk);
+    for (auto& [fd, l] : c->links) close(fd);
+    for (int lf : c->extra_listen) close(lf);
+    close(c->listen_fd);
+    close(c->wake_r);
+    close(c->wake_w);
+    close(c->epfd);
+  }  // unlock before delete — the guard must not unlock freed memory
   delete c;
 }
 

@@ -544,10 +544,26 @@ class FabricEngine:
                                   dst_proc.device)
         return unpack_value(payload_bytes, device=dst_proc.device)
 
+    def idle_wait(self, budget: float) -> bool:
+        """Progress-engine idle hook: when a blocked wait's sweep found
+        nothing to do, park on the DCN engine's completion condition
+        variable instead of spinning (on small-core hosts the spinner
+        starves the transport threads and cross-process latency
+        degrades to scheduler quanta). Only engages once wired — pure
+        in-process programs keep the spin-yield behavior."""
+        if not self.peer_ids:
+            return False
+        wait = getattr(self.ep, "wait_event", None)
+        if wait is None:
+            return False
+        wait(budget)
+        return True
+
     # -- teardown ----------------------------------------------------------
 
     def close(self) -> None:
         _progress.unregister(self.progress)
+        _progress.unregister_idle(self.idle_wait)
         self.ep.close()
 
 
@@ -587,6 +603,8 @@ def wire_up(*, endpoint=None, timeout_s: float = 60.0,
     ob1.attach_fabric(engine)
     engine.attach_pml(ob1)
     _progress.register(engine.progress)
+    _progress.register_idle(engine.idle_wait,
+                            wake=getattr(ep, "notify", None))
     # Re-run coll selection on live comms: components gated on fabric
     # availability (coll/hier for spanning comms) become selectable now
     # (the reference's comm_select runs after add_procs+modex for the
