@@ -248,6 +248,8 @@ class DcnEndpoint:
         """Park until ANY engine completion (recv/send/matched) is
         pending or `timeout` seconds lapse, consuming nothing — the
         progress engine's idle hook. True when something fired."""
+        if self._closed:
+            return False
         ms = max(1, int(timeout * 1000))
         return bool(self._lib.dcn_wait_event(self._ctx, ms))
 

@@ -41,6 +41,7 @@ class ProgressEngine:
         self._wait_cv = threading.Condition()
         # (hook, wake) pairs; wake pokes a parked hook from outside
         self._idle_hooks: list[tuple] = []
+        self._parked = 0  # threads currently inside _idle
 
     def register(self, fn: ProgressFn, low_priority: bool = False) -> None:
         with self._lock:
@@ -66,24 +67,30 @@ class ProgressEngine:
         small-core hosts where the spinner starves the transport threads
         (reference analog: opal_progress's sched_yield idle path)."""
         with self._lock:
-            if all(f is not fn for f, _ in self._idle_hooks):
+            # == (not `is`): bound methods are fresh objects per access
+            if all(f != fn for f, _ in self._idle_hooks):
                 self._idle_hooks.append((fn, wake))
 
     def unregister_idle(self, fn: Callable[[float], bool]) -> None:
         with self._lock:
             self._idle_hooks = [(f, w) for f, w in self._idle_hooks
-                                if f is not fn]
+                                if f != fn]
 
     def _idle(self, budget: float) -> None:
         with self._lock:
             hooks = list(self._idle_hooks)
-        for fn, _ in hooks:
-            try:
-                if fn(budget):
-                    return
-            except Exception:  # idle is best-effort; never break a wait
-                continue
-        time.sleep(0)  # no hook blocked: yield the GIL / scheduler
+            self._parked += 1
+        try:
+            for fn, _ in hooks:
+                try:
+                    if fn(budget):
+                        return
+                except Exception:  # best-effort; never break a wait
+                    continue
+            time.sleep(0)  # no hook blocked: yield the GIL / scheduler
+        finally:
+            with self._lock:
+                self._parked -= 1
 
     def progress(self) -> int:
         """One sweep over registered callbacks; returns events completed."""
@@ -107,13 +114,18 @@ class ProgressEngine:
         completion would otherwise not touch."""
         with self._wait_cv:
             self._wait_cv.notify_all()
-        with self._lock:
-            wakes = [w for _, w in self._idle_hooks if w is not None]
-        for w in wakes:
-            try:
-                w()
-            except Exception:
-                pass
+        # Poke parked idle hooks only when someone is actually parked —
+        # the unguarded fan-out would pay a native mutex + notify per
+        # request completion on the hot path (racy read: a missed wake
+        # degrades to the idle budget, ~1 ms, never a hang).
+        if self._parked:
+            with self._lock:
+                wakes = [w for _, w in self._idle_hooks if w is not None]
+            for w in wakes:
+                try:
+                    w()
+                except Exception:
+                    pass
 
     def progress_until(
         self,

@@ -59,6 +59,12 @@ P2P_TAG = 0x4D504950
 #: analog — reference: btl_sm_fbox.h:22-60, 4 KiB fastbox;
 #: mca_pml_ob1_send_inline -> btl_sendi, pml_ob1_isend.c:246)
 P2P_FAST_TAG = 0x4D504946
+#: DCN frame tag for rendezvous DATA segments ("MPID"): fixed binary
+#: header + raw payload slice, assembled into a preallocated buffer on
+#: the receiver — no per-segment dss dict on either side (the FRAG
+#: analog of the fastbox; reference: ob1 schedules RNDV FRAGs as raw
+#: chunks, pml_ob1_sendreq.h:385-455)
+P2P_DATA_TAG = 0x4D504944
 
 K_EAGER = 1  # envelope + payload (ob1 MATCH)
 K_RTS = 2    # envelope only (ob1 RNDV)
@@ -96,6 +102,12 @@ _FAST_MAGIC = 0x4FA57B0C
 #: dtype 8s | shape 6i
 _FAST_HDR = struct.Struct("<IiiiiqB8s6i")
 _FAST_MAX_DIMS = 6
+
+
+#: magic u32 | cid i32 | src i32 | dst i32 | tag i32 | seq q |
+#: rawlen q | off q | segs i | si i
+_DATA_HDR = struct.Struct("<Iiiiiqqqii")
+_DATA_MAGIC = 0x4FA57B0D
 
 
 def _fast_eligible(value, limit: int):
@@ -346,6 +358,17 @@ class FabricEngine:
             if tag == P2P_FAST_TAG:
                 self._dispatch(self._peer_index(peer), decode_fast(raw))
                 SPC.record("fabric_fast_recvs")
+            elif tag == P2P_DATA_TAG:
+                src_idx = self._peer_index(peer)
+                try:
+                    self._on_data_raw(src_idx, raw)
+                except FabricError as exc:
+                    hdr = _DATA_HDR.unpack_from(raw)
+                    if hdr[0] != _DATA_MAGIC:
+                        raise  # untrusted header: never route by it
+                    shim = {"k": K_DATA, "cid": hdr[1], "seq": hdr[5]}
+                    if not self._route_error(src_idx, shim, exc):
+                        raise
             elif tag == P2P_TAG:
                 self._dispatch(self._peer_index(peer),
                                dss.unpack_one(raw))
@@ -498,23 +521,29 @@ class FabricEngine:
         # same way, pml_ob1_sendreq.h:385-455): bounded per-message DCN
         # frames, progressive arrival on the receiver, and a transfer
         # counter that moves per segment instead of one giant blob.
+        # Raw binary frames (fixed header + payload slice) — the dss
+        # dict-per-segment path cost two extra full-payload copies plus
+        # per-segment parse work on the receiver.
         raw = pack_value(value)
+        view = memoryview(raw)
         seg = max(1, int(_segment_var.value))
         n_seg = max(1, -(-len(raw) // seg))
         for si in range(n_seg):
-            self._send(src_idx, {
-                "k": K_DATA, "cid": msg["cid"], "seq": msg["seq"],
-                "src": msg["src"], "dst": msg["dst"], "tag": msg["tag"],
-                "nb": msg["nb"], "segs": n_seg, "si": si,
-                "pay": raw[si * seg:(si + 1) * seg],
-            })
+            off = si * seg
+            frame = bytearray(_DATA_HDR.pack(
+                _DATA_MAGIC, msg["cid"], msg["src"], msg["dst"],
+                msg["tag"], msg["seq"], len(raw), off, n_seg, si,
+            ))
+            frame += view[off:off + seg]  # single payload copy
+            self._send_raw(src_idx, P2P_DATA_TAG, frame)
             SPC.record("fabric_data_segments_sent")
 
     def _on_data(self, src_idx: int, msg: dict) -> None:
-        """A rendezvous payload segment arrived. Segments of one message
-        reassemble by index (striped DCN links may reorder them); the
-        recv completes when the last lands — ob1's FRAG accounting via
-        bytes_received (pml_ob1_recvreq)."""
+        """A rendezvous payload segment arrived (dss-framed legacy
+        shape). Segments of one message reassemble by index (striped
+        DCN links may reorder them); the recv completes when the last
+        lands — ob1's FRAG accounting via bytes_received
+        (pml_ob1_recvreq)."""
         key = (src_idx, msg["cid"], msg["seq"])
         n_seg = int(msg.get("segs", 1))
         si = int(msg.get("si", 0))
@@ -533,6 +562,38 @@ class FabricEngine:
             self._await_data.pop(key, None)
         raw = b"".join(parts[i] for i in range(n_seg))
         value = unpack_value(raw, device=pending.dst_proc.device)
+        req._matched(pending.env, value)
+        SPC.record("fabric_rndv_delivered")
+
+    def _on_data_raw(self, src_idx: int, raw) -> None:
+        """Raw-framed DATA segment: fixed header + payload slice,
+        written straight into a preallocated assembly buffer (no dss
+        parse, no join — the per-segment fast path)."""
+        (magic, cid, src, dst, tag, seq, rawlen, off, segs,
+         si) = _DATA_HDR.unpack_from(raw)
+        if magic != _DATA_MAGIC:
+            raise FabricError(f"bad DATA-frame magic {magic:#x}")
+        key = (src_idx, cid, seq)
+        with self._lock:
+            entry = self._await_data.get(key)
+            if entry is None:
+                raise FabricError(
+                    f"DATA without a matched recv (cid={cid} seq={seq})"
+                )
+            req, pending, state = entry
+            buf = state.get("buf")
+            if buf is None:
+                buf = state["buf"] = bytearray(rawlen)
+                state["got"] = 0
+            payload = memoryview(raw)[_DATA_HDR.size:]
+            buf[off:off + len(payload)] = payload
+            state["got"] += 1
+            SPC.record("fabric_data_segments_recvd")
+            if state["got"] < segs:
+                return
+            self._await_data.pop(key, None)
+        value = unpack_value(bytes(buf),
+                             device=pending.dst_proc.device)
         req._matched(pending.env, value)
         SPC.record("fabric_rndv_delivered")
 
