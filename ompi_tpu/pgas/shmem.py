@@ -158,6 +158,81 @@ class ShmemContext:
         self.quiet(sym)
         sym._win._set_array(self.comm.allreduce(sym._win.array, op))
 
+    def alltoall(self, sym: SymmetricArray):
+        """shmem_alltoall: block slice j of PE i lands as slice i of
+        PE j (block leading dim must be n_pes). Reference:
+        oshmem scoll alltoall, delegating to the comm's vtable like
+        scoll/mpi (scoll_mpi_ops.c)."""
+        if sym.block_shape[0] != self.comm.size:
+            raise ArgumentError(
+                f"shmem alltoall needs block leading dim {self.comm.size}"
+                f", got {sym.block_shape}"
+            )
+        self.quiet(sym)
+        sym._win._set_array(self.comm.alltoall(sym._win.array))
+
+    # -- point-to-point sync + locks (reference: shmem_wait_until /
+    #    shmem_lock.c) ------------------------------------------------------
+
+    _CMPS = {
+        "eq": lambda a, b: a == b, "ne": lambda a, b: a != b,
+        "gt": lambda a, b: a > b, "ge": lambda a, b: a >= b,
+        "lt": lambda a, b: a < b, "le": lambda a, b: a <= b,
+    }
+
+    def wait_until(self, sym: SymmetricArray, pe: int, cmp: str,
+                   value, index=None, timeout: float = 60.0) -> None:
+        """shmem_wait_until: block until PE `pe`'s LOCAL variable
+        satisfies `cmp` against `value`, pumping the progress engine so
+        cross-controller puts/atomics can land while waiting."""
+        import numpy as np
+
+        from ..core import progress as _progress
+
+        fn = self._CMPS.get(cmp)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown comparison {cmp!r}; known: {sorted(self._CMPS)}"
+            )
+
+        def satisfied() -> bool:
+            blk = np.asarray(sym.local(pe))
+            probe = blk if index is None else blk[index]
+            return bool(np.all(fn(probe, value)))
+
+        if not _progress.ENGINE.progress_until(satisfied, timeout):
+            raise TimeoutError(
+                f"shmem wait_until({cmp}, {value!r}) timed out"
+            )
+
+    def set_lock(self, lock: SymmetricArray,
+                 timeout: float = 60.0) -> None:
+        """shmem_set_lock: acquire the distributed lock — a symmetric
+        scalar on PE 0 taken by atomic compare-and-swap (the reference
+        implements MCS queue locks over the same atomics,
+        shmem_lock.c; test-and-set with progress-pumped retry keeps the
+        identical acquire/release semantics). Each predicate evaluation
+        is one acquire attempt; between attempts the wait parks on the
+        progress engine's idle path instead of hot-spinning."""
+        from ..core import progress as _progress
+
+        if not _progress.ENGINE.progress_until(
+            lambda: self.test_lock(lock), timeout
+        ):
+            raise TimeoutError("shmem set_lock timed out")
+
+    def test_lock(self, lock: SymmetricArray) -> bool:
+        """shmem_test_lock: one acquire attempt; True on success."""
+        import numpy as np
+
+        prev = self.atomic_compare_swap(lock, 0, 1, pe=0)
+        return int(np.asarray(prev).ravel()[0]) == 0
+
+    def clear_lock(self, lock: SymmetricArray) -> None:
+        """shmem_clear_lock: complete outstanding puts, then release."""
+        self.quiet()
+        self.atomic_swap(lock, 0, pe=0)
+
 
 def init(comm=None) -> ShmemContext:
     """shmem_init: PGAS world over a communicator (default COMM_WORLD)."""

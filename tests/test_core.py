@@ -291,3 +291,83 @@ class TestOpsDevice:
             np.asarray(ops.reduce_ranks(stacked, "sum")),
             np.asarray(stacked).sum(0), rtol=1e-5,
         )
+
+
+class TestIdleHooks:
+    """Progress-engine idle hooks (the DCN park-instead-of-spin path)."""
+
+    def test_register_dedupe_unregister_by_equality(self):
+        """Bound methods are fresh objects per attribute access; hook
+        bookkeeping must use equality or close() leaks the hook (and a
+        leaked hook outlives its native context — a use-after-free)."""
+        from ompi_tpu.core import progress as prog
+
+        class H:
+            def hook(self, budget):
+                return False
+
+        h = H()
+        before = len(prog.ENGINE._idle_hooks)
+        prog.register_idle(h.hook)
+        prog.register_idle(h.hook)  # dedupe across fresh bound objects
+        assert len(prog.ENGINE._idle_hooks) == before + 1
+        prog.unregister_idle(h.hook)
+        assert len(prog.ENGINE._idle_hooks) == before
+
+    def test_idle_called_only_on_zero_event_sweeps(self):
+        from ompi_tpu.core import progress as prog
+
+        calls = []
+
+        def hook(budget):
+            calls.append(budget)
+            return True
+
+        prog.register_idle(hook)
+        try:
+            flag = {"done": False}
+
+            def pump():
+                # one event first (idle skipped), then zero-event sweeps
+                flag["n"] = flag.get("n", 0) + 1
+                if flag["n"] >= 3:
+                    flag["done"] = True
+                return 1 if flag["n"] == 1 else 0
+
+            prog.register(pump)
+            try:
+                ok = prog.ENGINE.progress_until(
+                    lambda: flag["done"], timeout=5.0
+                )
+            finally:
+                prog.unregister(pump)
+            assert ok
+            assert len(calls) >= 1          # idled on a zero-event sweep
+            assert all(b > 0 for b in calls)
+        finally:
+            prog.unregister_idle(hook)
+
+    def test_failing_hook_never_breaks_a_wait(self):
+        from ompi_tpu.core import progress as prog
+
+        def bad(budget):
+            raise RuntimeError("boom")
+
+        prog.register_idle(bad)
+        try:
+            flag = {"n": 0}
+
+            def pump():
+                flag["n"] += 1
+                return 0
+
+            prog.register(pump)
+            try:
+                ok = prog.ENGINE.progress_until(
+                    lambda: flag["n"] >= 3, timeout=5.0
+                )
+            finally:
+                prog.unregister(pump)
+            assert ok
+        finally:
+            prog.unregister_idle(bad)

@@ -195,3 +195,48 @@ class TestShmem:
         for pe in range(ctx.n_pes):
             np.testing.assert_array_equal(got[pe], [1.0, 2.0, 3.0])
         ctx.free(x)
+
+    def test_alltoall(self, world):
+        ctx = pgas.init(world)
+        n = ctx.n_pes
+        x = ctx.malloc((n, 2), "float32")
+        for pe in range(n):
+            # slice j of PE pe carries (pe, j)
+            block = np.stack([
+                np.asarray([pe, j], np.float32) for j in range(n)
+            ])
+            ctx.put(x, block, pe=pe)
+        ctx.alltoall(x)
+        got = np.asarray(x.array)
+        for pe in range(n):
+            for j in range(n):
+                # PE pe's slice j now holds PE j's slice pe = (j, pe)
+                np.testing.assert_array_equal(got[pe, j], [j, pe])
+        ctx.free(x)
+
+    def test_wait_until(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((1,), "int32")
+        ctx.put(x, np.asarray([7], np.int32), pe=1)
+        ctx.quiet()  # SHMEM: delivery guaranteed only after quiet/fence
+        ctx.wait_until(x, pe=1, cmp="ge", value=7, timeout=10)
+        ctx.wait_until(x, pe=1, cmp="eq", value=7, index=0, timeout=10)
+        with pytest.raises(TimeoutError):
+            ctx.wait_until(x, pe=1, cmp="lt", value=0, timeout=0.2)
+        from ompi_tpu.core.errors import ArgumentError
+
+        with pytest.raises(ArgumentError):
+            ctx.wait_until(x, pe=1, cmp="bogus", value=0)
+        ctx.free(x)
+
+    def test_distributed_lock(self, world):
+        ctx = pgas.init(world)
+        lk = ctx.malloc((1,), "int64")
+        ctx.set_lock(lk)
+        assert not ctx.test_lock(lk)          # held: second acquire fails
+        with pytest.raises(TimeoutError):
+            ctx.set_lock(lk, timeout=0.2)     # blocked acquire times out
+        ctx.clear_lock(lk)
+        assert ctx.test_lock(lk)              # free again: test acquires
+        ctx.clear_lock(lk)
+        ctx.free(lk)
