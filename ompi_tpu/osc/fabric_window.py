@@ -689,10 +689,21 @@ class FabricWindow:
                 self._collect_replies([s], -1)
 
     def free(self) -> None:
+        if self._freed:
+            return  # idempotent: a second free must not re-enter the
+                    # collective barrier (no peer would match it)
         if self._remote_pending or any(self._result_slots.values()):
             raise RMASyncError(
                 f"{self.name}: free with pending remote ops"
             )
+        # MPI_Win_free is collective WITH barrier semantics: every
+        # controller must stay alive (and pumping) until its peers'
+        # final epoch-release requests are serviced — without this, the
+        # first controller to finish its own unlocks exits and a peer's
+        # in-flight unlock waits on a dead process (a shutdown race hit
+        # by the 2-process SHMEM drill). The barrier rides p2p, so
+        # waiting in it services peers' remaining window traffic.
+        self.comm.barrier()
         _progress.unregister(self._handle_arrivals)
         self._freed = True
         self._inner._pending.clear()

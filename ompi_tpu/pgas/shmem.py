@@ -214,11 +214,23 @@ class ShmemContext:
         identical acquire/release semantics). Each predicate evaluation
         is one acquire attempt; between attempts the wait parks on the
         progress engine's idle path instead of hot-spinning."""
+        import time as _time
+
         from ..core import progress as _progress
 
-        if not _progress.ENGINE.progress_until(
-            lambda: self.test_lock(lock), timeout
-        ):
+        # Rate-limit the remote CAS attempts (progress_until evaluates
+        # its predicate more than once per sweep; an attempt per call
+        # would double the PE-0 round trips — test-and-set with backoff)
+        state = {"next": 0.0}
+
+        def attempt() -> bool:
+            now = _time.monotonic()
+            if now < state["next"]:
+                return False
+            state["next"] = now + 0.002
+            return self.test_lock(lock)
+
+        if not _progress.ENGINE.progress_until(attempt, timeout):
             raise TimeoutError("shmem set_lock timed out")
 
     def test_lock(self, lock: SymmetricArray) -> bool:
