@@ -233,6 +233,8 @@ class DcnEndpoint:
         tag = ctypes.c_longlong(0)
         length = ctypes.c_longlong(0)
         while True:
+            if self._closed:
+                raise DcnError("endpoint closed during recv")
             remaining = deadline - time.monotonic()
             slice_ms = max(1, min(100, int(remaining * 1000)))
             msgid = self._lib.dcn_wait_recv(

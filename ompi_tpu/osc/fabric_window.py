@@ -692,10 +692,8 @@ class FabricWindow:
         if self._freed:
             return  # idempotent: a second free must not re-enter the
                     # collective barrier (no peer would match it)
-        if self._remote_pending or any(self._result_slots.values()):
-            raise RMASyncError(
-                f"{self.name}: free with pending remote ops"
-            )
+        pending = bool(self._remote_pending
+                       or any(self._result_slots.values()))
         # MPI_Win_free is collective WITH barrier semantics: every
         # controller must stay alive (and pumping) until its peers'
         # final epoch-release requests are serviced — without this, the
@@ -703,7 +701,15 @@ class FabricWindow:
         # in-flight unlock waits on a dead process (a shutdown race hit
         # by the 2-process SHMEM drill). The barrier rides p2p, so
         # waiting in it services peers' remaining window traffic.
+        # Participate in the barrier even on the pending-ops error path:
+        # raising BEFORE it would leave every peer blocked against a
+        # rank that never arrives — one rank's usage error must surface
+        # locally, not as a distributed hang.
         self.comm.barrier()
+        if pending:
+            raise RMASyncError(
+                f"{self.name}: free with pending remote ops"
+            )
         _progress.unregister(self._handle_arrivals)
         self._freed = True
         self._inner._pending.clear()

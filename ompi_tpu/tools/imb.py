@@ -25,7 +25,8 @@ from dataclasses import dataclass
 import numpy as np
 
 OPS = ("allreduce", "bcast", "reduce", "allgather",
-       "reduce_scatter_block", "alltoall", "barrier")
+       "reduce_scatter_block", "alltoall", "barrier",
+       "gather", "scatter", "scan", "exscan")
 
 
 @dataclass
@@ -57,6 +58,8 @@ def _traffic_bytes(op: str, nbytes: int, n: int) -> float:
         return (n - 1) / n * nbytes
     if op == "reduce_scatter_block":
         return (n - 1) / n * nbytes
+    if op in ("gather", "scatter", "scan", "exscan"):
+        return nbytes
     return 0.0
 
 
@@ -69,8 +72,8 @@ def run_one(comm, op: str, nbytes: int, iters: int) -> Row:
         if op == "barrier":
             comm.barrier()
             return None
-        if op in ("bcast", "reduce"):
-            return getattr(comm, op)(x)
+        if op in ("gather", "scatter"):
+            return getattr(comm, op)(x, root=0)
         return getattr(comm, op)(x)
 
     out = call()  # warmup/compile

@@ -40,6 +40,17 @@ def test_imb_alltoall_buffer_shape():
     assert row.op == "alltoall" and row.min_us > 0
 
 
+def test_imb_rooted_and_prefix_ops():
+    """The sweep covers the full comm surface: rooted (gather/scatter)
+    and prefix (scan/exscan) operations produce timed rows too."""
+    from ompi_tpu.tools import imb
+
+    comm = mt.world()
+    for op in ("gather", "scatter", "scan", "exscan"):
+        row = imb.run_one(comm, op, 512, iters=1)
+        assert row.op == op and row.min_us > 0, row
+
+
 def test_imb_cli_rejects_bad_op():
     from ompi_tpu.tools import imb
 
