@@ -76,6 +76,7 @@ class DcnEndpoint:
         # claimable by explicit pollers (an int per unclaimed send —
         # negligible next to the payloads; cleared on close()).
         self._pending_send_done: deque[int] = deque()
+        self._inflight_waits = 0  # threads inside a native blocking wait
         self._closed = False
 
     # -- wiring ------------------------------------------------------------
@@ -233,14 +234,21 @@ class DcnEndpoint:
         tag = ctypes.c_longlong(0)
         length = ctypes.c_longlong(0)
         while True:
-            if self._closed:
-                raise DcnError("endpoint closed during recv")
             remaining = deadline - time.monotonic()
             slice_ms = max(1, min(100, int(remaining * 1000)))
-            msgid = self._lib.dcn_wait_recv(
-                self._ctx, slice_ms, ctypes.byref(peer),
-                ctypes.byref(tag), ctypes.byref(length),
-            )
+            # Increment-then-check: close() sets _closed BEFORE waiting
+            # for inflight waits to drain, so either we see _closed here
+            # or close() sees our increment and waits for this call.
+            self._inflight_waits += 1
+            try:
+                if self._closed:
+                    raise DcnError("endpoint closed during recv")
+                msgid = self._lib.dcn_wait_recv(
+                    self._ctx, slice_ms, ctypes.byref(peer),
+                    ctypes.byref(tag), ctypes.byref(length),
+                )
+            finally:
+                self._inflight_waits -= 1
             if msgid:
                 return self._consume_receipt(msgid, peer, tag, length)
             if time.monotonic() >= deadline:
@@ -250,10 +258,14 @@ class DcnEndpoint:
         """Park until ANY engine completion (recv/send/matched) is
         pending or `timeout` seconds lapse, consuming nothing — the
         progress engine's idle hook. True when something fired."""
-        if self._closed:
-            return False
         ms = max(1, int(timeout * 1000))
-        return bool(self._lib.dcn_wait_event(self._ctx, ms))
+        self._inflight_waits += 1
+        try:
+            if self._closed:
+                return False
+            return bool(self._lib.dcn_wait_event(self._ctx, ms))
+        finally:
+            self._inflight_waits -= 1
 
     def notify(self) -> None:
         """Wake a parked wait_event waiter (the progress engine pokes
@@ -386,11 +398,22 @@ class DcnEndpoint:
         }
 
     def close(self) -> None:
-        if not self._closed:
-            self._lib.dcn_destroy(self._ctx)
-            self._send_refs.clear()
-            self._pending_send_done.clear()
-            self._closed = True
+        if self._closed:
+            return
+        # Order matters: flag first (new waiters bounce), wake parked
+        # ones (the C-side drain handles threads already inside), then
+        # wait for in-flight native calls to return before freeing.
+        self._closed = True
+        try:
+            self._lib.dcn_notify(self._ctx)
+        except Exception:
+            pass
+        deadline = time.monotonic() + 5.0
+        while self._inflight_waits and time.monotonic() < deadline:
+            time.sleep(0.001)
+        self._lib.dcn_destroy(self._ctx)
+        self._send_refs.clear()
+        self._pending_send_done.clear()
 
     def __del__(self) -> None:
         try:

@@ -706,15 +706,19 @@ class FabricWindow:
         # rank that never arrives — one rank's usage error must surface
         # locally, not as a distributed hang.
         self.comm.barrier()
-        if pending:
-            raise RMASyncError(
-                f"{self.name}: free with pending remote ops"
-            )
+        # Tear down unconditionally: the barrier has completed, so no
+        # peer will ever match another one — a retried free() after the
+        # pending-ops error below must hit the idempotency guard, not
+        # re-enter an unmatchable barrier.
         _progress.unregister(self._handle_arrivals)
         self._freed = True
         self._inner._pending.clear()
         self._inner._sync = SyncType.NONE
         self._inner.free()
+        if pending:
+            raise RMASyncError(
+                f"{self.name}: free with pending remote ops"
+            )
 
     def __repr__(self) -> str:
         return (

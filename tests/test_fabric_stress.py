@@ -180,10 +180,12 @@ def test_dcn_threaded_bidirectional_mixed_sizes():
             errors.append(("recv", side, exc))
 
     threads = [
-        threading.Thread(target=sender, args=(a, pid_ab, "ab")),
-        threading.Thread(target=sender, args=(b, pid_ba, "ba")),
-        threading.Thread(target=receiver, args=(b, "ab")),
-        threading.Thread(target=receiver, args=(a, "ba")),
+        threading.Thread(target=sender, args=(a, pid_ab, "ab"),
+                         daemon=True),
+        threading.Thread(target=sender, args=(b, pid_ba, "ba"),
+                         daemon=True),
+        threading.Thread(target=receiver, args=(b, "ab"), daemon=True),
+        threading.Thread(target=receiver, args=(a, "ba"), daemon=True),
     ]
     for t in threads:
         t.start()

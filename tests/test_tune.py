@@ -90,6 +90,36 @@ def test_tune_new_decision_spaces():
             assert rule["algorithm"] in space, (opname, rule)
 
 
+def test_decide_defaults_mirror_reference_cutoffs():
+    """The fixed decision rules (no forced var, no rules file) follow
+    the reference's shape: small commutative reduces go binomial when
+    the native path is disabled, reduce_scatter picks recursive halving
+    only for small commutative power-of-two cases, ordered-required ops
+    always route native, and scatter defaults native unconditionally."""
+    from ompi_tpu import ops
+    from ompi_tpu.coll import tuned
+
+    config.set("coll_tuned_prefer_native", False)
+    try:
+        s = ops.lookup("sum")
+        assert tuned.decide_reduce(s, 1024, 8) == "binomial"
+        assert tuned.decide_reduce(s, 1 << 20, 8) == "native"
+        assert tuned.decide_reduce_scatter(s, 1024, 8) == \
+            "recursive_halving"
+        assert tuned.decide_reduce_scatter(s, 1024, 6) == "ring"  # !pof2
+        assert tuned.decide_reduce_scatter(s, 1 << 20, 8) == "ring"
+        maxloc = ops.lookup("maxloc")  # joint op: ordered path only
+        assert tuned.decide_reduce_scatter(maxloc, 1024, 8) == "native"
+        assert tuned.decide_gather(1024, 8) == "binomial"
+        assert tuned.decide_gather(1 << 20, 8) == "native"
+        assert tuned.decide_gather(1024, 2) == "native"  # tiny comm
+        assert tuned.decide_scatter(1024, 8) == "native"
+    finally:
+        config.set("coll_tuned_prefer_native", True)
+    # with prefer_native on (default), native wins for xla-reducible ops
+    assert tuned.decide_reduce(ops.lookup("sum"), 1024, 8) == "native"
+
+
 def test_tune_cli(tmp_path):
     from ompi_tpu.tools import tune
 
