@@ -76,7 +76,10 @@ class DcnEndpoint:
         # claimable by explicit pollers (an int per unclaimed send —
         # negligible next to the payloads; cleared on close()).
         self._pending_send_done: deque[int] = deque()
+        import threading
+
         self._inflight_waits = 0  # threads inside a native blocking wait
+        self._wait_mu = threading.Lock()  # guards the count + closing
         self._closed = False
 
     # -- wiring ------------------------------------------------------------
@@ -236,19 +239,21 @@ class DcnEndpoint:
         while True:
             remaining = deadline - time.monotonic()
             slice_ms = max(1, min(100, int(remaining * 1000)))
-            # Increment-then-check: close() sets _closed BEFORE waiting
-            # for inflight waits to drain, so either we see _closed here
-            # or close() sees our increment and waits for this call.
-            self._inflight_waits += 1
-            try:
+            # Register-then-call under the lock: close() flips _closed
+            # under the same lock, so either we observe it here or
+            # close() observes our registration and drains this call.
+            with self._wait_mu:
                 if self._closed:
                     raise DcnError("endpoint closed during recv")
+                self._inflight_waits += 1
+            try:
                 msgid = self._lib.dcn_wait_recv(
                     self._ctx, slice_ms, ctypes.byref(peer),
                     ctypes.byref(tag), ctypes.byref(length),
                 )
             finally:
-                self._inflight_waits -= 1
+                with self._wait_mu:
+                    self._inflight_waits -= 1
             if msgid:
                 return self._consume_receipt(msgid, peer, tag, length)
             if time.monotonic() >= deadline:
@@ -256,16 +261,19 @@ class DcnEndpoint:
 
     def wait_event(self, timeout: float) -> bool:
         """Park until ANY engine completion (recv/send/matched) is
-        pending or `timeout` seconds lapse, consuming nothing — the
-        progress engine's idle hook. True when something fired."""
-        ms = max(1, int(timeout * 1000))
-        self._inflight_waits += 1
-        try:
+        pending or up to ~200 ms lapse (each call parks one bounded
+        slice so close() can drain waiters promptly — loop for longer
+        waits), consuming nothing. True when something fired."""
+        ms = max(1, min(200, int(timeout * 1000)))
+        with self._wait_mu:
             if self._closed:
                 return False
+            self._inflight_waits += 1
+        try:
             return bool(self._lib.dcn_wait_event(self._ctx, ms))
         finally:
-            self._inflight_waits -= 1
+            with self._wait_mu:
+                self._inflight_waits -= 1
 
     def notify(self) -> None:
         """Wake a parked wait_event waiter (the progress engine pokes
@@ -398,19 +406,38 @@ class DcnEndpoint:
         }
 
     def close(self) -> None:
-        if self._closed:
-            return
-        # Order matters: flag first (new waiters bounce), wake parked
-        # ones (the C-side drain handles threads already inside), then
-        # wait for in-flight native calls to return before freeing.
-        self._closed = True
+        # Order matters: flag first under the lock (new waiters bounce,
+        # a racing close returns), wake parked ones (the C-side drain
+        # handles threads already inside), then wait for in-flight
+        # native calls to return before freeing.
+        with self._wait_mu:
+            if self._closed:
+                return
+            self._closed = True
         try:
             self._lib.dcn_notify(self._ctx)
         except Exception:
             pass
+        # Every wait parks in bounded slices (<=200 ms), so this drain
+        # deadline is real; if a waiter still hasn't returned, LEAK the
+        # native context instead of freeing memory under its feet.
         deadline = time.monotonic() + 5.0
-        while self._inflight_waits and time.monotonic() < deadline:
+        while time.monotonic() < deadline:
+            with self._wait_mu:
+                if self._inflight_waits == 0:
+                    break
             time.sleep(0.001)
+        else:
+            pass
+        with self._wait_mu:
+            drained = self._inflight_waits == 0
+        if not drained:
+            logger.warning(
+                "dcn close: %d native wait(s) did not drain; leaking "
+                "the context rather than freeing it mid-call",
+                self._inflight_waits,
+            )
+            return
         self._lib.dcn_destroy(self._ctx)
         self._send_refs.clear()
         self._pending_send_done.clear()

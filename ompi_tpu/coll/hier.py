@@ -537,6 +537,34 @@ def _np_from(raw: bytes) -> np.ndarray:
     return np.load(io.BytesIO(raw), allow_pickle=False)
 
 
+def _np_list_bytes(arrs) -> bytes:
+    """Wire form for a LIST of (possibly ragged) arrays — the v-variant
+    payloads, where every block carries its own shape/dtype."""
+    import io
+
+    bio = io.BytesIO()
+    np.savez(bio, *[np.ascontiguousarray(np.asarray(a)) for a in arrs])
+    return bio.getvalue()
+
+
+def _np_list_from(raw: bytes) -> list[np.ndarray]:
+    import io
+
+    with np.load(io.BytesIO(raw), allow_pickle=False) as z:
+        return [z[f"arr_{i}"] for i in range(len(z.files))]
+
+
+def _concat_global_order(h, parts: dict) -> np.ndarray:
+    """Concatenate per-slice block lists in GLOBAL rank order (member
+    lists are rank-sorted per slice but interleave across slices)."""
+    order = sorted(
+        ((r, parts[s][i]) for s, ranks in enumerate(h.members)
+         for i, r in enumerate(ranks)),
+        key=lambda t: t[0],
+    )
+    return np.concatenate([p for _, p in order], axis=0)
+
+
 def _hier_op(fn):
     """Wrap a HierColl exchange method with the epoch/abort protocol."""
     import functools
@@ -563,9 +591,10 @@ class _HierDataOps:
     def allgather(self, comm, h, tag, x):
         x = h.local_rank_major(x)
         arr = np.asarray(x)
+        raw = _np_bytes(arr)  # identical for every destination
         for s in range(h.n_slices):
             if s != h.slice_id:
-                h.send_bytes(s, tag, _np_bytes(arr))
+                h.send_bytes(s, tag, raw)
         parts = {h.slice_id: arr}
         for s in range(h.n_slices):
             if s != h.slice_id:
@@ -660,6 +689,175 @@ class _HierDataOps:
         SPC.record("hier_reduce_scatters")
         return h.comm.put_rank_major(
             np.ascontiguousarray(full[h.members[h.slice_id]]))
+
+    # -- vector (v/w) variants: per-rank counts, ragged blocks ----------
+    # (reference: the *v family + alltoallw, coll_base_functions.h:75-76;
+    # each controller contributes its LOCAL ranks' blocks, matching the
+    # driver-model convention of the non-vector family)
+
+    def _local_list(self, h, values, what: str):
+        from ..core.errors import ArgumentError
+
+        if len(values) != h.comm.size:
+            raise ArgumentError(
+                f"spanning {what} takes one block per LOCAL rank "
+                f"({h.comm.size}), got {len(values)}"
+            )
+        return [np.asarray(v) for v in values]
+
+    @_hier_op
+    def allgatherv(self, comm, h, tag, values):
+        import jax
+
+        host = self._local_list(h, values, "allgatherv")
+        raw = _np_list_bytes(host)  # identical for every destination
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                h.send_bytes(s, tag, raw)
+        parts = {h.slice_id: host}
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                parts[s] = _np_list_from(h.recv_from(s, tag,
+                                                     timeout=60.0))
+        SPC.record("hier_allgathervs")
+        return jax.device_put(_concat_global_order(h, parts),
+                              h.comm.replicated_sharding())
+
+    @_hier_op
+    def gatherv(self, comm, h, tag, values, root):
+        import jax
+
+        host = self._local_list(h, values, "gatherv")
+        root_slice = h.rank_slice[root]
+        if h.slice_id != root_slice:
+            h.send_bytes(root_slice, tag, _np_list_bytes(host))
+            return None
+        parts = {root_slice: host}
+        for s in range(h.n_slices):
+            if s != root_slice:
+                parts[s] = _np_list_from(h.recv_from(s, tag,
+                                                     timeout=60.0))
+        SPC.record("hier_gathervs")
+        return jax.device_put(_concat_global_order(h, parts),
+                              comm.procs[root].device)
+
+    @_hier_op
+    def scatterv(self, comm, h, tag, blocks, root):
+        import jax
+
+        from ..core.errors import ArgumentError
+
+        root_slice = h.rank_slice[root]
+        if h.slice_id == root_slice:
+            if len(blocks) != comm.size:
+                raise ArgumentError(
+                    f"spanning scatterv root needs one block per GLOBAL "
+                    f"rank ({comm.size}), got {len(blocks)}"
+                )
+            for s in range(h.n_slices):
+                if s != root_slice:
+                    h.send_bytes(s, tag, _np_list_bytes(
+                        [blocks[r] for r in h.members[s]]))
+            mine = [np.asarray(blocks[r])
+                    for r in h.members[root_slice]]
+        else:
+            mine = _np_list_from(h.recv_from(root_slice, tag,
+                                             timeout=60.0))
+        SPC.record("hier_scattervs")
+        return [jax.device_put(b, h.comm.devices[i])
+                for i, b in enumerate(mine)]
+
+    @_hier_op
+    def alltoallw(self, comm, h, tag, blocks):
+        import jax
+
+        from ..core.errors import ArgumentError
+
+        if len(blocks) != h.comm.size:
+            raise ArgumentError(
+                f"spanning alltoallw takes one send list per LOCAL rank "
+                f"({h.comm.size}), got {len(blocks)}"
+            )
+        for row in blocks:
+            if len(row) != comm.size:
+                raise ArgumentError(
+                    f"each send list needs one block per GLOBAL rank "
+                    f"({comm.size}), got {len(row)}"
+                )
+        mine = h.members[h.slice_id]
+        # ship each slice the blocks destined for its members,
+        # src-major then dst order (reconstructed symmetrically)
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                flat = [blocks[i][d]
+                        for i in range(len(mine))
+                        for d in h.members[s]]
+                h.send_bytes(s, tag, _np_list_bytes(flat))
+        # out[local_dst][global_src]
+        out = [[None] * comm.size for _ in mine]
+        for i, src_global in enumerate(mine):
+            for di, d in enumerate(mine):
+                out[di][src_global] = np.asarray(blocks[i][d])
+        for s in range(h.n_slices):
+            if s == h.slice_id:
+                continue
+            flat = _np_list_from(h.recv_from(s, tag, timeout=60.0))
+            srcs = h.members[s]
+            k = 0
+            for src_global in srcs:
+                for di in range(len(mine)):
+                    out[di][src_global] = flat[k]
+                    k += 1
+        SPC.record("hier_alltoallws")
+        return [
+            [jax.device_put(b, h.comm.devices[di]) for b in row]
+            for di, row in enumerate(out)
+        ]
+
+    def alltoallv(self, comm, blocks):
+        """Ragged all-to-all: out[local_dst] = concatenation over
+        GLOBAL src rank order of blocks[src][dst]."""
+        import jax.numpy as jnp
+
+        nested = self.alltoallw(comm, blocks)
+        return [jnp.concatenate([jnp.asarray(b) for b in row], axis=0)
+                for row in nested]
+
+    @_hier_op
+    def reduce_scatter(self, comm, h, tag, values, counts, op):
+        import jax
+
+        from ..core.errors import ArgumentError
+
+        opo = op_lookup(op)
+        host = self._local_list(h, values, "reduce_scatter")
+        if len(counts) != comm.size:
+            raise ArgumentError(
+                f"need one count per GLOBAL rank ({comm.size}), got "
+                f"{len(counts)}"
+            )
+        total = sum(counts)
+        for v in host:
+            if v.shape[0] != total:
+                raise ArgumentError(
+                    f"buffer rows {v.shape[0]} != sum(counts) {total}"
+                )
+        schedule = h.ordered_schedule(opo)
+        stacked = h.comm.put_rank_major(np.stack(host))
+        partial = phase1_local_reduce(h, stacked, opo)
+        full = phase2_exchange(h, partial, opo, timeout=60.0,
+                               schedule=schedule, tag_base=tag)
+        SPC.record("hier_reduce_scatter_vs")
+        out, start = [], 0
+        offsets = {}
+        for r, c in enumerate(counts):
+            offsets[r] = (start, c)
+            start += c
+        for i, r in enumerate(h.members[h.slice_id]):
+            lo, c = offsets[r]
+            out.append(jax.device_put(full[lo:lo + c],
+                                      h.comm.devices[i]))
+        return out
 
     def _prefix(self, comm, h, tag, x, op, *, inclusive: bool):
         opo = op_lookup(op)

@@ -546,3 +546,119 @@ def test_two_process_vtable_data_collectives():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed:\n{err[-4000:]}"
         assert "OK" in out
+
+
+_VECTOR_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    nprocs = int(sys.argv[2])
+    coord = sys.argv[3]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.pml import fabric
+
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=nprocs, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    world = ompi_tpu.init()     # ranks 0,1 on p0; 2,3 on p1
+    fabric.wire_up()
+    n = world.size
+    my = (0, 1) if pid == 0 else (2, 3)
+
+    def blk(r):  # ragged: rank r contributes r+1 rows
+        return (np.arange((r + 1) * 2, dtype=np.float32)
+                .reshape(r + 1, 2) + 100 * r)
+
+    expected_cat = np.concatenate([blk(r) for r in range(n)], axis=0)
+
+    # allgatherv: ragged blocks, concatenated in global rank order
+    out = np.asarray(world.allgatherv([blk(r) for r in my]))
+    np.testing.assert_array_equal(out, expected_cat)
+
+    # gatherv at a root on each side
+    for root in (0, 3):
+        g = world.gatherv([blk(r) for r in my], root=root)
+        if root in my:
+            np.testing.assert_array_equal(np.asarray(g), expected_cat)
+        else:
+            assert g is None
+
+    # scatterv from root 2 (ragged per-rank blocks)
+    blocks = [blk(r) * 2 for r in range(n)]
+    mine = world.scatterv(blocks if 2 in my else [], root=2)
+    assert len(mine) == len(my)
+    for i, r in enumerate(my):
+        np.testing.assert_array_equal(np.asarray(mine[i]), blk(r) * 2)
+
+    # alltoallv: blocks[src][dst] with (src+dst+1) rows each
+    def sd(src, dst):
+        return np.full(((src + dst) % 3 + 1, 2),
+                       10.0 * src + dst, np.float32)
+
+    send = [[sd(src, dst) for dst in range(n)] for src in my]
+    got = world.alltoallv(send)
+    assert len(got) == len(my)
+    for i, dst in enumerate(my):
+        exp = np.concatenate([sd(src, dst) for src in range(n)], axis=0)
+        np.testing.assert_array_equal(np.asarray(got[i]), exp)
+
+    # alltoallw: heterogeneous blocks keep their own shapes
+    gotw = world.alltoallw(send)
+    for i, dst in enumerate(my):
+        for src in range(n):
+            np.testing.assert_array_equal(
+                np.asarray(gotw[i][src]), sd(src, dst))
+
+    # reduce_scatter with counts [1, 2, 1, 2]
+    counts = [1, 2, 1, 2]
+    total = sum(counts)
+    vals = [np.arange(total, dtype=np.float32) + r for r in my]
+    out = world.reduce_scatter([vals[i] for i in range(len(my))],
+                               counts)
+    full = np.sum([np.arange(total, dtype=np.float32) + r
+                   for r in range(n)], axis=0)
+    offs = np.concatenate([[0], np.cumsum(counts)])
+    for i, r in enumerate(my):
+        np.testing.assert_allclose(
+            np.asarray(out[i]), full[offs[r]:offs[r] + counts[r]])
+
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def test_two_process_vector_collectives():
+    """The v/w family (ragged per-rank blocks) works through the vtable
+    on spanning comms: allgatherv/gatherv/scatterv/alltoallv/alltoallw/
+    reduce_scatter over DCN leader exchanges."""
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _VECTOR_WORKER, str(pid),
+             str(nprocs), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-4000:]}"
+        assert "OK" in out
