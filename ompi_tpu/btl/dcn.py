@@ -422,20 +422,17 @@ class DcnEndpoint:
         # deadline is real; if a waiter still hasn't returned, LEAK the
         # native context instead of freeing memory under its feet.
         deadline = time.monotonic() + 5.0
+        remaining = 1
         while time.monotonic() < deadline:
             with self._wait_mu:
-                if self._inflight_waits == 0:
-                    break
+                remaining = self._inflight_waits
+            if remaining == 0:
+                break
             time.sleep(0.001)
-        else:
-            pass
-        with self._wait_mu:
-            drained = self._inflight_waits == 0
-        if not drained:
+        if remaining:
             logger.warning(
                 "dcn close: %d native wait(s) did not drain; leaking "
-                "the context rather than freeing it mid-call",
-                self._inflight_waits,
+                "the context rather than freeing it mid-call", remaining,
             )
             return
         self._lib.dcn_destroy(self._ctx)

@@ -859,6 +859,93 @@ class _HierDataOps:
                                       h.comm.devices[i]))
         return out
 
+    # -- neighborhood collectives (reference: coll_base_functions.h:
+    #    62-66) over the comm's attached cart/graph/dist_graph topology.
+    #    The adjacency is GLOBAL knowledge (the controller builds the
+    #    topology), so only block payloads cross the wire.
+
+    @staticmethod
+    def _edges(comm):
+        from ..topo.topology import TopologyError, edge_fns
+
+        if comm.topo is None:
+            raise TopologyError("communicator has no topology")
+        return edge_fns(comm.topo)
+
+    def neighbor_allgather(self, comm, x):
+        """Each of this controller's ranks receives its topology
+        neighbors' blocks in neighbor order; returns a dict keyed by
+        GLOBAL rank id (this controller's ranks only)."""
+        import jax.numpy as jnp
+
+        _, ins = self._edges(comm)
+        h = comm_slice(comm)
+        full = np.asarray(self.allgather(comm, x))[0]  # (size, ...)
+        out = {}
+        for r in h.members[h.slice_id]:
+            neigh = ins(r)
+            out[r] = (jnp.stack([jnp.asarray(full[n]) for n in neigh])
+                      if neigh else
+                      jnp.zeros((0,) + full.shape[1:], full.dtype))
+        SPC.record("hier_neighbor_allgathers")
+        return out
+
+    @_hier_op
+    def neighbor_alltoall(self, comm, h, tag, sendblocks):
+        """sendblocks: dict keyed by GLOBAL rank id (this controller's
+        ranks), each one block per OUT neighbor in order; returns
+        {global_rank: stacked blocks from IN neighbors}. Duplicate
+        edges (a periodic cart dim of size 2 lists a neighbor twice)
+        pair position-wise, the MPI matching — payloads travel in
+        canonical (src, out-position) order so both ends reconstruct
+        the same pairing from the shared global adjacency."""
+        from collections import Counter
+
+        import jax.numpy as jnp
+
+        from ..topo.topology import TopologyError
+
+        outs, ins = self._edges(comm)
+        # count-aware symmetric validation (free: adjacency is global)
+        for r in range(comm.size):
+            for src, k in Counter(ins(r)).items():
+                if Counter(outs(src)).get(r, 0) != k:
+                    raise TopologyError(
+                        f"rank {r} lists {src} as in-neighbor x{k} but "
+                        f"rank {src}'s out-edges to {r} do not match"
+                    )
+        local = h.members[h.slice_id]
+        buckets: dict[int, list] = {s: [] for s in range(h.n_slices)}
+        for src in local:
+            for j, dst in enumerate(outs(src)):
+                buckets[h.rank_slice[dst]].append(
+                    np.asarray(sendblocks[src][j]))
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                h.send_bytes(s, tag, _np_list_bytes(buckets[s]))
+        # Rebuild (src, dst) FIFOs by walking every slice's sources in
+        # the same canonical order the sender enumerated.
+        mail: dict[tuple[int, int], list] = {}
+
+        def feed(src_list, blocks):
+            it = iter(blocks)
+            for src in src_list:
+                for dst in outs(src):
+                    if h.rank_slice[dst] == h.slice_id:
+                        mail.setdefault((src, dst), []).append(next(it))
+
+        feed(local, buckets[h.slice_id])
+        for s in range(h.n_slices):
+            if s != h.slice_id:
+                feed(h.members[s],
+                     _np_list_from(h.recv_from(s, tag, timeout=60.0)))
+        out = {}
+        for r in local:
+            got = [jnp.asarray(mail[(src, r)].pop(0)) for src in ins(r)]
+            out[r] = jnp.stack(got) if got else None
+        SPC.record("hier_neighbor_alltoalls")
+        return out
+
     def _prefix(self, comm, h, tag, x, op, *, inclusive: bool):
         opo = op_lookup(op)
         if not h.rank_ordered():

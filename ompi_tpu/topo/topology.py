@@ -288,16 +288,9 @@ def neighbor_allgather(comm, x):
     return out
 
 
-def neighbor_alltoall(comm, sendblocks: dict):
-    """sendblocks[r] = (n_out_neighbors(r), ...) blocks, one per out
-    neighbor in order; returns recvblocks[r] likewise from in neighbors.
-    """
-    import jax.numpy as jnp
-
-    topo = comm.topo
-    if topo is None:
-        raise TopologyError("communicator has no topology")
-
+def edge_fns(topo):
+    """(outs, ins) accessor pair for any topology kind — dist_graph
+    distinguishes directions, cart/graph edges are symmetric."""
     def outs(r):
         if isinstance(topo, DistGraphTopology):
             return topo.out_neighbors(r)
@@ -308,24 +301,45 @@ def neighbor_alltoall(comm, sendblocks: dict):
             return topo.in_neighbors(r)
         return topo.neighbors(r)
 
-    # Mailbox delivery keyed by (src, dst) pairs in neighbor order.
-    mail: dict[tuple[int, int], object] = {}
+    return outs, ins
+
+
+def neighbor_alltoall(comm, sendblocks: dict):
+    """sendblocks[r] = (n_out_neighbors(r), ...) blocks, one per out
+    neighbor in order; returns recvblocks[r] likewise from in neighbors.
+    """
+    import jax.numpy as jnp
+
+    topo = comm.topo
+    if topo is None:
+        raise TopologyError("communicator has no topology")
+    outs, ins = edge_fns(topo)
+
+    # Mailbox delivery: a FIFO per (src, dst) pair — duplicate edges
+    # (e.g. a periodic cart dimension of size 2 lists the same neighbor
+    # twice) pair the k-th out-occurrence with the k-th in-occurrence,
+    # the MPI position-wise matching; a plain dict would silently drop
+    # all but the last duplicate's block.
+    mail: dict[tuple[int, int], list] = {}
     for r in range(comm.size):
         blocks = sendblocks[r]
         for j, dst in enumerate(outs(r)):
-            mail[(r, dst)] = blocks[j]
+            mail.setdefault((r, dst), []).append(blocks[j])
     out = {}
     for r in range(comm.size):
         got = []
         for src in ins(r):
-            if (src, r) not in mail:
-                # MPI semantics: every in-edge must have a matching
-                # out-edge at the source; a silent skip would misalign
-                # received blocks against in-neighbor order.
+            q = mail.get((src, r))
+            if not q:
+                # MPI semantics: every in-edge occurrence must have a
+                # matching out-edge occurrence at the source; a silent
+                # skip would misalign received blocks against
+                # in-neighbor order.
                 raise TopologyError(
                     f"rank {r} lists {src} as in-neighbor but rank "
-                    f"{src} does not list {r} as out-neighbor"
+                    f"{src} does not list {r} as out-neighbor (or edge "
+                    f"multiplicities differ)"
                 )
-            got.append(mail[(src, r)])
+            got.append(q.pop(0))
         out[r] = jnp.stack(got) if got else None
     return out

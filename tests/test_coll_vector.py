@@ -172,6 +172,32 @@ def c_put(comm, x):
     return comm.put_rank_major(x)
 
 
+def test_neighbor_alltoall_duplicate_edges(comm):
+    """A periodic cart dimension of size 2 lists the SAME neighbor
+    twice; MPI pairs the k-th out-occurrence with the k-th
+    in-occurrence, so both distinct blocks must be delivered (a plain
+    (src,dst)-keyed mailbox silently drops one)."""
+    from ompi_tpu.topo import topology as topo_mod
+
+    sub = comm.split([0, 0] + [-1] * (comm.size - 2))[0]
+    assert sub.size == 2
+    cart = topo_mod.cart_create(sub, [2], [True])
+    assert cart.topo.neighbors(0) == [1, 1]  # duplicate edge
+    send = {
+        r: np.stack([np.full(2, 10.0 * r + j, np.float32)
+                     for j in range(2)])
+        for r in range(2)
+    }
+    recv = cart.neighbor_alltoall(send)
+    for r in range(2):
+        got = np.asarray(recv[r])
+        src = 1 - r
+        # position-wise pairing: in-occurrence j carries out-block j
+        np.testing.assert_array_equal(
+            got, np.stack([np.full(2, 10.0 * src + j, np.float32)
+                           for j in range(2)]))
+
+
 def test_neighbor_alltoall_ring(comm):
     from ompi_tpu.topo import topology as topo_mod
 

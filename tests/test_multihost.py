@@ -629,6 +629,32 @@ _VECTOR_WORKER = textwrap.dedent(r"""
         np.testing.assert_allclose(
             np.asarray(out[i]), full[offs[r]:offs[r] + counts[r]])
 
+    # neighborhood collectives over a periodic 1-D cart spanning both
+    # controllers: neighbors of rank r are (r-1)%n and (r+1)%n
+    from ompi_tpu.topo import topology as topo_mod
+    cart = topo_mod.cart_create(world, [n], [True])
+    xlocal = np.stack([np.full(2, float(r), np.float32) for r in my])
+    na = cart.neighbor_allgather(xlocal)
+    for r in my:
+        neigh = cart.topo.neighbors(r)
+        got = np.asarray(na[r])
+        np.testing.assert_array_equal(
+            got, np.stack([np.full(2, float(v), np.float32)
+                           for v in neigh]))
+    sendblocks = {
+        r: np.stack([np.full(2, 100.0 * r + v, np.float32)
+                     for v in cart.topo.neighbors(r)])
+        for r in my
+    }
+    nt = cart.neighbor_alltoall(sendblocks)
+    for r in my:
+        neigh = cart.topo.neighbors(r)
+        got = np.asarray(nt[r])
+        # block j from in-neighbor s = s's block destined for r
+        for j, s in enumerate(neigh):
+            np.testing.assert_array_equal(
+                got[j], np.full(2, 100.0 * s + r, np.float32))
+
     print(f"WORKER {pid} OK", flush=True)
 """)
 
