@@ -872,21 +872,43 @@ class _HierDataOps:
             raise TopologyError("communicator has no topology")
         return edge_fns(comm.topo)
 
-    def neighbor_allgather(self, comm, x):
+    @_hier_op
+    def neighbor_allgather(self, comm, h, tag, x):
         """Each of this controller's ranks receives its topology
-        neighbors' blocks in neighbor order; returns a dict keyed by
-        GLOBAL rank id (this controller's ranks only)."""
+        neighbors' blocks in neighbor order (in-neighbors for
+        dist_graph); returns a dict keyed by GLOBAL rank id (this
+        controller's ranks only). Sparse exchange: each slice ships
+        only the blocks the destination's ranks actually neighbor,
+        id-tagged — not a full allgather."""
         import jax.numpy as jnp
 
         _, ins = self._edges(comm)
-        h = comm_slice(comm)
-        full = np.asarray(self.allgather(comm, x))[0]  # (size, ...)
+        x = h.local_rank_major(x)
+        arr = np.asarray(x)
+        local = h.members[h.slice_id]
+        blk = {r: arr[i] for i, r in enumerate(local)}
+        for s in range(h.n_slices):
+            if s == h.slice_id:
+                continue
+            needed = sorted({n for r2 in h.members[s]
+                             for n in ins(r2) if n in blk})
+            payload = [np.asarray(needed, np.int64)]
+            payload += [blk[n] for n in needed]
+            h.send_bytes(s, tag, _np_list_bytes(payload))
+        have = dict(blk)
+        for s in range(h.n_slices):
+            if s == h.slice_id:
+                continue
+            got = _np_list_from(h.recv_from(s, tag, timeout=60.0))
+            for rid, b in zip(got[0].ravel().astype(int).tolist(),
+                              got[1:]):
+                have[int(rid)] = b
         out = {}
-        for r in h.members[h.slice_id]:
+        for r in local:
             neigh = ins(r)
-            out[r] = (jnp.stack([jnp.asarray(full[n]) for n in neigh])
+            out[r] = (jnp.stack([jnp.asarray(have[n]) for n in neigh])
                       if neigh else
-                      jnp.zeros((0,) + full.shape[1:], full.dtype))
+                      jnp.zeros((0,) + arr.shape[1:], arr.dtype))
         SPC.record("hier_neighbor_allgathers")
         return out
 
@@ -906,14 +928,22 @@ class _HierDataOps:
         from ..topo.topology import TopologyError
 
         outs, ins = self._edges(comm)
-        # count-aware symmetric validation (free: adjacency is global)
-        for r in range(comm.size):
-            for src, k in Counter(ins(r)).items():
-                if Counter(outs(src)).get(r, 0) != k:
-                    raise TopologyError(
-                        f"rank {r} lists {src} as in-neighbor x{k} but "
-                        f"rank {src}'s out-edges to {r} do not match"
-                    )
+        # Count-aware validation, cached on the immutable topology:
+        # every in-edge occurrence needs a matching out-edge occurrence
+        # (surplus out-edges are tolerated — their blocks go unread,
+        # matching the single-controller mailbox behavior).
+        topo = comm.topo
+        if not getattr(topo, "_hier_edge_validated", False):
+            out_counts = {r: Counter(outs(r))
+                          for r in range(comm.size)}
+            for r in range(comm.size):
+                for src, k in Counter(ins(r)).items():
+                    if out_counts[src].get(r, 0) < k:
+                        raise TopologyError(
+                            f"rank {r} lists {src} as in-neighbor x{k} "
+                            f"but rank {src} has fewer out-edges to {r}"
+                        )
+            topo._hier_edge_validated = True
         local = h.members[h.slice_id]
         buckets: dict[int, list] = {s: [] for s in range(h.n_slices)}
         for src in local:

@@ -272,16 +272,18 @@ def dist_graph_create(comm, sources: dict, destinations: dict,
 
 def neighbor_allgather(comm, x):
     """Each rank receives its topology neighbors' blocks, in neighbor
-    order. x: rank-major (size, ...). Returns {rank: (n_neigh, ...)}."""
+    order (in-neighbors for dist_graph). x: rank-major (size, ...).
+    Returns {rank: (n_neigh, ...)}."""
     import jax.numpy as jnp
 
     topo = comm.topo
     if topo is None:
         raise TopologyError("communicator has no topology")
+    _, ins = edge_fns(topo)
     arr = jnp.asarray(x)
     out = {}
     for r in range(comm.size):
-        neigh = topo.neighbors(r)
+        neigh = ins(r)
         out[r] = jnp.stack([arr[n] for n in neigh]) if neigh else (
             jnp.zeros((0,) + arr.shape[1:], arr.dtype)
         )

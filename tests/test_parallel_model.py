@@ -239,6 +239,22 @@ class TestFlagshipModel:
             microbatches=2,
         )
 
+    def test_dense_family_trains(self):
+        """The dense family (moe_every=0: every layer a TP MLP, no
+        expert routing) trains on the full dp2*pp2*tp2 mesh — the
+        flagship covers both model families through its config."""
+        cfg = dataclasses.replace(self._cfg(layers_per_stage=2),
+                                  moe_every=0)
+        mesh = T.demo_mesh(8)
+        params = T.sharded_init(cfg, mesh)
+        step = T.build_train_step(cfg, mesh)
+        tokens, targets = T.make_batch(cfg, batch=4)
+        loss0, params = step(params, tokens, targets)
+        loss1, params = step(params, tokens, targets)
+        l0, l1 = float(loss0), float(loss1)
+        assert np.isfinite(l0) and np.isfinite(l1)
+        assert l1 < l0, (l0, l1)
+
     def test_parallel_matches_serial(self):
         """dp2*pp2*tp2 loss == single-device loss, same params."""
         cfg8 = self._cfg(layers_per_stage=2)
