@@ -616,11 +616,41 @@ def bench_multi_device(n: int) -> dict:
     }
 
 
+def _watchdog(seconds: float, metric: str):
+    """If the device tunnel wedges mid-run (observed: RPC calls that
+    never return), the driver must still get ONE JSON line — a daemon
+    thread can emit it and hard-exit even while the main thread is
+    stuck inside a native call. Returns the timer; cancel it once the
+    real result has been printed."""
+    import os
+    import threading
+
+    def fire():
+        print(json.dumps({
+            "metric": metric,
+            "value": 0,
+            "unit": "GB/s",
+            "vs_baseline": 0,
+            "detail": {"error": f"watchdog: bench exceeded {seconds:.0f}s "
+                                "(device tunnel wedged?)"},
+        }), flush=True)
+        os._exit(2)
+
+    t = threading.Timer(seconds, fire)
+    t.daemon = True
+    t.start()
+    return t
+
+
 def main() -> None:
     import jax
 
     n = len(jax.devices())
+    metric = ("allreduce_busbw_16MiB_f32" if n > 1
+              else "allreduce_sum_reduce_512MiB_f32")
+    dog = _watchdog(25 * 60, metric)
     result = bench_multi_device(n) if n > 1 else bench_single_chip()
+    dog.cancel()  # a hung shutdown must not overwrite a real result
     print(json.dumps(result))
 
 
