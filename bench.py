@@ -643,12 +643,15 @@ def _watchdog(seconds: float, metric: str):
 
 
 def main() -> None:
+    # Arm BEFORE touching jax: a tunnel wedge during device enumeration
+    # is exactly the failure mode the watchdog exists for.
+    dog = _watchdog(25 * 60, "allreduce_sum_reduce_512MiB_f32")
     import jax
 
     n = len(jax.devices())
-    metric = ("allreduce_busbw_16MiB_f32" if n > 1
-              else "allreduce_sum_reduce_512MiB_f32")
-    dog = _watchdog(25 * 60, metric)
+    if n > 1:
+        dog.cancel()
+        dog = _watchdog(24 * 60, "allreduce_busbw_16MiB_f32")
     result = bench_multi_device(n) if n > 1 else bench_single_chip()
     dog.cancel()  # a hung shutdown must not overwrite a real result
     print(json.dumps(result))

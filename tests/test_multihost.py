@@ -629,6 +629,17 @@ _VECTOR_WORKER = textwrap.dedent(r"""
         np.testing.assert_allclose(
             np.asarray(out[i]), full[offs[r]:offs[r] + counts[r]])
 
+    # persistent collective on the spanning comm: init once, start+wait
+    # twice (reference: pcollreq / MPI_Allreduce_init)
+    px = np.stack([np.full(2, float(r + 1), np.float32) for r in my])
+    preq = world.allreduce_init(px)
+    expect_sum = sum(float(r + 1) for r in range(n))
+    for _ in range(2):
+        preq.start()
+        preq.wait(timeout=120)
+        got = np.asarray(preq.result())
+        assert np.allclose(got, expect_sum), (got, expect_sum)
+
     # neighborhood collectives over a periodic 1-D cart spanning both
     # controllers: neighbors of rank r are (r-1)%n and (r+1)%n
     from ompi_tpu.topo import topology as topo_mod
