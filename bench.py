@@ -616,7 +616,7 @@ def bench_multi_device(n: int) -> dict:
     }
 
 
-def _watchdog(seconds: float, metric: str):
+def _watchdog(seconds: float, metric: str, phase: str = "benchmark"):
     """If the device tunnel wedges mid-run (observed: RPC calls that
     never return), the driver must still get ONE JSON line — a daemon
     thread can emit it and hard-exit even while the main thread is
@@ -632,7 +632,8 @@ def _watchdog(seconds: float, metric: str):
             "unit": "GB/s",
             "vs_baseline": 0,
             "detail": {"error": f"watchdog: bench exceeded {seconds:.0f}s "
-                                "(device tunnel wedged?)"},
+                                "(device tunnel wedged?)",
+                       "phase": phase},
         }), flush=True)
         os._exit(2)
 
@@ -644,8 +645,11 @@ def _watchdog(seconds: float, metric: str):
 
 def main() -> None:
     # Arm BEFORE touching jax: a tunnel wedge during device enumeration
-    # is exactly the failure mode the watchdog exists for.
-    dog = _watchdog(25 * 60, "allreduce_sum_reduce_512MiB_f32")
+    # is exactly the failure mode the watchdog exists for. The metric
+    # name cannot be mode-accurate before the device count is known —
+    # the phase field attributes a pre-enumeration wedge correctly.
+    dog = _watchdog(25 * 60, "allreduce_sum_reduce_512MiB_f32",
+                    phase="startup (jax import / device enumeration)")
     import jax
 
     n = len(jax.devices())

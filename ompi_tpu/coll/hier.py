@@ -134,7 +134,24 @@ class SliceHandle:
                         f"slice {self.slice_id}: timeout waiting for "
                         f"{key}"
                     )
-                time.sleep(0.0002)
+                # Park on the engine's completion condition variable
+                # instead of spin-sleeping: on small-core hosts the
+                # spinner steals the transport threads' cycles (same
+                # fix as the fabric's idle hook). Drain send
+                # completions first — wait_event also wakes on those,
+                # and an unconsumed one would turn the park back into
+                # a hot spin (the fabric's progress pass drains them
+                # the same way).
+                drain = getattr(self.endpoint, "poll_send_complete",
+                                None)
+                if drain is not None:
+                    while drain() is not None:
+                        pass
+                wait = getattr(self.endpoint, "wait_event", None)
+                if wait is not None:
+                    wait(0.05)
+                else:
+                    time.sleep(0.0002)
                 continue
             peer, got_tag, raw = got
             src = -peer - 1 if peer < 0 else None
