@@ -120,6 +120,34 @@ def test_decide_defaults_mirror_reference_cutoffs():
     assert tuned.decide_reduce(ops.lookup("sum"), 1024, 8) == "native"
 
 
+def test_rules_file_covers_new_spaces(tmp_path):
+    """A dynamic rules file can steer the new decision spaces (reduce /
+    reduce_scatter / gather / scatter), banded by size, first match
+    wins — the coll_tuned_dynamic_file.c consumption model."""
+    from ompi_tpu import ops
+    from ompi_tpu.coll import tuned
+
+    p = tmp_path / "rules.json"
+    p.write_text(json.dumps({
+        "reduce": [{"max_bytes": 4096, "algorithm": "binomial"},
+                   {"algorithm": "native"}],
+        "reduce_scatter": [{"algorithm": "ring"}],
+        "gather": [{"min_ranks": 4, "algorithm": "binomial"}],
+        "scatter": [{"algorithm": "binomial"}],
+    }))
+    config.set("coll_tuned_rules_file", str(p))
+    try:
+        s = ops.lookup("sum")
+        assert tuned.decide_reduce(s, 1024, 8) == "binomial"
+        assert tuned.decide_reduce(s, 1 << 20, 8) == "native"
+        assert tuned.decide_reduce_scatter(s, 1 << 20, 8) == "ring"
+        assert tuned.decide_gather(1 << 20, 8) == "binomial"
+        assert tuned.decide_gather(64, 2) == "native"  # min_ranks miss
+        assert tuned.decide_scatter(64, 8) == "binomial"
+    finally:
+        config.set("coll_tuned_rules_file", "")
+
+
 def test_tune_cli(tmp_path):
     from ompi_tpu.tools import tune
 
