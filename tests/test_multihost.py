@@ -629,6 +629,14 @@ _VECTOR_WORKER = textwrap.dedent(r"""
         np.testing.assert_allclose(
             np.asarray(out[i]), full[offs[r]:offs[r] + counts[r]])
 
+    # the comm_method hook's table attributes every op (incl. the v/w
+    # and neighbor families) to the hier component on the spanning comm
+    from ompi_tpu.hook import comm_method
+    txt = " ".join(comm_method.render(world).split())
+    for opname in ("allreduce", "allgatherv", "alltoallw",
+                   "reduce_scatter", "neighbor_alltoall"):
+        assert f"{opname}: hier" in txt, (opname, txt[-400:])
+
     # persistent collective on the spanning comm: init once, start+wait
     # twice (reference: pcollreq / MPI_Allreduce_init)
     px = np.stack([np.full(2, float(r + 1), np.float32) for r in my])
