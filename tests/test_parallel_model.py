@@ -95,6 +95,27 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(fused), np.asarray(base),
                                    rtol=2e-4, atol=2e-4)
 
+    def test_pallas_bf16_matches_xla(self):
+        """bf16 inputs (sublane-16 tiling; f32 accumulation inside the
+        kernel) stay exact against the XLA path within bf16 tolerance."""
+        n, T_, H, Dh = 4, 16, 2, 128
+        rng = np.random.default_rng(5)
+        q, k, v = (jnp.asarray(rng.standard_normal((n, T_, H, Dh)),
+                               jnp.bfloat16) for _ in range(3))
+        base = spmd_run(
+            lambda a, b, c: sp.ring_attention(
+                a, b, c, "x", impl="xla"), n, q, k, v, axis="x",
+        )
+        fused = spmd_run(
+            lambda a, b, c: sp.ring_attention(
+                a, b, c, "x", impl="pallas"), n, q, k, v, axis="x",
+            check_vma=False,
+        )
+        np.testing.assert_allclose(
+            np.asarray(fused, np.float32), np.asarray(base, np.float32),
+            rtol=3e-2, atol=3e-2,
+        )
+
     def test_pallas_unaligned_falls_back(self):
         """Unaligned Dh streams through the XLA path instead of failing
         at trace time."""
