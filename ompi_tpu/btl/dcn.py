@@ -17,6 +17,7 @@ multi-host wire (the modex analog exchanges host:port pairs).
 
 from __future__ import annotations
 
+import contextlib
 import time
 from collections import deque
 from typing import Any, Optional
@@ -80,7 +81,29 @@ class DcnEndpoint:
 
         self._inflight_waits = 0  # threads inside a native blocking wait
         self._wait_mu = threading.Lock()  # guards the count + closing
+        # Serializes native send + pin registration against completion
+        # polling so a completion id can't be claimed between
+        # dcn_send_ref returning and the pin landing in _send_refs
+        # (which would leave the payload pinned until close()).
+        self._send_mu = threading.Lock()
         self._closed = False
+
+    @contextlib.contextmanager
+    def _native_call(self, *, what: str):
+        """Guard for any data-plane entry into the native Ctx: bounce
+        when closed (DcnError on enter) and count the call in
+        _inflight_waits so close()'s drain loop waits for it before
+        dcn_destroy (otherwise the _closed check is a TOCTOU and the
+        native call can run on a freed context)."""
+        with self._wait_mu:
+            if self._closed:
+                raise DcnError(f"endpoint closed during {what}")
+            self._inflight_waits += 1
+        try:
+            yield
+        finally:
+            with self._wait_mu:
+                self._inflight_waits -= 1
 
     # -- wiring ------------------------------------------------------------
 
@@ -169,23 +192,26 @@ class DcnEndpoint:
 
     def send_bytes(self, peer: int, tag: int, data) -> int:
         buf = np.ascontiguousarray(np.frombuffer(data, np.uint8))
-        msgid = self._lib.dcn_send_ref(
-            self._ctx, peer, tag, buf.ctypes.data, buf.nbytes
-        )
-        if msgid < 0:
-            raise DcnError(f"send to unknown peer {peer}")
-        # Zero-copy contract: the engine references `buf` directly for
-        # rendezvous payloads; pin it until the completion id pops.
-        # Every send also drains finished completions so non-polling
-        # callers don't keep flushed payloads pinned; drained ids are
-        # preserved losslessly for explicit pollers.
-        self._send_refs[int(msgid)] = buf
-        while True:
-            done = int(self._lib.dcn_poll_send(self._ctx))
-            if not done:
-                break
-            self._send_refs.pop(done, None)
-            self._pending_send_done.append(done)
+        with self._native_call(what="send"), self._send_mu:
+            msgid = self._lib.dcn_send_ref(
+                self._ctx, peer, tag, buf.ctypes.data, buf.nbytes
+            )
+            if msgid < 0:
+                raise DcnError(f"send to unknown peer {peer}")
+            # Zero-copy contract: the engine references `buf` directly
+            # for rendezvous payloads; pin it until the completion id
+            # pops. Registration happens under _send_mu so a concurrent
+            # poll_send_complete can't claim the id first. Every send
+            # also drains finished completions so non-polling callers
+            # don't keep flushed payloads pinned; drained ids are
+            # preserved losslessly for explicit pollers.
+            self._send_refs[int(msgid)] = buf
+            while True:
+                done = int(self._lib.dcn_poll_send(self._ctx))
+                if not done:
+                    break
+                self._send_refs.pop(done, None)
+                self._pending_send_done.append(done)
         SPC.record("dcn_send_bytes", buf.nbytes)
         return int(msgid)
 
@@ -239,21 +265,11 @@ class DcnEndpoint:
         while True:
             remaining = deadline - time.monotonic()
             slice_ms = max(1, min(100, int(remaining * 1000)))
-            # Register-then-call under the lock: close() flips _closed
-            # under the same lock, so either we observe it here or
-            # close() observes our registration and drains this call.
-            with self._wait_mu:
-                if self._closed:
-                    raise DcnError("endpoint closed during recv")
-                self._inflight_waits += 1
-            try:
+            with self._native_call(what="recv"):
                 msgid = self._lib.dcn_wait_recv(
                     self._ctx, slice_ms, ctypes.byref(peer),
                     ctypes.byref(tag), ctypes.byref(length),
                 )
-            finally:
-                with self._wait_mu:
-                    self._inflight_waits -= 1
             if msgid:
                 return self._consume_receipt(msgid, peer, tag, length)
             if time.monotonic() >= deadline:
@@ -265,30 +281,35 @@ class DcnEndpoint:
         slice so close() can drain waiters promptly — loop for longer
         waits), consuming nothing. True when something fired."""
         ms = max(1, min(200, int(timeout * 1000)))
-        with self._wait_mu:
-            if self._closed:
-                return False
-            self._inflight_waits += 1
         try:
-            return bool(self._lib.dcn_wait_event(self._ctx, ms))
-        finally:
-            with self._wait_mu:
-                self._inflight_waits -= 1
+            with self._native_call(what="wait_event"):
+                return bool(self._lib.dcn_wait_event(self._ctx, ms))
+        except DcnError:
+            return False  # closed
 
     def notify(self) -> None:
         """Wake a parked wait_event waiter (the progress engine pokes
-        this when a non-DCN completion fires elsewhere)."""
-        if not self._closed:
-            self._lib.dcn_notify(self._ctx)
+        this when a non-DCN completion fires elsewhere). Guarded like
+        every data-plane native call so close()'s drain also covers a
+        thread mid-dcn_notify."""
+        try:
+            with self._native_call(what="notify"):
+                self._lib.dcn_notify(self._ctx)
+        except DcnError:
+            pass  # closed: nothing to wake
 
     def poll_send_complete(self) -> Optional[int]:
-        if self._pending_send_done:
-            return self._pending_send_done.popleft()
-        msgid = int(self._lib.dcn_poll_send(self._ctx))
-        if not msgid:
-            return None
-        self._send_refs.pop(msgid, None)
-        return msgid
+        try:
+            with self._native_call(what="poll_send"), self._send_mu:
+                if self._pending_send_done:
+                    return self._pending_send_done.popleft()
+                msgid = int(self._lib.dcn_poll_send(self._ctx))
+                if not msgid:
+                    return None
+                self._send_refs.pop(msgid, None)
+                return msgid
+        except DcnError:
+            return None  # closed: nothing left to poll
 
     def set_link_weights(self, peer: int, weights) -> None:
         """Per-link FRAG striping proportions for a peer (reference:
@@ -436,8 +457,9 @@ class DcnEndpoint:
             )
             return
         self._lib.dcn_destroy(self._ctx)
-        self._send_refs.clear()
-        self._pending_send_done.clear()
+        with self._send_mu:
+            self._send_refs.clear()
+            self._pending_send_done.clear()
 
     def __del__(self) -> None:
         try:

@@ -19,6 +19,7 @@ from .request import (
     Status,
     test_all,
     test_any,
+    test_some,
     wait_all,
     wait_any,
     wait_some,
@@ -57,6 +58,7 @@ __all__ = [
     "Status",
     "test_all",
     "test_any",
+    "test_some",
     "wait_all",
     "wait_any",
     "wait_some",

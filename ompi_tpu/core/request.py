@@ -56,6 +56,12 @@ class Request:
         self.status = Status()
         self._result: Any = None
         self._callbacks: list[Callable[["Request"], None]] = []
+        # Set once a some-family call has returned this request: MPI
+        # Waitsome/Testsome deallocate completed requests (persistent
+        # ones go inactive), so later calls must not re-harvest them.
+        # The handle itself stays usable (result()/status) — start()
+        # clears the mark for persistent reuse.
+        self._harvested = False
 
     # -- completion -------------------------------------------------------
 
@@ -136,6 +142,7 @@ class Request:
             raise RequestError("start() on already-active request")
         self.state = RequestState.ACTIVE
         self.status = Status()
+        self._harvested = False
         self._start()
         return self
 
@@ -206,15 +213,59 @@ def wait_any(
     raise RequestError("unreachable")
 
 
+def _active_indices(requests: Sequence[Request]) -> list[int]:
+    """Indices participating in a some/any completion call. Inactive
+    persistent requests are ignored per MPI-3.1 §3.7.5, and so are
+    requests a previous some-call already harvested (MPI deallocates
+    those — they read as MPI_REQUEST_NULL afterwards; reference:
+    req_wait.c MPI_Waitsome skips inactive entries; req_test.c)."""
+    return [
+        i for i, r in enumerate(requests)
+        if r.state != RequestState.INACTIVE and not r._harvested
+    ]
+
+
+def _harvest(
+    requests: Sequence[Request], live: Sequence[int]
+) -> list[tuple[int, Status]]:
+    """Collect every complete request in `live` for a some-family call.
+    Error checking happens BEFORE any harvest mark lands: a failed
+    request must not cause successful completions to be marked
+    deallocated yet never reported (the caller retries and would skip
+    them forever). Shared by wait_some and test_some so Waitsome and
+    Testsome semantics can't diverge."""
+    done_idx = [
+        i for i in live if requests[i]._poll() or requests[i].done
+    ]
+    for i in done_idx:
+        if requests[i].status.error is not None:
+            raise requests[i].status.error
+    out = []
+    for i in done_idx:
+        requests[i]._harvested = True
+        out.append((i, requests[i].status))
+    return out
+
+
 def wait_some(
     requests: Sequence[Request], timeout: float | None = None
-) -> list[tuple[int, Status]]:
-    idx, st = wait_any(requests, timeout)
-    out = [(idx, st)]
-    for i, r in enumerate(requests):
-        if i != idx and (r._poll() or r.done):
-            out.append((i, r.status))
-    return out
+) -> list[tuple[int, Status]] | None:
+    """MPI_Waitsome (reference: ompi/request/req_wait.c:92-141 — block
+    until >=1 active request completes, then harvest EVERY complete
+    one). Returns [(index, status), ...]; None when the list holds no
+    active requests (the MPI_UNDEFINED outcount)."""
+    live = _active_indices(requests)
+    if not live:
+        return None
+
+    def some_done() -> bool:
+        return any(
+            requests[i]._poll() or requests[i].done for i in live
+        )
+
+    if not _progress.ENGINE.progress_until(some_done, timeout):
+        raise TimeoutError("wait_some timed out")
+    return _harvest(requests, live)
 
 
 def test_all(requests: Sequence[Request]) -> tuple[bool, list[Status] | None]:
@@ -227,8 +278,31 @@ def test_all(requests: Sequence[Request]) -> tuple[bool, list[Status] | None]:
 def test_any(
     requests: Sequence[Request],
 ) -> tuple[bool, int | None, Status | None]:
+    """MPI_Testany (reference: ompi/request/req_test.c): flag=True with
+    the first complete active index, or (True, None, None) when no
+    request in the list is active (the MPI_UNDEFINED index), else
+    (False, None, None)."""
+    live = _active_indices(requests)
+    if not live:
+        return True, None, None
     _progress.progress()
-    for i, r in enumerate(requests):
+    for i in live:
+        r = requests[i]
         if r._poll() or r.done:
+            if r.status.error is not None:
+                raise r.status.error
             return True, i, r.status
     return False, None, None
+
+
+def test_some(
+    requests: Sequence[Request],
+) -> list[tuple[int, Status]] | None:
+    """MPI_Testsome (reference: ompi/request/req_test.c): one progress
+    sweep, then harvest every complete active request — [] when none
+    finished yet, None when no request is active (MPI_UNDEFINED)."""
+    live = _active_indices(requests)
+    if not live:
+        return None
+    _progress.progress()
+    return _harvest(requests, live)

@@ -584,13 +584,56 @@ class FabricEngine:
             buf = state.get("buf")
             if buf is None:
                 buf = state["buf"] = bytearray(rawlen)
-                state["got"] = 0
+                state["seen"] = {}  # off -> payload length written
+                state["bytes"] = 0
+            # Wire-derived fields are untrusted: rawlen is pinned by
+            # the FIRST frame of the message (a forged larger value on
+            # a later frame would defeat the bounds check below), and
+            # offsets are checked against the buffer actually allocated
+            # (an out-of-range bytearray slice assignment silently
+            # appends rather than failing). Completion is byte-coverage
+            # accounting — segment-COUNT accounting would let frames
+            # with distinct indices but overlapping offsets complete a
+            # holey buffer (ob1 likewise completes on bytes_received,
+            # pml_ob1_recvreq).
+            if rawlen != len(buf):
+                raise FabricError(
+                    f"DATA segment header mismatch (rawlen={rawlen} "
+                    f"vs {len(buf)})"
+                )
             payload = memoryview(raw)[_DATA_HDR.size:]
+            if off < 0 or off + len(payload) > len(buf):
+                raise FabricError(
+                    f"DATA segment out of bounds (off={off} "
+                    f"len={len(payload)} rawlen={len(buf)})"
+                )
+            if off in state["seen"]:
+                raise FabricError(
+                    f"duplicate DATA segment at off={off} "
+                    f"(cid={cid} seq={seq})"
+                )
+            state["seen"][off] = len(payload)
             buf[off:off + len(payload)] = payload
-            state["got"] += 1
+            state["bytes"] += len(payload)
             SPC.record("fabric_data_segments_recvd")
-            if state["got"] < segs:
+            if state["bytes"] < len(buf):
                 return
+            # Byte count reached rawlen: verify the segments tile the
+            # buffer exactly — overlapping writes can reach the count
+            # while leaving holes. One O(n log n) pass at completion.
+            end = 0
+            for o in sorted(state["seen"]):
+                if o != end:
+                    raise FabricError(
+                        f"DATA reassembly hole at {end} (next segment "
+                        f"at {o}, cid={cid} seq={seq})"
+                    )
+                end = o + state["seen"][o]
+            if end != len(buf):
+                raise FabricError(
+                    f"DATA reassembly overrun/short tail ({end} != "
+                    f"{len(buf)}, cid={cid} seq={seq})"
+                )
             self._await_data.pop(key, None)
         value = unpack_value(bytes(buf),
                              device=pending.dst_proc.device)

@@ -210,6 +210,83 @@ class TestRequests:
         idx, _ = request.wait_any(reqs, timeout=1)
         assert idx == 0
 
+    def test_wait_some_harvests_all_complete(self):
+        """MPI_Waitsome semantics (reference req_wait.c:92-141): block
+        until >=1 active completes, harvest every complete one, skip
+        inactive persistent entries; None when nothing is active."""
+        done = request.CompletedRequest("a")
+        inactive = request.Request(persistent=True)
+        state = {"n": 0}
+
+        def poll():
+            state["n"] += 1
+            return (state["n"] >= 2, "g")
+
+        pending = request.GeneralizedRequest(poll)
+        out = request.wait_some([done, inactive, pending], timeout=5)
+        idxs = [i for i, _ in out]
+        assert 0 in idxs and 1 not in idxs
+        # all inactive → MPI_UNDEFINED analog
+        assert request.wait_some([inactive]) is None
+
+    def test_test_any_and_test_some(self):
+        """MPI_Testany/Testsome (reference req_test.c): non-blocking
+        harvest; no-active-requests returns the UNDEFINED analog."""
+        inactive = request.Request(persistent=True)
+        never = request.GeneralizedRequest(lambda: (False, None))
+        done = request.CompletedRequest(7)
+
+        # Testany: UNDEFINED when nothing active; flag=False while an
+        # active request is incomplete; fires on the complete one.
+        assert request.test_any([inactive]) == (True, None, None)
+        flag, idx, _ = request.test_any([never])
+        assert (flag, idx) == (False, None)
+        flag, idx, st = request.test_any([inactive, never, done])
+        assert flag and idx == 2 and st is done.status
+
+        # Testsome: [] while none finished, entries once they are,
+        # None with no active requests at all.
+        assert request.test_some([inactive]) is None
+        assert request.test_some([never]) == []
+        got = request.test_some([never, done, inactive])
+        assert got == [(1, done.status)]
+
+    def test_some_family_with_mixed_persistent_active(self):
+        """A STARTED persistent request participates; completion via
+        _complete surfaces through wait_some/test_some like any nbc."""
+        preq = request.Request(persistent=True)
+        preq.start()
+        never = request.GeneralizedRequest(lambda: (False, None))
+        assert request.test_some([preq, never]) == []
+        preq._complete("p")
+        out = request.wait_some([preq, never], timeout=5)
+        assert out == [(0, preq.status)]
+        assert preq.result() == "p"  # handle stays readable
+
+    def test_some_family_deallocates_harvested(self):
+        """MPI Waitsome/Testsome deallocate what they return: a request
+        harvested once must never be re-returned (it reads as
+        MPI_REQUEST_NULL), and start() re-arms a persistent one."""
+        done = request.CompletedRequest("x")
+        never = request.GeneralizedRequest(lambda: (False, None))
+        assert request.test_some([done, never]) == [(0, done.status)]
+        # the completed request is now NULL-equivalent: testsome sees
+        # only the incomplete one, and with nothing else active at all
+        # the call reports UNDEFINED
+        assert request.test_some([done, never]) == []
+        assert request.test_some([done]) is None
+        assert request.test_any([done]) == (True, None, None)
+
+        preq = request.Request(persistent=True)
+        preq.start()
+        preq._complete("one")
+        assert request.wait_some([preq], timeout=5) == [(0, preq.status)]
+        assert request.wait_some([preq], timeout=5) is None
+        preq.start()  # re-arm clears the harvest mark
+        preq._complete("two")
+        assert request.wait_some([preq], timeout=5) == [(0, preq.status)]
+        assert preq.result() == "two"
+
     def test_persistent_lifecycle(self):
         r = request.Request(persistent=True)
         assert r.state == request.RequestState.INACTIVE

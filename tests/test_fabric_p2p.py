@@ -151,6 +151,63 @@ def test_raw_data_segments_reassemble_out_of_order():
         eng._on_data_raw(1, hdr + b"x" * 16)
 
 
+def test_raw_data_rejects_out_of_bounds_and_duplicate_segments():
+    """Wire-derived DATA headers are untrusted: out-of-range offsets
+    must fail loudly (a bytearray slice-assign would silently append),
+    rawlen is pinned by the first frame, replayed offsets are rejected,
+    and completion is byte-coverage — overlapping segments that reach
+    the byte count without tiling the buffer must never deliver."""
+    from ompi_tpu.pml.fabric import _DATA_HDR, _DATA_MAGIC, FabricError
+
+    eng = _make_engine()
+
+    class _Req:
+        def _matched(self, env, val):
+            raise AssertionError("must not complete")
+
+    class _Pending:
+        env = None
+
+        class dst_proc:
+            device = None
+
+    key = (1, 7, 3)
+    rawlen = 512
+
+    def frame(off, si, paylen=256, claim=rawlen):
+        hdr = _DATA_HDR.pack(_DATA_MAGIC, 7, 0, 0, 42, 3, claim,
+                             off, 3, si)
+        return hdr + b"z" * paylen
+
+    # offset past the buffer end
+    eng._await_data[key] = (_Req(), _Pending(), {})
+    with pytest.raises(FabricError, match="out of bounds"):
+        eng._on_data_raw(1, frame(off=rawlen - 8, si=0))
+    # negative offset
+    with pytest.raises(FabricError, match="out of bounds"):
+        eng._on_data_raw(1, frame(off=-4, si=0))
+    state = eng._await_data[key][2]
+    assert state["bytes"] == 0 and len(state["buf"]) == rawlen
+
+    # duplicate offset: first lands, replay is rejected, coverage
+    # stays at one segment
+    eng._on_data_raw(1, frame(off=0, si=0))
+    with pytest.raises(FabricError, match="duplicate"):
+        eng._on_data_raw(1, frame(off=0, si=0))
+    assert eng._await_data[key][2]["bytes"] == 256
+
+    # rawlen is pinned by the first frame: a later frame forging a
+    # LARGER rawlen (to defeat the bounds check) is rejected
+    with pytest.raises(FabricError, match="mismatch"):
+        eng._on_data_raw(1, frame(off=600, si=1, claim=4 * rawlen))
+
+    # overlapping distinct offsets reach bytes==rawlen while leaving
+    # bytes 256..383 unwritten: the completion tiling check refuses
+    eng._on_data_raw(1, frame(off=384, si=2, paylen=128))
+    with pytest.raises(FabricError, match="hole"):
+        eng._on_data_raw(1, frame(off=300, si=1, paylen=128))
+
+
 def test_duplicate_seq_rejected():
     from ompi_tpu.pml.fabric import FabricError, K_EAGER
 
