@@ -197,15 +197,28 @@ def wait_all(
 
 def wait_any(
     requests: Sequence[Request], timeout: float | None = None
-) -> tuple[int, Status]:
-    def any_done() -> bool:
-        return any(r._poll() or r.done for r in requests)
-
+) -> tuple[int | None, Status]:
+    """MPI_Waitany: block until one ACTIVE request completes. Entries a
+    some-call already harvested read as MPI_REQUEST_NULL and are
+    skipped; (None, empty Status) when nothing in the list is active
+    (the MPI_UNDEFINED index, consistent with test_any). Unlike the
+    some-family, wait_any does not deallocate — the returned handle
+    stays live for result()."""
     if not requests:
         raise RequestError("wait_any on empty request list")
+    live = _active_indices(requests)
+    if not live:
+        return None, Status()
+
+    def any_done() -> bool:
+        return any(
+            requests[i]._poll() or requests[i].done for i in live
+        )
+
     if not _progress.ENGINE.progress_until(any_done, timeout):
         raise TimeoutError("wait_any timed out")
-    for i, r in enumerate(requests):
+    for i in live:
+        r = requests[i]
         if r.done:
             if r.status.error is not None:
                 raise r.status.error

@@ -554,7 +554,30 @@ class FabricEngine:
                     f"DATA without a matched recv (cid={msg['cid']} "
                     f"seq={msg['seq']})"
                 )
-            req, pending, parts = entry
+            req, pending, state = entry
+            # Same untrusted-header discipline as _on_data_raw, and a
+            # namespaced sub-dict so a message can't be half-assembled
+            # through both framings (the raw path's buf/seen/bytes keys
+            # must never count toward this path's segment tally).
+            parts = state.setdefault("parts", {})
+            if "legacy_segs" not in state:
+                state["legacy_segs"] = n_seg
+            if (n_seg != state["legacy_segs"] or state.get("buf")
+                    is not None):
+                raise FabricError(
+                    f"DATA segment header mismatch (segs={n_seg} vs "
+                    f"{state['legacy_segs']}, mixed framing="
+                    f"{state.get('buf') is not None})"
+                )
+            if not 0 <= si < n_seg:
+                raise FabricError(
+                    f"DATA segment index {si} out of range [0,{n_seg})"
+                )
+            if si in parts:
+                raise FabricError(
+                    f"duplicate DATA segment {si} (cid={msg['cid']} "
+                    f"seq={msg['seq']})"
+                )
             parts[si] = msg["pay"]
             SPC.record("fabric_data_segments_recvd")
             if len(parts) < n_seg:
@@ -581,6 +604,11 @@ class FabricEngine:
                     f"DATA without a matched recv (cid={cid} seq={seq})"
                 )
             req, pending, state = entry
+            if "parts" in state:  # message already assembling dss-framed
+                raise FabricError(
+                    f"mixed DATA framing for one message (cid={cid} "
+                    f"seq={seq})"
+                )
             buf = state.get("buf")
             if buf is None:
                 buf = state["buf"] = bytearray(rawlen)
