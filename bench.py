@@ -406,6 +406,93 @@ def _fabric_loopback() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_SHM_PERF_WORKER = r"""
+import sys, time
+import numpy as np
+from ompi_tpu.btl.sm import ShmEndpoint
+rank = int(sys.argv[1]); prefix = sys.argv[2]
+ep = ShmEndpoint(prefix, rank)
+ep.connect(1 - rank, timeout_s=30)
+N = 1000
+small = b"x" * 64
+if rank == 0:
+    for _ in range(50):
+        ep.send_bytes(1, 1, small); ep.recv_bytes(10)
+    ts = []
+    for _ in range(N):
+        t1 = time.perf_counter()
+        ep.send_bytes(1, 1, small); ep.recv_bytes(10)
+        ts.append(time.perf_counter() - t1)
+    ts.sort()
+    big = np.random.default_rng(0).integers(
+        0, 255, 64 << 20, dtype=np.uint8).tobytes()
+    ep.send_bytes(1, 2, big); ep.recv_bytes(30)
+    bws = []
+    for _ in range(5):
+        t1 = time.perf_counter()
+        ep.send_bytes(1, 2, big); ep.recv_bytes(30)
+        bws.append(time.perf_counter() - t1)
+    bws.sort()
+    import json
+    print("SHMPERF " + json.dumps({
+        "p50_64B_rtt_us": round(ts[len(ts) // 2] * 1e6, 1),
+        "p99_64B_rtt_us": round(ts[int(len(ts) * 0.99)] * 1e6, 1),
+        "gbps_64MiB": round(len(big) / bws[len(bws) // 2] / 1e9, 2),
+    }), flush=True)
+else:
+    for _ in range(50 + N):
+        ep.recv_bytes(30); ep.send_bytes(0, 1, small)
+    for _ in range(6):
+        ep.recv_bytes(60); ep.send_bytes(0, 2, b"a")
+ep.close()
+"""
+
+
+def _shm_2proc() -> dict:
+    """Raw shared-memory engine perf between two processes (the btl/sm
+    analog: fastbox RTT + chunk-streamed bulk; native/src/shm.cc).
+    Replaces the kernel TCP loopback hops the same-host path used to
+    pay — compare p50 against fabric_2proc_mpi's pre-shm ~1 ms."""
+    import subprocess
+    import sys
+    import uuid
+
+    try:
+        from ompi_tpu.btl import sm as _sm
+
+        if not _sm.engine_available():
+            return {"skipped": "native shm engine unavailable"}
+        prefix = f"bench{uuid.uuid4().hex[:8]}"
+        here = os.path.dirname(os.path.abspath(__file__))
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-c", _SHM_PERF_WORKER, str(r), prefix],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                text=True, cwd=here,
+            )
+            for r in range(2)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, err = p.communicate(timeout=180)
+                outs.append((p.returncode, out, err))
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        for rc, out, err in outs:
+            if rc != 0:
+                return {"error": f"worker rc={rc}: {err[-400:]}"}
+        for _, out, _ in outs:
+            for line in out.splitlines():
+                if line.startswith("SHMPERF "):
+                    return json.loads(line[len("SHMPERF "):])
+        return {"error": "no SHMPERF line in worker output"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _FABRIC_PERF_WORKER = r"""
 import json, os, sys, time
 pid = int(sys.argv[1]); nprocs = int(sys.argv[2]); coord = sys.argv[3]
@@ -598,6 +685,9 @@ def bench_single_chip() -> dict:
     _set_phase("fabric loopback (host wire)")
     fabric_loopback = _fabric_loopback()
     _record("fabric_loopback", fabric_loopback)
+    _set_phase("shm 2-process (host wire)")
+    shm_2proc = _shm_2proc()
+    _record("shm_2proc", shm_2proc)
     _set_phase("fabric 2-process MPI (host wire)")
     fabric_2proc = _fabric_2proc()
     _record("fabric_2proc_mpi", fabric_2proc)
@@ -623,6 +713,7 @@ def bench_single_chip() -> dict:
             "pallas": pallas,
             "pallas_attn": pallas_attn,
             "fabric_loopback": fabric_loopback,
+            "shm_2proc": shm_2proc,
             "fabric_2proc_mpi": fabric_2proc,
         },
     }
@@ -769,6 +860,7 @@ def main() -> None:
         _set_phase("probe failed; host-only fabric phases")
         # No TPU in the path for the wire benches — capture them anyway.
         _record("fabric_loopback", _fabric_loopback())
+        _record("shm_2proc", _shm_2proc())
         _record("fabric_2proc_mpi", _fabric_2proc())
         print(_emit_abort(metric, None,
                           "chip probe timed out: device tunnel dead; "

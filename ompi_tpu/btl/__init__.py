@@ -1,6 +1,6 @@
 """Inter-device transfer layer (reference: opal/mca/btl)."""
 
 from .framework import BTL, Bml, BtlComponent
-from . import dcn, template  # noqa: F401 - register btl/dcn, btl/template
+from . import dcn, sm, template  # noqa: F401 - register components
 
-__all__ = ["BTL", "Bml", "BtlComponent", "dcn", "template"]
+__all__ = ["BTL", "Bml", "BtlComponent", "dcn", "sm", "template"]

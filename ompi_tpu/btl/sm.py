@@ -1,0 +1,368 @@
+"""btl/sm — intra-host shared-memory transport.
+
+TPU-native equivalent of opal/mca/btl/sm (reference: btl_sm_fbox.h:22-60
+per-peer lock-free fastboxes; btl_sm_component.c:200,243-245 — 4 KiB
+fastbox / 32 KiB eager regime; btl_sm_module.c FIFO queues). The native
+engine (native/src/shm.cc) owns the POSIX segment, the per-peer-pair
+fastbox + eager SPSC rings, chunked bulk streaming and futex parking;
+this module is the endpoint/bytes API plus the BTL component that makes
+the selection visible to the BML/comm_method layers.
+
+Role in the TPU design (SURVEY §5.8): same-host controller processes
+previously exchanged ALL traffic over TCP loopback through the kernel
+(~1 ms small-message p50 on 1-core hosts — VERDICT r3 missing #1);
+this engine keeps the entire same-host path in user space. Peers are
+addressed by their global process index; the modex publishes
+(segment prefix, hostname) and `pml/fabric.wire_up` connects co-located
+peers here while inter-host peers stay on DCN.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+from ..native import build
+from .framework import BTL, BtlComponent
+
+logger = get_logger("btl.sm")
+
+_fbox_var = config.register(
+    "btl", "sm", "fbox_size", type=int, default=4096,
+    description="Per-peer fastbox ring bytes (reference: btl/sm 4 KiB "
+                "fastbox, btl_sm_component.c:200)",
+)
+_ring_var = config.register(
+    "btl", "sm", "ring_size", type=int, default=1 << 20,
+    description="Per-peer eager/bulk ring bytes (reference: btl/sm FIFO)",
+)
+_max_peers_var = config.register(
+    "btl", "sm", "max_peers", type=int, default=32,
+    description="Sender slots in this process's shared segment",
+)
+_enable_var = config.register(
+    "btl", "sm", "enable", type=bool, default=True,
+    description="Use shared memory for same-host cross-process traffic "
+                "(off: such traffic rides DCN TCP loopback)",
+)
+_eager_limit_var = config.register(
+    "btl", "sm", "eager_limit", type=int, default=32 * 1024,
+    description="Whole-message-inline limit for the shm eager ring; "
+                "larger payloads chunk-stream (reference: btl/sm "
+                "32 KiB eager, btl_sm_component.c:243)",
+)
+
+
+class ShmError(OmpiTpuError):
+    errclass = "ERR_OTHER"
+
+
+def _declare(lib) -> None:
+    import ctypes
+
+    if getattr(lib, "_shm_declared", False):
+        return
+    LL = ctypes.c_longlong
+    P = ctypes.c_void_p
+    lib.shm_create.restype = P
+    lib.shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                               ctypes.c_int, LL, LL, LL]
+    lib.shm_connect.restype = ctypes.c_int
+    lib.shm_connect.argtypes = [P, ctypes.c_int, ctypes.c_int]
+    lib.shm_send.restype = LL
+    lib.shm_send.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL]
+    lib.shm_poll_recv.restype = LL
+    lib.shm_poll_recv.argtypes = [
+        P, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(LL),
+        ctypes.POINTER(LL),
+    ]
+    lib.shm_wait_recv.restype = LL
+    lib.shm_wait_recv.argtypes = [
+        P, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(LL), ctypes.POINTER(LL),
+    ]
+    lib.shm_wait_event.restype = ctypes.c_int
+    lib.shm_wait_event.argtypes = [P, ctypes.c_int]
+    lib.shm_notify.restype = None
+    lib.shm_notify.argtypes = [P]
+    lib.shm_read.restype = LL
+    lib.shm_read.argtypes = [P, LL, ctypes.c_void_p, LL]
+    lib.shm_stat.restype = LL
+    lib.shm_stat.argtypes = [P, ctypes.c_int]
+    lib.shm_peer_alive.restype = ctypes.c_int
+    lib.shm_peer_alive.argtypes = [P, ctypes.c_int]
+    lib.shm_destroy.restype = None
+    lib.shm_destroy.argtypes = [P]
+    lib._shm_declared = True
+
+
+_STAT_NAMES = (
+    "bytes_sent", "bytes_recv", "fbox_sends", "ring_sends",
+    "chunk_msgs", "msgs_recvd", "send_stalls", "fbox_recvs", "peers",
+)
+
+
+class ShmEndpoint:
+    """One process's shared-memory presence: its own segment plus maps
+    of each connected peer's. Peers are global process indices (the
+    slot-owner table in the segment records them)."""
+
+    def __init__(self, prefix: str, my_rank: int) -> None:
+        lib = build.get_lib()
+        if lib is None or not hasattr(lib, "shm_create"):
+            raise ShmError("native shm engine unavailable")
+        _declare(lib)
+        self._lib = lib
+        self.prefix = prefix
+        self.my_rank = my_rank
+        self._ctx = lib.shm_create(
+            prefix.encode(), my_rank, _max_peers_var.value,
+            _fbox_var.value, _ring_var.value,
+            _eager_limit_var.value,
+        )
+        if not self._ctx:
+            raise ShmError(
+                f"cannot create shm segment /{prefix}_{my_rank}"
+            )
+        self._mu = threading.Lock()
+        self._inflight = 0
+        self._closed = False
+        self.peers: set[int] = set()
+
+    @contextlib.contextmanager
+    def _native_call(self, *, what: str):
+        with self._mu:
+            if self._closed:
+                raise ShmError(f"endpoint closed during {what}")
+            self._inflight += 1
+        try:
+            yield
+        finally:
+            with self._mu:
+                self._inflight -= 1
+
+    def connect(self, peer_rank: int, timeout_s: float = 30.0) -> None:
+        with self._native_call(what="connect"):
+            rc = self._lib.shm_connect(
+                self._ctx, peer_rank, int(timeout_s * 1000)
+            )
+        if rc != 0:
+            raise ShmError(
+                f"cannot attach peer {peer_rank}'s shm segment "
+                f"(/{self.prefix}_{peer_rank})"
+            )
+        self.peers.add(peer_rank)
+
+    def send_bytes(self, peer_rank: int, tag: int, data) -> int:
+        buf = np.ascontiguousarray(np.frombuffer(data, np.uint8))
+        with self._native_call(what="send"):
+            rc = self._lib.shm_send(
+                self._ctx, peer_rank, tag, buf.ctypes.data, buf.nbytes
+            )
+        if rc == -1:
+            raise ShmError(f"send to unconnected shm peer {peer_rank}")
+        if rc == -2:
+            raise ShmError(f"shm peer {peer_rank} is dead")
+        SPC.record("sm_send_bytes", buf.nbytes)
+        return 0  # copy semantics: complete on return
+
+    def poll_recv(self) -> Optional[tuple[int, int, bytes]]:
+        import ctypes
+
+        peer = ctypes.c_int(0)
+        tag = ctypes.c_longlong(0)
+        length = ctypes.c_longlong(0)
+        try:
+            with self._native_call(what="poll"):
+                msgid = self._lib.shm_poll_recv(
+                    self._ctx, ctypes.byref(peer), ctypes.byref(tag),
+                    ctypes.byref(length),
+                )
+                if not msgid:
+                    return None
+                return self._consume(msgid, peer, tag, length)
+        except ShmError:
+            return None  # closed
+
+    def _consume(self, msgid, peer, tag, length) -> tuple[int, int, bytes]:
+        buf = np.empty(max(1, length.value), np.uint8)
+        got = self._lib.shm_read(
+            self._ctx, msgid, buf.ctypes.data, length.value
+        )
+        if got != length.value:
+            raise ShmError(f"short shm read {got} != {length.value}")
+        SPC.record("sm_recv_bytes", length.value)
+        return int(peer.value), int(tag.value), buf[:length.value].tobytes()
+
+    def recv_bytes(self, timeout: float = 10.0) -> tuple[int, int, bytes]:
+        import ctypes
+
+        deadline = time.monotonic() + timeout
+        peer = ctypes.c_int(0)
+        tag = ctypes.c_longlong(0)
+        length = ctypes.c_longlong(0)
+        while True:
+            remaining = deadline - time.monotonic()
+            slice_ms = max(1, min(100, int(remaining * 1000)))
+            with self._native_call(what="recv"):
+                msgid = self._lib.shm_wait_recv(
+                    self._ctx, slice_ms, ctypes.byref(peer),
+                    ctypes.byref(tag), ctypes.byref(length),
+                )
+                if msgid:
+                    return self._consume(msgid, peer, tag, length)
+            if time.monotonic() >= deadline:
+                raise ShmError("shm recv timeout")
+
+    def wait_event(self, timeout: float) -> bool:
+        ms = max(1, min(200, int(timeout * 1000)))
+        try:
+            with self._native_call(what="wait_event"):
+                return bool(self._lib.shm_wait_event(self._ctx, ms))
+        except ShmError:
+            return False  # closed
+
+    def notify(self) -> None:
+        try:
+            with self._native_call(what="notify"):
+                self._lib.shm_notify(self._ctx)
+        except ShmError:
+            pass
+
+    def poll_send_complete(self) -> Optional[int]:
+        return None  # sends complete synchronously (copy semantics)
+
+    def peer_alive(self, peer_rank: int) -> bool:
+        try:
+            with self._native_call(what="peer_alive"):
+                return bool(
+                    self._lib.shm_peer_alive(self._ctx, peer_rank)
+                )
+        except ShmError:
+            return False
+
+    def stats(self) -> dict:
+        with self._native_call(what="stats"):
+            return {
+                n: int(self._lib.shm_stat(self._ctx, i))
+                for i, n in enumerate(_STAT_NAMES)
+            }
+
+    def close(self) -> None:
+        with self._mu:
+            if self._closed:
+                return
+            self._closed = True
+        # Wake parked waiters, then drain in-flight native calls before
+        # unmapping (same discipline as DcnEndpoint.close).
+        try:
+            self._lib.shm_notify(self._ctx)
+        except Exception:
+            pass
+        deadline = time.monotonic() + 5.0
+        remaining = 1
+        while time.monotonic() < deadline:
+            with self._mu:
+                remaining = self._inflight
+            if remaining == 0:
+                break
+            time.sleep(0.001)
+        if remaining:
+            logger.warning(
+                "shm close: %d native call(s) did not drain; leaking "
+                "the segment mapping rather than unmapping mid-call",
+                remaining,
+            )
+            return
+        self._lib.shm_destroy(self._ctx)
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+def engine_available() -> bool:
+    """True when the native shm engine is usable and enabled."""
+    if not _enable_var.value:
+        return False
+    lib = build.get_lib()
+    return lib is not None and hasattr(lib, "shm_create")
+
+
+def host_identity() -> dict:
+    """Same-machine identity for the modex business card: hostname can
+    collide across containers, so pair it with the kernel boot id."""
+    import socket
+
+    boot = ""
+    try:
+        with open("/proc/sys/kernel/random/boot_id") as f:
+            boot = f.read().strip()
+    except OSError:
+        pass
+    return {"host": socket.gethostname(), "boot": boot}
+
+
+def new_prefix() -> str:
+    """Job-unique segment prefix (rank 0 generates, the modex shares
+    it): uid keeps parallel users on one box apart."""
+    import uuid
+
+    return f"ompitpu{os.getuid()}_{uuid.uuid4().hex[:10]}"
+
+
+@BTL.register
+class SmBtl(BtlComponent):
+    """Same-host cross-process transport (shared memory). Outranks DCN
+    for co-located peers (reference: btl/sm priority over tcp) — the
+    actual byte path lives in the fabric's endpoint mux; this component
+    makes the selection visible to the BML and comm_method."""
+
+    NAME = "sm"
+    PRIORITY = 40  # below self/ici (in-process), above dcn (10)
+    EAGER_LIMIT = 32 * 1024  # btl_sm_component.c:243
+
+    def available(self, **ctx: Any) -> bool:
+        return engine_available()
+
+    def can_reach(self, src_proc, dst_proc) -> bool:
+        if src_proc.process_index == dst_proc.process_index:
+            return False  # in-process: self/ici win
+        from ..pml.framework import PML
+
+        try:
+            ob1 = PML.component("ob1")
+        except Exception:
+            return False
+        eng = getattr(ob1, "_fabric", None)
+        if eng is None:
+            return False
+        shm_peers = getattr(eng, "shm_peers", set())
+        import jax
+
+        me = jax.process_index()
+        return all(
+            idx == me or idx in shm_peers
+            for idx in (src_proc.process_index, dst_proc.process_index)
+        )
+
+    def transfer(self, value, src_proc, dst_proc):
+        from ..core.errors import CommError
+
+        raise CommError(
+            "SmBtl.transfer: cross-process p2p goes through the PML "
+            "fabric (ompi_tpu.pml.fabric.wire_up routes co-located "
+            "peers over the shm endpoint); byte-level sends are "
+            "available via ShmEndpoint"
+        )

@@ -91,6 +91,13 @@ _segment_var = config.register(
                 "RDMA/FRAG pipeline, pml_ob1_sendreq.h:385-455; 1 MiB "
                 "tuned segment)",
 )
+_strict_place_var = config.register(
+    "pml", "fabric", "strict_placement", type=bool, default=False,
+    description="Force jax.Array delivery (device_put) even for "
+                "fastbox-tier messages on CPU destinations; default "
+                "delivers those as writable host ndarrays, saving "
+                "~40 us of backend dispatch per small message",
+)
 
 
 # -- fast-path wire format ---------------------------------------------------
@@ -218,6 +225,13 @@ class FabricEngine:
         self.my_index = my_index
         self.n_processes = n_processes
         self.peer_ids: dict[int, int] = {}  # process index -> dcn peer id
+        # Same-host peers ride shared memory instead of DCN TCP (the
+        # BML role: choose the transport per peer — reference:
+        # bml_r2.c:65 endpoint arrays; btl/sm beats btl/tcp on
+        # priority for co-located procs). shm addresses peers by their
+        # global process index directly.
+        self.shm = None  # ShmEndpoint | None
+        self.shm_peers: set[int] = set()
         self._lock = threading.RLock()
         self._send_seq: dict[tuple[int, int], int] = {}  # (cid,dst_idx)
         self._expect: dict[tuple[int, int], int] = {}    # (cid,src_idx)
@@ -273,6 +287,10 @@ class FabricEngine:
         raise FabricError(f"message on unmapped dcn peer {peer}")
 
     def _send_raw(self, dst_idx: int, dcn_tag: int, raw: bytes) -> None:
+        if self.shm is not None and dst_idx in self.shm_peers:
+            self.shm.send_bytes(dst_idx, dcn_tag, raw)
+            SPC.record("fabric_sm_sends")
+            return
         pid = self.peer_ids.get(dst_idx)
         if pid is None:
             raise FabricError(
@@ -348,34 +366,47 @@ class FabricEngine:
         finally:
             self._pump_mu.release()
 
+    def _handle_frame(self, src_idx: int, tag: int, raw) -> bool:
+        """Dispatch one wire frame from either transport (shm or DCN);
+        False for unknown channel tags."""
+        if tag == P2P_FAST_TAG:
+            self._dispatch(src_idx, decode_fast(raw))
+            SPC.record("fabric_fast_recvs")
+        elif tag == P2P_DATA_TAG:
+            try:
+                self._on_data_raw(src_idx, raw)
+            except FabricError as exc:
+                hdr = _DATA_HDR.unpack_from(raw)
+                if hdr[0] != _DATA_MAGIC:
+                    raise  # untrusted header: never route by it
+                shim = {"k": K_DATA, "cid": hdr[1], "seq": hdr[5]}
+                if not self._route_error(src_idx, shim, exc):
+                    raise
+        elif tag == P2P_TAG:
+            self._dispatch(src_idx, dss.unpack_one(raw))
+        else:
+            logger.warning("non-p2p frame (tag %#x) on fabric", tag)
+            return False
+        return True
+
     def _progress_locked(self) -> int:
         n = 0
+        # shm first: same-host frames are the latency-critical tier
+        if self.shm is not None:
+            while True:
+                got = self.shm.poll_recv()
+                if got is None:
+                    break
+                src_idx, tag, raw = got  # shm peers ARE process indices
+                if self._handle_frame(src_idx, tag, raw):
+                    n += 1
         while True:
             got = self.ep.poll_recv()
             if got is None:
                 break
             peer, tag, raw = got
-            if tag == P2P_FAST_TAG:
-                self._dispatch(self._peer_index(peer), decode_fast(raw))
-                SPC.record("fabric_fast_recvs")
-            elif tag == P2P_DATA_TAG:
-                src_idx = self._peer_index(peer)
-                try:
-                    self._on_data_raw(src_idx, raw)
-                except FabricError as exc:
-                    hdr = _DATA_HDR.unpack_from(raw)
-                    if hdr[0] != _DATA_MAGIC:
-                        raise  # untrusted header: never route by it
-                    shim = {"k": K_DATA, "cid": hdr[1], "seq": hdr[5]}
-                    if not self._route_error(src_idx, shim, exc):
-                        raise
-            elif tag == P2P_TAG:
-                self._dispatch(self._peer_index(peer),
-                               dss.unpack_one(raw))
-            else:
-                logger.warning("non-p2p frame (tag %#x) on fabric", tag)
-                continue
-            n += 1
+            if self._handle_frame(self._peer_index(peer), tag, raw):
+                n += 1
         # Streams held on a not-yet-created communicator (the comm-
         # creation race) retry here once the local comm exists.
         with self._lock:
@@ -672,8 +703,19 @@ class FabricEngine:
         import jax
 
         if isinstance(payload_bytes, _FastPayload):
-            return jax.device_put(payload_bytes.to_array(),
-                                  dst_proc.device)
+            arr = payload_bytes.to_array()
+            if (getattr(dst_proc.device, "platform", None) == "cpu"
+                    and not _strict_place_var.value):
+                # Fastbox tier on a CPU destination: a host ndarray IS
+                # device-resident there, and jax.device_put would add
+                # ~40 us of backend bookkeeping per message — the exact
+                # regime this path exists to keep short. Delivered as a
+                # WRITABLE copy (frombuffer views are read-only);
+                # pml_fabric_strict_placement restores jax.Array
+                # delivery. Bulk/rendezvous always keeps the jax.Array
+                # placement contract.
+                return np.array(arr)
+            return jax.device_put(arr, dst_proc.device)
         return unpack_value(payload_bytes, device=dst_proc.device)
 
     def idle_wait(self, budget: float) -> bool:
@@ -683,6 +725,22 @@ class FabricEngine:
         starves the transport threads and cross-process latency
         degrades to scheduler quanta). Only engages once wired — pure
         in-process programs keep the spin-yield behavior."""
+        have_dcn_peers = bool(self.peer_ids) and any(
+            idx not in self.shm_peers for idx in self.peer_ids
+        )
+        if self.shm is not None and self.shm_peers:
+            if not have_dcn_peers:
+                # single-host job: park fully on the shm doorbell futex
+                self.shm.wait_event(budget)
+                return True
+            # mixed transports, one parking thread: alternate short
+            # slices so neither wire waits a full budget behind the
+            # other
+            self.shm.wait_event(min(budget / 2, 0.002))
+            wait = getattr(self.ep, "wait_event", None)
+            if wait is not None:
+                wait(min(budget / 2, 0.002))
+            return True
         if not self.peer_ids:
             return False
         wait = getattr(self.ep, "wait_event", None)
@@ -696,7 +754,78 @@ class FabricEngine:
     def close(self) -> None:
         _progress.unregister(self.progress)
         _progress.unregister_idle(self.idle_wait)
+        if self.shm is not None:
+            self.shm.close()
         self.ep.close()
+
+    def notify(self) -> None:
+        """Wake whichever transport the idle hook is parked on."""
+        if self.shm is not None:
+            self.shm.notify()
+        n = getattr(self.ep, "notify", None)
+        if n is not None:
+            n()
+
+
+def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
+              host_id: dict, my: int, timeout_s: float) -> None:
+    """Attach the shared-memory endpoint for co-located peers (the
+    btl/sm selection: same host -> shm beats tcp; reference priority
+    ordering btl_sm_component.c vs btl_tcp). Rank 0 generates the
+    job-unique segment prefix; the modex shares it. Failures degrade to
+    DCN (which already works) rather than failing the job."""
+    from ..btl import sm as _sm
+    from ..runtime import modex
+
+    # Rank 0 publishes the job prefix UNCONDITIONALLY — it may itself
+    # have no co-located peers (multi-host topologies), and peers on
+    # other hosts must not stall a full modex timeout waiting for it.
+    if my == 0:
+        modex.put("shm/prefix", _sm.new_prefix())
+    co_located = [
+        idx for idx, rec in peer_recs.items()
+        if rec.get("host") == host_id["host"]
+        and rec.get("boot") == host_id["boot"]
+    ]
+    if not co_located or not _sm.engine_available():
+        return
+    # Two-phase wiring so a partial failure can't poison peers: phase 1
+    # creates segments and attaches every co-located peer; phase 2
+    # exchanges per-process outcome, and ONLY mutually-ok peers route
+    # over shm. A process whose wiring failed publishes ok=False and
+    # destroys its endpoint — peers exclude it before any send, so its
+    # dead segment is never dialed.
+    shm = None
+    ok = False
+    try:
+        prefix = modex.get("shm/prefix", timeout_s=timeout_s)
+        shm = _sm.ShmEndpoint(prefix, my)
+        modex.put(f"shm/{my}", {"ready": True})
+        for idx in co_located:
+            modex.get(f"shm/{idx}", timeout_s=timeout_s)
+            shm.connect(idx, timeout_s=timeout_s)
+        ok = True
+    except Exception as exc:
+        logger.warning(
+            "shm wiring failed (%s); same-host peers stay on DCN", exc
+        )
+    modex.put(f"shm_ok/{my}", bool(ok))
+    if not ok:
+        if shm is not None:
+            shm.close()
+        return
+    good = set()
+    for idx in co_located:
+        try:
+            if modex.get(f"shm_ok/{idx}", timeout_s=timeout_s):
+                good.add(idx)
+        except Exception:
+            pass  # peer never reported: leave it on DCN
+    engine.shm = shm
+    engine.shm_peers = good
+    SPC.record("fabric_sm_peers", len(good))
+    logger.info("shm wired: process %d, co-located peers %s", my,
+                sorted(good))
 
 
 def wire_up(*, endpoint=None, timeout_s: float = 60.0,
@@ -721,22 +850,28 @@ def wire_up(*, endpoint=None, timeout_s: float = 60.0,
     from .mtl import MTL_MATCH_TAG
 
     ep.enable_matching(MTL_MATCH_TAG)
-    modex.put(f"p2p/{my}", {"ip": ep.address[0], "port": ep.address[1]})
+    from ..btl import sm as _sm
+
+    host_id = _sm.host_identity()
+    modex.put(f"p2p/{my}", {"ip": ep.address[0], "port": ep.address[1],
+                            **host_id})
     engine = FabricEngine(ep, my, n)
+    peer_recs: dict[int, dict] = {}
     for idx in range(n):
         if idx == my:
             continue
         rec = modex.get(f"p2p/{idx}", timeout_s=timeout_s)
+        peer_recs[idx] = rec
         engine.peer_ids[idx] = ep.connect(
             rec["ip"], rec["port"], cookie=my + 1, nlinks=nlinks
         )
+    _wire_shm(engine, peer_recs, host_id, my, timeout_s)
     ensure_components()
     ob1 = PML.component("ob1")
     ob1.attach_fabric(engine)
     engine.attach_pml(ob1)
     _progress.register(engine.progress)
-    _progress.register_idle(engine.idle_wait,
-                            wake=getattr(ep, "notify", None))
+    _progress.register_idle(engine.idle_wait, wake=engine.notify)
     # Re-run coll selection on live comms: components gated on fabric
     # availability (coll/hier for spanning comms) become selectable now
     # (the reference's comm_select runs after add_procs+modex for the
