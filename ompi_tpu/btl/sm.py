@@ -181,17 +181,24 @@ class ShmEndpoint:
         peer = ctypes.c_int(0)
         tag = ctypes.c_longlong(0)
         length = ctypes.c_longlong(0)
+        # Only the closed-endpoint race (guard entry) maps to "no
+        # message"; a _consume failure after the native side already
+        # popped the message must propagate, not silently drop it.
+        guard = self._native_call(what="poll")
         try:
-            with self._native_call(what="poll"):
-                msgid = self._lib.shm_poll_recv(
-                    self._ctx, ctypes.byref(peer), ctypes.byref(tag),
-                    ctypes.byref(length),
-                )
-                if not msgid:
-                    return None
-                return self._consume(msgid, peer, tag, length)
+            guard.__enter__()
         except ShmError:
             return None  # closed
+        try:
+            msgid = self._lib.shm_poll_recv(
+                self._ctx, ctypes.byref(peer), ctypes.byref(tag),
+                ctypes.byref(length),
+            )
+            if not msgid:
+                return None
+            return self._consume(msgid, peer, tag, length)
+        finally:
+            guard.__exit__(None, None, None)
 
     def _consume(self, msgid, peer, tag, length) -> tuple[int, int, bytes]:
         buf = np.empty(max(1, length.value), np.uint8)

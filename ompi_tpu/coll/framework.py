@@ -199,9 +199,12 @@ class PersistentColl(Request):
         self.buffer = x
 
     def _start(self) -> None:
-        self._pending = self._comm._coll_call(
-            self._opname, self.buffer, *self._args
-        )
+        if self._opname == "barrier":  # the one bufferless operation
+            self._pending = self._comm._coll_call("barrier")
+        else:
+            self._pending = self._comm._coll_call(
+                self._opname, self.buffer, *self._args
+            )
 
     def _poll(self) -> bool:
         if self.done:
@@ -243,6 +246,7 @@ def register_components() -> None:
         hier,
         pallas_ring,
         selfcoll,
+        smcoll,
         sync,
         tuned,
         xla,

@@ -526,12 +526,10 @@ class FabricSlice:
 
 
 def comm_slice(comm) -> FabricSlice:
-    """The comm's auto-wired hier handle (built once, cached)."""
-    h = getattr(comm, "_hier_slice", None)
-    if h is None:
-        h = FabricSlice(comm)
-        comm._hier_slice = h
-    return h
+    """The comm's auto-wired hier handle (built once, cached) — the
+    module-level entry for non-coll callers (osc/fabric_window);
+    delegates to the single implementation on HierColl."""
+    return HierColl.comm_slice(comm)
 
 
 # -- spanning-comm data-movement and prefix collectives ---------------------
@@ -588,7 +586,7 @@ def _hier_op(fn):
 
     @functools.wraps(fn)
     def wrapped(self, comm, *args, **kw):
-        h = comm_slice(comm)
+        h = self.comm_slice(comm)
         tag = h.next_tag_base()
         try:
             out = fn(self, comm, h, tag, *args, **kw)
@@ -1050,6 +1048,19 @@ class HierColl(_HierDataOps, CollComponent):
     PRIORITY = 85  # above tuned (80): device tiers cannot cross controllers
     DESCRIPTION = ("two-level ICI+DCN collectives for process-spanning "
                    "communicators (auto-wired from the fabric)")
+    #: Subclasses swap the leader-exchange handle (coll/smcoll routes
+    #: it over raw shared-memory frames) and the per-comm cache slot.
+    SLICE_FACTORY = FabricSlice
+    SLICE_ATTR = "_hier_slice"
+
+    @classmethod
+    def comm_slice(cls, comm):
+        """This component's cached exchange handle for `comm`."""
+        h = getattr(comm, cls.SLICE_ATTR, None)
+        if h is None:
+            h = cls.SLICE_FACTORY(comm)
+            setattr(comm, cls.SLICE_ATTR, h)
+        return h
 
     def available(self, comm=None, **_) -> bool:
         if comm is None:
@@ -1064,7 +1075,7 @@ class HierColl(_HierDataOps, CollComponent):
                 and _fabric_wired())
 
     def allreduce(self, comm, x, op):
-        h = comm_slice(comm)
+        h = self.comm_slice(comm)
         opo = op_lookup(op)
         schedule = h.ordered_schedule(opo)
         try:
@@ -1080,7 +1091,7 @@ class HierColl(_HierDataOps, CollComponent):
     def bcast(self, comm, x, root):
         import jax.numpy as jnp
 
-        h = comm_slice(comm)
+        h = self.comm_slice(comm)
         x = h.local_rank_major(x)
         root_slice = h.rank_slice[root]
         tag = h.next_tag_base()
@@ -1108,7 +1119,7 @@ class HierColl(_HierDataOps, CollComponent):
         at root)."""
         import jax
 
-        h = comm_slice(comm)
+        h = self.comm_slice(comm)
         x = h.local_rank_major(x)
         opo = op_lookup(op)
         h.ordered_schedule(opo)  # layout guard for non-commutative ops
@@ -1143,7 +1154,7 @@ class HierColl(_HierDataOps, CollComponent):
     def barrier(self, comm):
         """Local device barrier, then a zero-payload leader exchange
         (gather+release — no controller leaves before all entered)."""
-        h = comm_slice(comm)
+        h = self.comm_slice(comm)
         h.comm.barrier()
         token = np.zeros(1, np.uint8)
         try:

@@ -1,0 +1,189 @@
+"""coll/sm — same-host spanning collectives over shared memory.
+
+TPU-native equivalent of ompi/mca/coll/sm (reference: coll_sm.h:35-120
+— per-comm shm segment with fan-in/fan-out and in_use-flag flow
+control; selected above the network paths for fully-intra-node comms).
+Here the local phases already run device-resident on each controller's
+slice (the hier design); what coll/sm contributes is the LEADER
+exchange: when every process of a spanning communicator shares the
+host, phase-2 traffic moves as raw frames through the btl/sm segment
+— no MPI envelope, no matching queues, no per-hop request objects —
+via a fabric byte channel (FabricEngine.open_channel).
+
+Selection: priority 87 beats coll/hier (85) exactly when the comm is
+same-host-complete (the reference's coll/sm outranks tuned/tcp for
+intra-node comms and withdraws otherwise, coll_sm_module.c query).
+All schedules (rd/ring/gather, v/w variants, neighborhood, prefix) are
+inherited from HierColl — only the wire changes.
+"""
+
+from __future__ import annotations
+
+import struct
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+from ..core import progress as _progress
+from ..core.counters import SPC
+from ..pml.fabric import COLL_SM_TAG
+from .framework import COLL
+from .hier import FabricSlice, HierColl, HierError, _fabric_wired
+
+#: per-frame header: collective tag (q), source slice (i), comm cid (i)
+_HDR = struct.Struct("<qii")
+
+
+def _engine():
+    from ..pml.framework import PML
+
+    try:
+        return getattr(PML.component("ob1"), "_fabric", None)
+    except Exception:
+        return None
+
+
+class _Router:
+    """Engine-wide demux of the coll/sm channel: frames land keyed by
+    (cid, src_slice, tag) so interleaved collectives on different
+    comms never steal each other's traffic. Locked — concurrent
+    collectives on different comms drain from different threads."""
+
+    def __init__(self, engine) -> None:
+        self.engine = engine
+        self.q = engine.open_channel(COLL_SM_TAG)
+        self.stash: dict[tuple, deque] = {}
+        self._mu = threading.Lock()
+
+    def _drain_locked(self) -> None:
+        while True:
+            try:
+                _src_idx, raw = self.q.popleft()
+            except IndexError:
+                break
+            tag, src_slice, cid = _HDR.unpack_from(raw)
+            self.stash.setdefault((cid, src_slice, tag),
+                                  deque()).append(raw[_HDR.size:])
+
+    def pop(self, key) -> Optional[bytes]:
+        with self._mu:
+            self._drain_locked()
+            q = self.stash.get(key)
+            if q:
+                out = q.popleft()
+                if not q:
+                    del self.stash[key]
+                return out
+            return None
+
+    def purge_window(self, cid: int, lo: int, hi: int) -> None:
+        """Drop stashed frames of an aborted collective so the 4096-
+        epoch tag-window recycle can never resurrect them as a later
+        collective's data."""
+        with self._mu:
+            self._drain_locked()
+            dead = [k for k in self.stash
+                    if k[0] == cid and lo <= k[2] < hi]
+            for k in dead:
+                del self.stash[k]
+
+
+def _router(engine) -> _Router:
+    r = getattr(engine, "_coll_sm_router", None)
+    if r is None:
+        r = engine._coll_sm_router = _Router(engine)
+    return r
+
+
+class ShmSlice(FabricSlice):
+    """FabricSlice whose leader exchange rides raw shm frames instead
+    of MPI p2p: one segment write + one futex wake per hop (the
+    fan-in/fan-out byte path of the reference's coll/sm, with the shm
+    rings standing in for its in_use-flagged fragment segments)."""
+
+    def __init__(self, parent) -> None:
+        super().__init__(parent)
+        eng = _engine()
+        if eng is None or eng.shm is None:
+            raise HierError("coll/sm needs the shm-wired fabric")
+        self.engine = eng
+        self.router = _router(eng)
+
+    def send_bytes(self, peer_slice: int, tag: int, raw: bytes) -> None:
+        dst_proc = self.slices[peer_slice]
+        hdr = _HDR.pack(tag, self.slice_id, self.parent.cid)
+        self.engine.shm.send_bytes(dst_proc, COLL_SM_TAG, hdr + raw)
+        SPC.record("coll_sm_leader_sends")
+        SPC.record("coll_sm_leader_bytes", len(raw))
+
+    def recv_from(self, src_slice: int, tag: int,
+                  timeout: float) -> bytes:
+        key = (self.parent.cid, src_slice, tag)
+        deadline = time.monotonic() + timeout
+        spins = 0
+        while True:
+            out = self.router.pop(key)
+            if out is not None:
+                return out
+            # liveness probe (a kill(pid,0) syscall) only every ~50th
+            # pass — per-iteration it would tax the very latency path
+            # this transport shortens
+            spins += 1
+            if spins % 50 == 0 and not self.engine.shm.peer_alive(
+                    self.slices[src_slice]):
+                raise HierError(
+                    f"coll/sm: slice {src_slice}'s controller died "
+                    "mid-collective"
+                )
+            if time.monotonic() >= deadline:
+                raise HierError(
+                    f"coll/sm: timeout waiting for {key}"
+                )
+            # pump the fabric (fills the channel), then park briefly on
+            # the shm doorbell
+            if _progress.progress() == 0:
+                self.engine.shm.wait_event(0.002)
+
+    def next_tag_base(self) -> int:
+        self._window = super().next_tag_base()
+        return self._window
+
+    def finish(self) -> None:
+        pass  # shm sends complete on return (copy semantics)
+
+    def abort_pending(self) -> None:
+        # Purge this collective's window from the engine stash: an
+        # aborted exchange may have landed frames that the (mod-4096)
+        # tag-window recycle would otherwise hand to a much-later
+        # collective as data.
+        w = getattr(self, "_window", None)
+        if w is not None:
+            self.router.purge_window(self.parent.cid, w, w + 0x10000)
+
+
+@COLL.register
+class SmColl(HierColl):
+    NAME = "sm"
+    PRIORITY = 87  # above hier (85): same wire family, fewer hops
+    DESCRIPTION = ("same-host spanning collectives with the leader "
+                   "exchange over the btl/sm segment (reference: "
+                   "ompi/mca/coll/sm, coll_sm.h:35-120)")
+    SLICE_FACTORY = ShmSlice
+    SLICE_ATTR = "_coll_sm_slice"
+
+    def available(self, comm=None, **_) -> bool:
+        if comm is None or not _fabric_wired():
+            return False
+        import jax
+
+        eng = _engine()
+        if eng is None or eng.shm is None:
+            return False
+        try:
+            idxs = {p.process_index for p in comm.procs}
+        except Exception:
+            return False
+        me = jax.process_index()
+        return (len(idxs) > 1 and me in idxs
+                and all(i == me or i in eng.shm_peers for i in idxs))

@@ -111,7 +111,11 @@ struct ChunkHdr {
 };
 
 struct SegHdr {
-  uint32_t magic;
+  // Atomic: the creator's release-store of magic publishes the whole
+  // initialized header; connectors acquire-load it before reading any
+  // geometry field (a plain flag would be a data race and could leak
+  // stale sizes on weakly-ordered CPUs).
+  std::atomic<uint32_t> magic;
   uint32_t version;
   int32_t pid;
   int32_t max_peers;
@@ -463,7 +467,11 @@ void* shm_create(const char* prefix, int my_rank, int max_peers,
     delete c;
     return nullptr;
   }
-  memset(base, 0, total);
+  // Only the header needs explicit zeroing before field init: a new
+  // POSIX shm object's pages are kernel-zeroed on first fault, and
+  // memset of the whole segment would commit every slot's pages
+  // (~33 MiB at defaults) whether or not a peer ever claims them.
+  memset(base, 0, header_bytes(max_peers));
   SegHdr* seg = reinterpret_cast<SegHdr*>(base);
   seg->version = kVersion;
   seg->pid = (int32_t)getpid();
@@ -477,8 +485,7 @@ void* shm_create(const char* prefix, int my_rank, int max_peers,
     slot_fbox(seg, i)->size = (uint64_t)fbox_size;
     slot_ring(seg, i)->size = (uint64_t)ring_size;
   }
-  std::atomic_thread_fence(std::memory_order_release);
-  seg->magic = kMagic;  // publish: connectors poll for this
+  seg->magic.store(kMagic, std::memory_order_release);  // publish
   c->seg = seg;
   c->map_len = total;
   return c;
@@ -507,10 +514,13 @@ int shm_connect(void* ctx, int peer_rank, int timeout_ms) {
         close(fd);
         if (base != MAP_FAILED) {
           SegHdr* s = reinterpret_cast<SegHdr*>(base);
-          // wait for the magic publish
+          // wait for the magic publish (acquire pairs with the
+          // creator's release store, making the geometry visible)
           int tries = 0;
-          while (s->magic != kMagic && tries++ < 1000) sched_yield();
-          if (s->magic == kMagic) {
+          while (s->magic.load(std::memory_order_acquire) != kMagic
+                 && tries++ < 1000)
+            sched_yield();
+          if (s->magic.load(std::memory_order_acquire) == kMagic) {
             seg = s;
             total = (size_t)st.st_size;
             break;

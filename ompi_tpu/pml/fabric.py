@@ -59,6 +59,11 @@ P2P_TAG = 0x4D504950
 #: analog — reference: btl_sm_fbox.h:22-60, 4 KiB fastbox;
 #: mca_pml_ob1_send_inline -> btl_sendi, pml_ob1_isend.c:246)
 P2P_FAST_TAG = 0x4D504946
+#: wire tag of the coll/sm leader-exchange channel ("CSMC") — defined
+#: here so shm wire-up can open the channel EAGERLY: a peer's first
+#: same-host collective may land frames before this process builds its
+#: first ShmSlice, and an unowned tag would be dropped
+COLL_SM_TAG = 0x43534D43
 #: DCN frame tag for rendezvous DATA segments ("MPID"): fixed binary
 #: header + raw payload slice, assembled into a preallocated buffer on
 #: the receiver — no per-segment dss dict on either side (the FRAG
@@ -241,6 +246,10 @@ class FabricEngine:
         self._rndv_out: dict[tuple[int, int, int], tuple[Any, Any]] = {}
         self._await_data: dict[tuple[int, int, int], tuple[Any, Any]] = {}
         self._comms = weakref.WeakValueDictionary()  # cid -> Communicator
+        # Raw byte channels for non-PML consumers (coll/smcoll's leader
+        # exchange): frames on a registered wire tag are queued for the
+        # owner instead of entering MPI matching.
+        self._channels: dict[int, Any] = {}
         self._pml = None
         # Single-pumper guard: progress() must not run concurrently —
         # two threads advancing the same ordered stream would both read
@@ -385,9 +394,25 @@ class FabricEngine:
         elif tag == P2P_TAG:
             self._dispatch(src_idx, dss.unpack_one(raw))
         else:
+            chan = self._channels.get(tag)
+            if chan is not None:
+                chan.append((src_idx, raw))
+                return True
             logger.warning("non-p2p frame (tag %#x) on fabric", tag)
             return False
         return True
+
+    def open_channel(self, wire_tag: int):
+        """Claim a wire tag; frames carrying it are appended to the
+        returned deque as (src_process_index, raw) instead of entering
+        MPI matching. One owner per tag (idempotent per engine)."""
+        from collections import deque
+
+        with self._lock:
+            chan = self._channels.get(wire_tag)
+            if chan is None:
+                chan = self._channels[wire_tag] = deque()
+        return chan
 
     def _progress_locked(self) -> int:
         n = 0
@@ -787,22 +812,35 @@ def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
         if rec.get("host") == host_id["host"]
         and rec.get("boot") == host_id["boot"]
     ]
-    if not co_located or not _sm.engine_available():
+    if not co_located:
+        return
+    if not _sm.engine_available():
+        # Co-located peers will wait for our record: publish an
+        # explicit not-ready so their degradation is per-peer and
+        # immediate, not a full modex-timeout stall that aborts their
+        # healthy wiring.
+        modex.put(f"shm/{my}", {"ready": False})
+        modex.put(f"shm_ok/{my}", False)
         return
     # Two-phase wiring so a partial failure can't poison peers: phase 1
-    # creates segments and attaches every co-located peer; phase 2
-    # exchanges per-process outcome, and ONLY mutually-ok peers route
-    # over shm. A process whose wiring failed publishes ok=False and
-    # destroys its endpoint — peers exclude it before any send, so its
-    # dead segment is never dialed.
+    # creates segments and attaches every READY co-located peer (a
+    # not-ready peer is skipped, staying on DCN, without aborting the
+    # rest); phase 2 exchanges per-process outcome, and ONLY mutually-
+    # ok peers route over shm. A process whose wiring failed publishes
+    # ok=False and destroys its endpoint — peers exclude it before any
+    # send, so its dead segment is never dialed.
     shm = None
     ok = False
+    candidates: list[int] = []
     try:
         prefix = modex.get("shm/prefix", timeout_s=timeout_s)
         shm = _sm.ShmEndpoint(prefix, my)
         modex.put(f"shm/{my}", {"ready": True})
         for idx in co_located:
-            modex.get(f"shm/{idx}", timeout_s=timeout_s)
+            rec = modex.get(f"shm/{idx}", timeout_s=timeout_s)
+            if rec.get("ready"):
+                candidates.append(idx)
+        for idx in candidates:
             shm.connect(idx, timeout_s=timeout_s)
         ok = True
     except Exception as exc:
@@ -810,12 +848,12 @@ def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
             "shm wiring failed (%s); same-host peers stay on DCN", exc
         )
     modex.put(f"shm_ok/{my}", bool(ok))
-    if not ok:
+    if not ok or not candidates:
         if shm is not None:
             shm.close()
         return
     good = set()
-    for idx in co_located:
+    for idx in candidates:
         try:
             if modex.get(f"shm_ok/{idx}", timeout_s=timeout_s):
                 good.add(idx)
@@ -823,6 +861,7 @@ def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
             pass  # peer never reported: leave it on DCN
     engine.shm = shm
     engine.shm_peers = good
+    engine.open_channel(COLL_SM_TAG)  # before any peer's coll/sm frame
     SPC.record("fabric_sm_peers", len(good))
     logger.info("shm wired: process %d, co-located peers %s", my,
                 sorted(good))

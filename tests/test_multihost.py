@@ -342,6 +342,11 @@ _VTABLE_WORKER = textwrap.dedent(r"""
     pid = int(sys.argv[1])
     nprocs = int(sys.argv[2])
     coord = sys.argv[3]
+    # This suite covers the DCN spanning path: disable btl/sm so the
+    # (higher-priority, same-host) coll/sm component withdraws and
+    # coll/hier over DCN keeps its coverage (coll/sm has its own suite,
+    # tests/test_coll_sm.py).
+    os.environ["OMPITPU_MCA_btl_sm_enable"] = "false"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=2"
@@ -435,6 +440,8 @@ _DATAOPS_WORKER = textwrap.dedent(r"""
     pid = int(sys.argv[1])
     nprocs = int(sys.argv[2])
     coord = sys.argv[3]
+    # DCN-path coverage: keep coll/hier selected (see _VTABLE_WORKER)
+    os.environ["OMPITPU_MCA_btl_sm_enable"] = "false"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=2"
@@ -553,6 +560,8 @@ _VECTOR_WORKER = textwrap.dedent(r"""
     pid = int(sys.argv[1])
     nprocs = int(sys.argv[2])
     coord = sys.argv[3]
+    # DCN-path coverage: keep coll/hier selected (see _VTABLE_WORKER)
+    os.environ["OMPITPU_MCA_btl_sm_enable"] = "false"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=2"
