@@ -382,6 +382,250 @@ def reduce_native(
     return allreduce_native(x, axis_name, op)
 
 
+def _segment_leaf(leaf: jax.Array, segments: int):
+    """Static split of a flattened leaf into `segments` chunks (+ the
+    restore function). Segment count is a trace-time constant, so each
+    chunk's collective chain is an independent program XLA can overlap
+    — the pipelining the reference gets from segsize knobs."""
+    flat = leaf.reshape(-1)
+    import numpy as _np
+
+    bounds = _np.linspace(0, flat.shape[0], segments + 1).astype(int)
+    chunks = [flat[int(a):int(b)] for a, b in zip(bounds, bounds[1:])
+              if b > a]
+
+    def restore(parts):
+        return jnp.concatenate(parts).reshape(leaf.shape)
+
+    return chunks, restore
+
+
+def _auto_segments(x, target_bytes: int = 64 * 1024, cap: int = 8) -> int:
+    total = sum(
+        leaf.size * leaf.dtype.itemsize for leaf in jax.tree.leaves(x)
+    )
+    return int(max(1, min(cap, total // max(target_bytes, 1))))
+
+
+def bcast_chain(x, axis_name: str, root: int = 0) -> jax.Array:
+    """Chain broadcast: the payload hops rank-to-rank down the (root-
+    relative) chain, n-1 single-hop rounds.
+
+    Reference: coll_base_bcast.c (ompi_coll_base_bcast_intra_chain with
+    fanout 1); the building block of the pipelined variant."""
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+    perm = [((root + i) % n, (root + i + 1) % n) for i in range(n - 1)]
+
+    def chain_one(v):
+        for h in range(n - 1):
+            recv = lax.ppermute(v, axis_name, perm)
+            v = jnp.where(vrank == h + 1, recv, v)
+        return v
+
+    return jax.tree.map(chain_one, x)
+
+
+def bcast_pipelined(x, axis_name: str, root: int = 0,
+                    segments: int | None = None) -> jax.Array:
+    """Pipelined (segmented-chain) broadcast: the payload splits into
+    static segments, each circulating the chain independently — XLA
+    overlaps the per-segment hops, so the wire sees a full pipeline
+    after the (n-1)-hop fill.
+
+    Reference: coll_base_bcast.c (..._intra_pipeline) with the tuned
+    segsize rules (coll_tuned_decision_fixed.c:250-310)."""
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    segs = segments if segments else _auto_segments(x)
+    if segs <= 1:
+        return bcast_chain(x, axis_name, root)
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+    perm = [((root + i) % n, (root + i + 1) % n) for i in range(n - 1)]
+
+    def pipe_one(leaf):
+        chunks, restore = _segment_leaf(leaf, segs)
+        out = []
+        for c in chunks:
+            v = c
+            for h in range(n - 1):
+                recv = lax.ppermute(v, axis_name, perm)
+                v = jnp.where(vrank == h + 1, recv, v)
+            out.append(v)
+        return restore(out)
+
+    return jax.tree.map(pipe_one, x)
+
+
+def bcast_binary(x, axis_name: str, root: int = 0) -> jax.Array:
+    """Binary-tree broadcast: node v forwards to children 2v+1 / 2v+2
+    (root-relative), depth ceil(log2) rounds with fanout 2.
+
+    Reference: coll_base_bcast.c (..._intra_bintree) via the
+    coll_base_topo.c tree builders."""
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+
+    def phys(v: int) -> int:
+        return (v + root) % n
+
+    def tree_one(v):
+        level_start = 0  # first vrank of the sending level
+        width = 1
+        while level_start + width - 1 < n - 1:
+            # one ppermute per child side — a ppermute source must be
+            # unique, and a binary node feeds two children per round
+            for side in (1, 2):
+                perm = [
+                    (phys(s), phys(2 * s + side))
+                    for s in range(level_start,
+                                   min(level_start + width, n))
+                    if 2 * s + side < n
+                ]
+                if not perm:
+                    continue
+                recv = lax.ppermute(v, axis_name, perm)
+                takes_lo = 2 * level_start + 1
+                takes_hi = 2 * (level_start + width - 1) + 2
+                child_parity = side % 2  # left children odd, right even
+                takes = ((vrank >= takes_lo) & (vrank <= takes_hi)
+                         & (vrank % 2 == child_parity))
+                v = jnp.where(takes, recv, v)
+            level_start = 2 * level_start + 1
+            width = 2 * width
+        return v
+
+    return jax.tree.map(tree_one, x)
+
+
+def reduce_pipelined(
+    x, axis_name: str, op: Op, root: int = 0,
+    segments: int | None = None,
+) -> jax.Array:
+    """Pipelined chain reduction toward root: partials flow down the
+    reverse chain combining at every hop, segmented so consecutive
+    segments keep the wire busy. Chain order is x_0 + (x_1 + (...)) —
+    MPI rank order when root is 0, so non-commutative ops are safe
+    there; elsewhere they fall back to the ordered gather path.
+
+    Reference: coll_base_reduce.c (..._intra_pipeline /
+    ..._intra_chain), segsize rules coll_tuned_decision_fixed.c:250-310.
+    Only root's result is defined (MPI reduce semantics)."""
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    if (not op.commutative or _op_mod._is_joint(op)) and root != 0:
+        return _allreduce_gather_reduce(x, axis_name, op)
+    if _op_mod._is_joint(op):
+        return _allreduce_gather_reduce(x, axis_name, op)
+    rank = _rank(axis_name)
+    vrank = (rank - root) % n
+    segs = segments if segments else _auto_segments(x)
+    rev = [((root + i + 1) % n, (root + i) % n) for i in range(n - 1)]
+
+    def chain_reduce(v):
+        for h in range(n - 1):
+            recv = lax.ppermute(v, axis_name, rev)
+            combines = vrank == (n - 2 - h)
+            v = jnp.where(combines, op.combine(v, recv), v)
+        return v
+
+    def pipe_one(leaf):
+        if segs <= 1:
+            return chain_reduce(leaf)
+        chunks, restore = _segment_leaf(leaf, segs)
+        return restore([chain_reduce(c) for c in chunks])
+
+    return jax.tree.map(pipe_one, x)
+
+
+def scan_recursive_doubling(x, axis_name: str, op: Op) -> jax.Array:
+    """Inclusive prefix via recursive doubling: log2(n) rounds, round k
+    combines the prefix from rank-2^k (associative order preserved, so
+    non-commutative ops are safe).
+
+    Reference: the scan recursion of coll_base_scan.c restructured to
+    the log-depth doubling exchange (the pattern of
+    allreduce_intra_recursivedoubling, coll_base_allreduce.c:130)."""
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    rank = _rank(axis_name)
+
+    def one(leaf):
+        acc = leaf
+        k = 1
+        while k < n:
+            perm = [(i, i + k) for i in range(n - k)]
+            recv = lax.ppermute(acc, axis_name, perm)
+            acc = jnp.where(rank >= k, op.combine(recv, acc), acc)
+            k <<= 1
+        return acc
+
+    return jax.tree.map(one, x)
+
+
+def scan_linear_chain(x, axis_name: str, op: Op) -> jax.Array:
+    """Inclusive prefix via the linear chain: the running prefix flows
+    rank-to-rank, each rank folding in its contribution — the
+    reference's own recursion shape (coll_base_scan.c), n-1 hops."""
+    n = _size(axis_name)
+    if n == 1:
+        return x
+    rank = _rank(axis_name)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def one(leaf):
+        acc = leaf
+        for h in range(n - 1):
+            recv = lax.ppermute(acc, axis_name, perm)
+            acc = jnp.where(rank == h + 1, op.combine(recv, leaf), acc)
+        return acc
+
+    return jax.tree.map(one, x)
+
+
+def _exscan_from_inclusive(inc, x, axis_name: str, op: Op):
+    """Shift an inclusive scan down one rank; rank 0 gets the op
+    identity (exscan semantics)."""
+    n = _size(axis_name)
+    rank = _rank(axis_name)
+    perm = [(i, i + 1) for i in range(n - 1)]
+
+    def one(leaf_inc, leaf_x):
+        prev = lax.ppermute(leaf_inc, axis_name, perm)
+        if op.has_identity:
+            ident = op.identity_like(leaf_x)
+        else:
+            ident = jnp.zeros_like(leaf_x)
+        return jnp.where(rank == 0, ident, prev)
+
+    return jax.tree.map(one, inc, x)
+
+
+def exscan_recursive_doubling(x, axis_name: str, op: Op) -> jax.Array:
+    """Exclusive prefix: recursive-doubling inclusive scan + one-hop
+    shift (reference: coll_base_exscan.c semantics)."""
+    return _exscan_from_inclusive(
+        scan_recursive_doubling(x, axis_name, op), x, axis_name, op
+    )
+
+
+def exscan_linear_chain(x, axis_name: str, op: Op) -> jax.Array:
+    """Exclusive prefix via the linear chain + one-hop shift."""
+    return _exscan_from_inclusive(
+        scan_linear_chain(x, axis_name, op), x, axis_name, op
+    )
+
+
 # ---------------------------------------------------------------------------
 # allgather / reduce_scatter
 # ---------------------------------------------------------------------------

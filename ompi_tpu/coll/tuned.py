@@ -38,6 +38,10 @@ logger = get_logger("coll.tuned")
 # Reference cutoffs (BASELINE.md): 10,000 B small-message cutoff, 1 MiB
 # ring→segmented switch, 1 MiB segments.
 _V = partial(config.register, "coll", "tuned")
+_large = _V("bcast_large_cutoff", type=int, default=1 << 20,
+            description="Bytes above which rooted ops take the "
+                        "segmented pipeline tier (reference: 1MiB "
+                        "segments, coll_tuned_decision_fixed.c:250-310)")
 _small = _V("allreduce_small_cutoff", type=int, default=10_000,
             description="Allreduce: bytes/rank below which recursive "
                         "doubling is used (reference: 10000B)")
@@ -63,6 +67,10 @@ _force_bcast = _V("bcast_algorithm", type=str, default="",
                   description="Force a bcast algorithm by name")
 _force_reduce = _V("reduce_algorithm", type=str, default="",
                    description="Force a reduce algorithm by name")
+_force_scan = _V("scan_algorithm", type=str, default="",
+                 description="Force the scan algorithm")
+_force_exscan = _V("exscan_algorithm", type=str, default="",
+                   description="Force the exscan algorithm")
 _force_reduce_scatter = _V("reduce_scatter_algorithm", type=str, default="",
                            description="Force a reduce_scatter algorithm "
                                        "by name")
@@ -143,11 +151,27 @@ ALLTOALL_ALGOS: dict[str, Callable] = {
 BCAST_ALGOS: dict[str, Callable] = {
     "native": spmd.bcast_native,
     "binomial": spmd.bcast_binomial,
+    "chain": spmd.bcast_chain,
+    "binary": spmd.bcast_binary,
+    "pipelined": spmd.bcast_pipelined,
 }
 
 REDUCE_ALGOS: dict[str, Callable] = {
     "native": spmd.reduce_native,
     "binomial": spmd.reduce_binomial,
+    "pipelined": spmd.reduce_pipelined,
+}
+
+SCAN_ALGOS: dict[str, Callable] = {
+    "native": spmd.scan_native,
+    "recursive_doubling": spmd.scan_recursive_doubling,
+    "linear_chain": spmd.scan_linear_chain,
+}
+
+EXSCAN_ALGOS: dict[str, Callable] = {
+    "native": spmd.exscan_native,
+    "recursive_doubling": spmd.exscan_recursive_doubling,
+    "linear_chain": spmd.exscan_linear_chain,
 }
 
 REDUCE_SCATTER_ALGOS: dict[str, Callable] = {
@@ -267,6 +291,12 @@ def decide_allgather(nbytes: int, nranks: int) -> str:
 
 
 def decide_bcast(nbytes: int, nranks: int) -> str:
+    """Reference regime (coll_tuned_decision_fixed.c:250-310): binomial
+    for small messages, binary tree mid-size, segmented pipeline/chain
+    for bulk. Native (XLA's own broadcast lowering) stays the default
+    when preferred — XLA already emits the ICI-optimal schedule; the
+    algorithm tiers are for rules-file/sweep selection and spanning
+    reuse."""
     forced = _force_bcast.value
     if forced:
         return forced
@@ -275,6 +305,51 @@ def decide_bcast(nbytes: int, nranks: int) -> str:
         got = rules.decide("bcast", nbytes, nranks)
         if got:
             return got
+    if _prefer_native.value:
+        return "native"
+    if nbytes < _small.value:
+        return "binomial"
+    if nbytes < _large.value:
+        return "binary"
+    return "pipelined"
+
+
+def decide_scan(op: Op, nbytes: int, nranks: int) -> str:
+    """Scan space: the log-depth doubling exchange for small payloads,
+    the associative-scan native plan otherwise; joint (paired-word)
+    ops stay native — the variants exchange leaves positionally."""
+    forced = _force_scan.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("scan", nbytes, nranks)
+        if got:
+            return got
+    if _is_joint(op):
+        return "native"
+    if _prefer_native.value:
+        return "native"
+    if nbytes < _small.value:
+        return "recursive_doubling"
+    return "native"
+
+
+def decide_exscan(op: Op, nbytes: int, nranks: int) -> str:
+    forced = _force_exscan.value
+    if forced:
+        return forced
+    rules = _rules()
+    if rules is not None:
+        got = rules.decide("exscan", nbytes, nranks)
+        if got:
+            return got
+    if _is_joint(op):
+        return "native"
+    if _prefer_native.value:
+        return "native"
+    if nbytes < _small.value:
+        return "recursive_doubling"
     return "native"
 
 
@@ -298,6 +373,8 @@ def decide_reduce(op: Op, nbytes: int, nranks: int) -> str:
         return "native"
     if nbytes < _small.value:
         return "binomial"
+    if nbytes >= _large.value:
+        return "pipelined"  # segmented chain (reference pipeline tier)
     return "native"
 
 
@@ -477,6 +554,34 @@ class TunedColl(XlaColl):
             check_vma=not is_pallas_algo(algo),
         )
         return plan(x)[root]
+
+    def _prefix(self, comm, x, op, opname: str, decide, algos, native):
+        """Shared scan/exscan dispatch over the tuned decision space
+        (reference: the per-op decision functions of coll/tuned)."""
+        op = op_lookup(op)
+        x = rank_major_check(comm, x)
+        if comm.size == 1:
+            return native(self, comm, x, op)
+        algo = decide(op, _nbytes(x), comm.size)
+        fn = algos.get(algo)
+        if fn is None:
+            raise ArgumentError(
+                f"unknown {opname} algorithm {algo!r}; known: "
+                f"{sorted(algos)}"
+            )
+        key = (opname, algo, op.cache_key, x.shape, str(x.dtype))
+        plan = compile_plan(
+            comm, key, lambda b: fn(b, "ranks", op)
+        )
+        return plan(x)
+
+    def scan(self, comm, x, op):
+        return self._prefix(comm, x, op, "scan", decide_scan,
+                            SCAN_ALGOS, XlaColl.scan)
+
+    def exscan(self, comm, x, op):
+        return self._prefix(comm, x, op, "exscan", decide_exscan,
+                            EXSCAN_ALGOS, XlaColl.exscan)
 
     def reduce_scatter_block(self, comm, x, op):
         op = op_lookup(op)

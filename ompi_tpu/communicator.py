@@ -320,18 +320,76 @@ class Communicator(HasAttributes, HasErrhandler):
     def ineighbor_alltoall(self, sendblocks):
         return self._icoll("neighbor_alltoall", sendblocks)
 
-    # Persistent collectives (MPI-4 *_init / mpiext pcollreq analog): the
-    # compiled plan IS the persistent schedule; starting it re-runs the
-    # cached executable on new data.
-    def allreduce_init(self, x, op="sum"):
+    # Persistent collectives (MPI-4 *_init / mpiext pcollreq analog;
+    # reference: the 22-operation table of coll_base_functions.h:45-66
+    # and ompi/mpiext/pcollreq): the compiled plan IS the persistent
+    # schedule; start() re-dispatches the cached executable against the
+    # bound buffer. Every blocking operation below has an _init form,
+    # including the vector and neighborhood families.
+    def _pinit(self, opname: str, x, *args):
         from .coll.framework import PersistentColl
 
-        return PersistentColl(self, "allreduce", (op,), x)
+        return PersistentColl(self, opname, args, x)
+
+    def allreduce_init(self, x, op="sum"):
+        return self._pinit("allreduce", x, op)
 
     def bcast_init(self, x, root: int = 0):
-        from .coll.framework import PersistentColl
+        return self._pinit("bcast", x, self.check_rank(root))
 
-        return PersistentColl(self, "bcast", (self.check_rank(root),), x)
+    def reduce_init(self, x, op="sum", root: int = 0):
+        return self._pinit("reduce", x, op, self.check_rank(root))
+
+    def allgather_init(self, x):
+        return self._pinit("allgather", x)
+
+    def reduce_scatter_block_init(self, x, op="sum"):
+        return self._pinit("reduce_scatter_block", x, op)
+
+    def alltoall_init(self, x):
+        return self._pinit("alltoall", x)
+
+    def gather_init(self, x, root: int = 0):
+        return self._pinit("gather", x, self.check_rank(root))
+
+    def scatter_init(self, x, root: int = 0):
+        return self._pinit("scatter", x, self.check_rank(root))
+
+    def scan_init(self, x, op="sum"):
+        return self._pinit("scan", x, op)
+
+    def exscan_init(self, x, op="sum"):
+        return self._pinit("exscan", x, op)
+
+    def barrier_init(self):
+        return self._pinit("barrier", None)
+
+    def allgatherv_init(self, values):
+        return self._pinit("allgatherv", list(values))
+
+    def gatherv_init(self, values, root: int = 0):
+        return self._pinit("gatherv", list(values),
+                           self.check_rank(root))
+
+    def scatterv_init(self, blocks, root: int = 0):
+        return self._pinit("scatterv", list(blocks),
+                           self.check_rank(root))
+
+    def alltoallv_init(self, blocks):
+        return self._pinit("alltoallv", [list(b) for b in blocks])
+
+    def alltoallw_init(self, blocks):
+        return self._pinit("alltoallw", [list(b) for b in blocks])
+
+    def reduce_scatter_init(self, values, counts, op="sum"):
+        return self._pinit("reduce_scatter", list(values),
+                           list(counts), op)
+
+    def neighbor_allgather_init(self, x):
+        return self._pinit("neighbor_allgather", x)
+
+    def neighbor_alltoall_init(self, sendblocks):
+        return self._pinit("neighbor_alltoall", sendblocks)
 
     # Persistent p2p (MPI_Send_init / MPI_Recv_init, reference pml.h:292
     # `pml_isend_init`): binds the envelope once; each start() re-issues

@@ -69,7 +69,8 @@ def sweep_op(comm, opname: str, algos: dict, min_bytes: int,
         for name, fn in algos.items():
             key = ("tune", opname, name, x.shape, str(x.dtype))
             try:
-                if opname in ("allreduce", "reduce_scatter"):
+                if opname in ("allreduce", "reduce_scatter", "scan",
+                              "exscan"):
                     per_rank = lambda b, f=fn: f(b, "ranks", op)
                 elif opname == "reduce":
                     per_rank = lambda b, f=fn: f(b, "ranks", op, root=0)
@@ -109,6 +110,8 @@ def tune(comm, ops=None, min_bytes: int = 256,
         GATHER_ALGOS,
         REDUCE_ALGOS,
         REDUCE_SCATTER_ALGOS,
+        SCAN_ALGOS,
+        EXSCAN_ALGOS,
         SCATTER_ALGOS,
         _pallas_algos,
     )
@@ -126,6 +129,8 @@ def tune(comm, ops=None, min_bytes: int = 256,
         "reduce_scatter": REDUCE_SCATTER_ALGOS,
         "gather": GATHER_ALGOS,
         "scatter": SCATTER_ALGOS,
+        "scan": SCAN_ALGOS,
+        "exscan": EXSCAN_ALGOS,
     }
     ops = ops or list(spaces)
     out = {}
@@ -140,7 +145,8 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="ompi_tpu.tools.tune")
     ap.add_argument("--out", required=True)
     ap.add_argument("--ops", default="allreduce,allgather,alltoall,bcast,"
-                                     "reduce,reduce_scatter,gather,scatter")
+                                     "reduce,reduce_scatter,gather,"
+                                     "scatter,scan,exscan")
     ap.add_argument("--min-bytes", type=int, default=256)
     ap.add_argument("--max-bytes", type=int, default=1 << 20)
     ap.add_argument("--iters", type=int, default=5)

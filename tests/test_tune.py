@@ -103,7 +103,10 @@ def test_decide_defaults_mirror_reference_cutoffs():
     try:
         s = ops.lookup("sum")
         assert tuned.decide_reduce(s, 1024, 8) == "binomial"
-        assert tuned.decide_reduce(s, 1 << 20, 8) == "native"
+        # >= the 1 MiB pipeline cutoff: segmented chain (round 4;
+        # reference pipeline tier, coll_tuned_decision_fixed.c:250-310)
+        assert tuned.decide_reduce(s, 1 << 20, 8) == "pipelined"
+        assert tuned.decide_reduce(s, 256 << 10, 8) == "native"
         assert tuned.decide_reduce_scatter(s, 1024, 8) == \
             "recursive_halving"
         assert tuned.decide_reduce_scatter(s, 1024, 6) == "ring"  # !pof2
@@ -139,6 +142,8 @@ def test_rules_file_covers_new_spaces(tmp_path):
     try:
         s = ops.lookup("sum")
         assert tuned.decide_reduce(s, 1024, 8) == "binomial"
+        # the rules file's catch-all entry outranks the fixed-rule
+        # pipeline tier (dynamic rules win, decision_fixed is fallback)
         assert tuned.decide_reduce(s, 1 << 20, 8) == "native"
         assert tuned.decide_reduce_scatter(s, 1 << 20, 8) == "ring"
         assert tuned.decide_gather(1 << 20, 8) == "binomial"
@@ -160,3 +165,101 @@ def test_tune_cli(tmp_path):
     with open(p) as f:
         doc = json.load(f)
     assert "bcast" in doc
+
+
+def test_round4_algorithm_depth_spaces():
+    """Chain/binary/pipelined bcast, pipelined reduce and the scan/
+    exscan variants are selectable through the tuned decision layer
+    (VERDICT r4 item 7; reference coll_tuned_decision_fixed.c:250-310)."""
+    from ompi_tpu import ops as _ops
+    from ompi_tpu.coll import tuned
+
+    assert {"chain", "binary", "pipelined"} <= set(tuned.BCAST_ALGOS)
+    assert "pipelined" in tuned.REDUCE_ALGOS
+    assert {"recursive_doubling", "linear_chain"} <= set(tuned.SCAN_ALGOS)
+    assert {"recursive_doubling", "linear_chain"} <= set(
+        tuned.EXSCAN_ALGOS)
+
+    s = _ops.lookup("sum")
+    config.set("coll_tuned_prefer_native", False)
+    try:
+        # reference-shaped fixed rules: binomial small, binary mid,
+        # pipelined bulk; scan flips to doubling below the small cutoff
+        assert tuned.decide_bcast(1024, 8) == "binomial"
+        assert tuned.decide_bcast(256 << 10, 8) == "binary"
+        assert tuned.decide_bcast(4 << 20, 8) == "pipelined"
+        assert tuned.decide_reduce(s, 4 << 20, 8) == "pipelined"
+        assert tuned.decide_scan(s, 1024, 8) == "recursive_doubling"
+        assert tuned.decide_scan(s, 4 << 20, 8) == "native"
+        assert tuned.decide_exscan(s, 1024, 8) == "recursive_doubling"
+    finally:
+        config.set("coll_tuned_prefer_native", True)
+
+
+def test_forced_depth_algorithms_through_vtable():
+    """Forcing each new algorithm through the per-op MCA var runs it on
+    the live comm and matches the oracle."""
+    import numpy as np
+
+    comm = mt.init()
+    n = comm.size
+    rng = np.random.default_rng(12)
+    data = rng.standard_normal((n, 24)).astype(np.float32)
+    x = comm.put_rank_major(data)
+
+    for algo in ("chain", "binary", "pipelined"):
+        config.set("coll_tuned_bcast_algorithm", algo)
+        try:
+            out = np.asarray(comm.bcast(x, root=3))
+        finally:
+            config.set("coll_tuned_bcast_algorithm", "")
+        np.testing.assert_allclose(
+            out, np.broadcast_to(data[3], out.shape), rtol=1e-6,
+            err_msg=algo)
+
+    config.set("coll_tuned_reduce_algorithm", "pipelined")
+    try:
+        out = np.asarray(comm.reduce(x, op="sum", root=0))
+    finally:
+        config.set("coll_tuned_reduce_algorithm", "")
+    np.testing.assert_allclose(out, data.sum(0), rtol=1e-4, atol=1e-5)
+
+    acc = np.cumsum(data, axis=0)
+    for algo in ("recursive_doubling", "linear_chain"):
+        config.set("coll_tuned_scan_algorithm", algo)
+        try:
+            out = np.asarray(comm.scan(x))
+        finally:
+            config.set("coll_tuned_scan_algorithm", "")
+        np.testing.assert_allclose(out, acc, rtol=1e-4, atol=1e-5,
+                                   err_msg=algo)
+        config.set("coll_tuned_exscan_algorithm", algo)
+        try:
+            eout = np.asarray(comm.exscan(x))
+        finally:
+            config.set("coll_tuned_exscan_algorithm", "")
+        np.testing.assert_allclose(eout[1:], acc[:-1], rtol=1e-4,
+                                   atol=1e-5, err_msg=algo)
+        np.testing.assert_allclose(eout[0], 0.0, atol=1e-6)
+
+
+def test_tune_sweeps_scan_spaces(tmp_path):
+    """tools/tune.py covers the scan/exscan spaces (VERDICT r4 item 7:
+    'wired into tuned + tune.py')."""
+    from ompi_tpu.tools import tune
+
+    p = str(tmp_path / "scan.json")
+    rc = tune.main([
+        "--out", p, "--ops", "scan,exscan", "--min-bytes", "256",
+        "--max-bytes", "1024", "--iters", "1",
+    ])
+    assert rc == 0
+    with open(p) as f:
+        doc = json.load(f)
+    assert doc["scan"] and doc["exscan"]
+    from ompi_tpu.coll import tuned as tuned_mod
+
+    known = set(tuned_mod.SCAN_ALGOS) | set(tuned_mod.EXSCAN_ALGOS)
+    for rules in (doc["scan"], doc["exscan"]):
+        for rule in rules:
+            assert rule["algorithm"] in known
