@@ -62,23 +62,33 @@ _T_GRANT = 3   # lock grant
 _T_POST = 4    # PSCW exposure-epoch notification (post -> origins)
 
 def _enc_index(idx) -> Any:
-    """dss-able encoding of a window index (None | int | slice | tuple
-    of those) — the datatype story of the RMA wire."""
-    if idx is None or isinstance(idx, int):
-        return idx
+    """dss-able encoding of a window index (None | int | slice |
+    integer index array | tuple of those) — the datatype story of the
+    RMA wire. Index arrays carry SHMEM's strided/element-offset ops
+    (iput/iget unravel to coordinate arrays for multi-dim blocks)."""
+    import numpy as _np
+
+    if idx is None or isinstance(idx, (int, _np.integer)):
+        return int(idx) if idx is not None else None
     if isinstance(idx, slice):
         return ("s", idx.start, idx.stop, idx.step)
+    if isinstance(idx, _np.ndarray) and idx.dtype.kind in "iu":
+        return ("a", idx.dtype.str, idx.tolist())
     if isinstance(idx, tuple):
         return ("t",) + tuple(_enc_index(i) for i in idx)
     raise WinError(f"unsupported remote RMA index {idx!r}")
 
 
 def _dec_index(enc) -> Any:
+    import numpy as _np
+
     if enc is None or isinstance(enc, int):
         return enc
     if isinstance(enc, (tuple, list)):
         if enc[0] == "s":
             return slice(enc[1], enc[2], enc[3])
+        if enc[0] == "a":
+            return _np.asarray(enc[2], dtype=_np.dtype(enc[1]))
         if enc[0] == "t":
             return tuple(_dec_index(i) for i in enc[1:])
     raise WinError(f"bad remote RMA index encoding {enc!r}")

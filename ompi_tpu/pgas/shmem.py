@@ -20,6 +20,9 @@ from __future__ import annotations
 
 from typing import Any, Optional
 
+import numpy as np
+
+from ..core.counters import SPC
 from ..core.errors import ArgumentError
 from ..osc.window import LOCK_SHARED, Window, create_window
 
@@ -54,6 +57,7 @@ class ShmemContext:
     def __init__(self, comm) -> None:
         self.comm = comm
         self._heap: list[SymmetricArray] = []
+        self._teams: dict[tuple, Any] = {}  # active-set -> sub-comm
 
     @property
     def n_pes(self) -> int:
@@ -102,12 +106,96 @@ class ShmemContext:
         return res.value()
 
     def quiet(self, sym: Optional[SymmetricArray] = None) -> None:
-        """shmem_quiet: complete all outstanding puts."""
+        """shmem_quiet: COMPLETE all outstanding puts/atomics (remote
+        delivery guaranteed on return — the strong barrier)."""
         targets = [sym] if sym is not None else self._heap
         for s in targets:
             s._win.flush()
 
-    fence = quiet  # same-PE ordering == completion in the driver model
+    def fence(self, sym: Optional[SymmetricArray] = None) -> None:
+        """shmem_fence: ORDER delivery of puts per destination PE —
+        strictly weaker than quiet (no completion guarantee; reference:
+        the spml fence vs quiet portal split, spml.h:383-413). Both
+        window tiers already deliver one process's RMA stream to a
+        given target in issue order (single-controller: the FIFO
+        pending queue applied in order; fabric windows: the per-peer
+        sequenced fabric stream), so fence requires no wire traffic —
+        it is an ordering assertion point, recorded for
+        introspection/profiling symmetry with the reference."""
+        del sym
+        SPC.record("shmem_fence")
+
+    # -- strided / typed element RMA (reference: oshmem/shmem/c
+    #    shmem_iput/iget and the typed shmem_<type>_p/g families) ---------
+
+    def _flat_index(self, sym: SymmetricArray, flat_offsets):
+        """Element-offset addressing into a (possibly multi-dim) block:
+        SHMEM's strided ops address symmetric objects by flat element
+        offset; multi-dim blocks unravel to coordinate tuples."""
+        shape = tuple(sym.block_shape)
+        flat_offsets = np.asarray(flat_offsets)
+        total = int(np.prod(shape)) if shape else 1
+        if flat_offsets.size and (flat_offsets.min() < 0
+                                  or flat_offsets.max() >= total):
+            raise ArgumentError(
+                f"element offsets out of range [0, {total})"
+            )
+        if len(shape) <= 1:
+            return flat_offsets
+        return np.unravel_index(flat_offsets, shape)
+
+    def iput(self, sym: SymmetricArray, source, tst: int, sst: int,
+             nelems: int, pe: int) -> None:
+        """shmem_iput: strided put — element `i` of the transfer reads
+        source[i*sst] and lands at target offset i*tst on PE `pe`."""
+        if tst < 1 or sst < 1 or nelems < 0:
+            raise ArgumentError("iput needs tst>=1, sst>=1, nelems>=0")
+        if nelems == 0:
+            return
+        src = np.asarray(source).ravel()[:sst * nelems:sst]
+        if src.size != nelems:
+            raise ArgumentError(
+                f"source too small: {nelems} elems at stride {sst}"
+            )
+        offs = np.arange(nelems) * tst
+        sym._win.put(src, pe, index=self._flat_index(sym, offs))
+        SPC.record("shmem_iput_elems", nelems)
+
+    def iget(self, sym: SymmetricArray, tst: int, sst: int,
+             nelems: int, pe: int):
+        """shmem_iget: strided get — returns the nelems values at
+        source offsets i*sst on PE `pe`, laid out at local stride tst
+        (the returned array has length (nelems-1)*tst+1 with the
+        fetched values at offsets i*tst, matching the target layout
+        shmem_iget writes)."""
+        if tst < 1 or sst < 1 or nelems < 0:
+            raise ArgumentError("iget needs tst>=1, sst>=1, nelems>=0")
+        if nelems == 0:
+            return np.empty(0)
+        offs = np.arange(nelems) * sst
+        res = sym._win.get(pe, index=self._flat_index(sym, offs))
+        sym._win.flush(pe)
+        vals = np.asarray(res.value())
+        out = np.zeros((nelems - 1) * tst + 1, vals.dtype)
+        out[::tst][:nelems] = vals
+        SPC.record("shmem_iget_elems", nelems)
+        return out
+
+    def p(self, sym: SymmetricArray, value, pe: int,
+          offset: int = 0) -> None:
+        """shmem_p: typed single-element put at a flat element offset
+        (the shmem_<type>_p family — dtype comes from the symmetric
+        allocation)."""
+        idx = self._flat_index(sym, np.asarray([offset]))
+        val = np.asarray(value).reshape(1)
+        sym._win.put(val, pe, index=idx)
+
+    def g(self, sym: SymmetricArray, pe: int, offset: int = 0):
+        """shmem_g: typed single-element blocking get."""
+        idx = self._flat_index(sym, np.asarray([offset]))
+        res = sym._win.get(pe, index=idx)
+        sym._win.flush(pe)
+        return np.asarray(res.value()).ravel()[0]
 
     # -- atomics (reference: oshmem/mca/atomic) ----------------------------
 
@@ -170,6 +258,122 @@ class ShmemContext:
             )
         self.quiet(sym)
         sym._win._set_array(self.comm.alltoall(sym._win.array))
+
+    # -- active-set collectives (reference: the (PE_start, logPE_stride,
+    #    PE_size) triplet of the SHMEM-1.x collective API,
+    #    oshmem/shmem/c/shmem_broadcast.c etc.) ---------------------------
+
+    def _active_set(self, start: int, log_stride: int,
+                    size: Optional[int]) -> list[int]:
+        n = self.n_pes
+        size = n if size is None else size
+        stride = 1 << log_stride
+        pes = [start + i * stride for i in range(size)]
+        if not pes or pes[0] < 0 or pes[-1] >= n:
+            raise ArgumentError(
+                f"active set (start={start}, logPE_stride={log_stride},"
+                f" size={size}) exceeds [0, {n})"
+            )
+        return pes
+
+    def _team(self, start: int, log_stride: int, size: Optional[int]):
+        """Sub-communicator of the active set (cached). Collective over
+        the controllers owning at least one member PE — the
+        comm_create_group model."""
+        pes = self._active_set(start, log_stride, size)
+        key = tuple(pes)
+        team = self._teams.get(key)
+        if team is None or team._freed:
+            if len(pes) == self.n_pes:
+                team = self.comm
+            else:
+                colors = [0 if r in set(pes) else -1
+                          for r in range(self.n_pes)]
+                team = self.comm.split(colors)[0]
+            self._teams[key] = team
+        return team, pes
+
+    def _member_rows(self, sym: SymmetricArray, pes: list[int]):
+        """(local window indices, stacked blocks) of this controller's
+        member PEs, in team-rank order."""
+        import jax.numpy as jnp
+
+        win = sym._win
+        idxs = []
+        for pe in pes:
+            if hasattr(win, "_local_idx_or_raise"):
+                try:
+                    idxs.append((pe, win._local_idx_or_raise(pe)))
+                except Exception:
+                    continue  # remote PE: contributed by its controller
+            else:
+                idxs.append((pe, pe))
+        rows = jnp.stack([win.array[i] for _, i in idxs])
+        return [i for _, i in idxs], rows
+
+    def _team_buf(self, team, rows):
+        """The team collective's input convention: spanning comms take
+        each controller's LOCAL rank-major blocks (the hier/sm coll
+        contract); single-controller teams shard the full buffer."""
+        from ..runtime.proc import spans_processes
+
+        arr = np.asarray(rows)
+        if spans_processes(team):
+            return arr
+        return team.put_rank_major(arr)
+
+    def _scatter_rows(self, sym: SymmetricArray, idxs, rows) -> None:
+        win = sym._win
+        arr = win.array
+        # host-stage the team-mesh result: the window array lives on
+        # the parent comm's mesh and jax refuses mixed-mesh scatters
+        rows = np.asarray(rows)
+        for slot, i in enumerate(idxs):
+            arr = arr.at[i].set(rows[slot])
+        win._set_array(arr)
+
+    def reduce_active(self, sym: SymmetricArray, op="sum", *,
+                      start: int = 0, log_stride: int = 0,
+                      size: Optional[int] = None) -> None:
+        """Active-set to_all reduction: member PEs' blocks become the
+        reduction over the set; non-members are untouched."""
+        team, pes = self._team(start, log_stride, size)
+        self.quiet(sym)
+        idxs, rows = self._member_rows(sym, pes)
+        red = team.allreduce(self._team_buf(team, rows), op)
+        self._scatter_rows(sym, idxs, red)
+
+    def broadcast_active(self, sym: SymmetricArray, root: int, *,
+                         start: int = 0, log_stride: int = 0,
+                         size: Optional[int] = None) -> None:
+        """Active-set broadcast: `root` is the ROOT PE's index within
+        the active set (SHMEM-1.x PE_root semantics)."""
+        team, pes = self._team(start, log_stride, size)
+        if not 0 <= root < len(pes):
+            raise ArgumentError(
+                f"PE_root {root} outside the {len(pes)}-member set"
+            )
+        self.quiet(sym)
+        idxs, rows = self._member_rows(sym, pes)
+        out = team.bcast(self._team_buf(team, rows), root=root)
+        self._scatter_rows(sym, idxs, out)
+
+    def collect_active(self, sym: SymmetricArray, *, start: int = 0,
+                       log_stride: int = 0,
+                       size: Optional[int] = None):
+        """Active-set fcollect: concatenation of member blocks, returned
+        to every member's controller."""
+        team, pes = self._team(start, log_stride, size)
+        self.quiet(sym)
+        _idxs, rows = self._member_rows(sym, pes)
+        return team.allgather(self._team_buf(team, rows))
+
+    def barrier_active(self, *, start: int = 0, log_stride: int = 0,
+                       size: Optional[int] = None) -> None:
+        """shmem_barrier over the active set: quiet + team barrier."""
+        team, _ = self._team(start, log_stride, size)
+        self.quiet()
+        team.barrier()
 
     # -- point-to-point sync + locks (reference: shmem_wait_until /
     #    shmem_lock.c) ------------------------------------------------------

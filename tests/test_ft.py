@@ -430,3 +430,205 @@ def test_crs_overwrite_keeps_a_complete_snapshot(tmp_path):
     assert meta["step"] == 2
     assert not os.path.exists(p + ".old")
     assert not os.path.exists(p + ".tmp")
+
+
+# ---------------------------------------------------------------------------
+# VERDICT r4 item 9: cross-process elastic drill over the LIVE fabric —
+# kill one of two controllers mid-collective, detect via DCN peer
+# failure (ft/events), shrink, RESPAWN a replacement process, re-wire,
+# and finish a correct allreduce on the new world.
+# ---------------------------------------------------------------------------
+
+_RESPAWN_REPLACEMENT = r"""
+import json, os, sys, time
+handoff = sys.argv[1]; ckdir = sys.argv[2]
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.btl import dcn
+from ompi_tpu.coll import hier
+from ompi_tpu.ft.manager import CheckpointManager
+
+comm = ompi_tpu.init()            # a FRESH controller: its 2 devices
+ep = dcn.DcnEndpoint()
+# publish our listener, read the survivor's (file modex: the respawned
+# process is outside the dead job's coordinator)
+tmp = os.path.join(handoff, "r_addr.json.tmp")
+with open(tmp, "w") as f:
+    json.dump({"ip": ep.address[0], "port": ep.address[1]}, f)
+os.replace(tmp, os.path.join(handoff, "r_addr.json"))
+deadline = time.monotonic() + 60
+a_path = os.path.join(handoff, "a_addr.json")
+while not os.path.exists(a_path):
+    if time.monotonic() > deadline:
+        sys.exit("no survivor address")
+    time.sleep(0.02)
+with open(a_path) as f:
+    a = json.load(f)
+peer = ep.connect(a["ip"], a["port"], cookie=2)  # we are slice 1
+h = hier.SliceHandle(comm=comm, endpoint=ep, slice_id=1, n_slices=2,
+                     peer_ids={0: peer})
+
+state = CheckpointManager(ckdir).restore(1)
+rows = np.asarray(state["x"])[2:4]   # the replaced ranks' shard
+out = np.asarray(hier.allreduce(h, comm.put_rank_major(rows),
+                                timeout=60.0))
+expect = np.asarray(state["x"]).sum(axis=0)
+assert np.allclose(out, expect), out
+ep.close()
+print("REPLACEMENT OK", flush=True)
+os._exit(0)
+"""
+
+_RESPAWN_SURVIVOR = r"""
+import json, os, subprocess, sys, time
+nprocs = 2; pid = int(sys.argv[1]); coord = sys.argv[2]
+handoff = sys.argv[3]; ckdir = sys.argv[4]
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu import Group
+from ompi_tpu.btl import dcn
+from ompi_tpu.coll import hier
+from ompi_tpu.ft import elastic
+from ompi_tpu.ft.manager import CheckpointManager
+from ompi_tpu.runtime import modex
+
+jax.distributed.initialize(coordinator_address=coord,
+                           num_processes=nprocs, process_id=pid,
+                           local_device_ids=[0, 1])
+world = ompi_tpu.init()
+local_ranks = [r for r, p in enumerate(world.procs)
+               if p.process_index == pid]
+remote_ranks = [r for r in range(world.size) if r not in local_ranks]
+comm = world.create(Group(local_ranks))
+ep = dcn.DcnEndpoint()
+modex.publish_dcn_address(ep, pid)
+table = modex.collect_dcn_addresses(nprocs, timeout_s=60)
+peer_ids = {i: ep.connect(ip, port, cookie=pid + 1)
+            for i, (ip, port) in table.items() if i != pid}
+h = hier.SliceHandle(comm=comm, endpoint=ep, slice_id=pid,
+                     n_slices=nprocs, peer_ids=peer_ids)
+other = 1 - pid
+elastic.watch_dcn({peer_ids[other]: remote_ranks,
+                   -(other + 1): remote_ranks})
+
+mgr = CheckpointManager(ckdir)
+state = {"x": np.arange(world.size * 8, dtype=np.float32)
+         .reshape(world.size, 8)}
+if pid == 0:
+    mgr.save(1, state)
+
+# round 1 with both controllers
+x = comm.put_rank_major(np.full((comm.size, 4), pid + 1.0, np.float32))
+out = np.asarray(hier.allreduce(h, x))
+assert np.allclose(out, 2 * (1.0 + 2.0)), out.ravel()[:2]
+
+if pid == 1:
+    time.sleep(0.5)
+    os._exit(17)          # die WITHOUT entering round 2
+
+# survivor: peer dies mid-collective -> DCN failure event
+died = False
+try:
+    hier.allreduce(h, x, timeout=30.0)
+except dcn.DcnError:
+    died = True
+assert died, "peer death went undetected"
+assert set(elastic.failed_ranks()) == set(remote_ranks)
+
+# shrink: agree on survivors, restore the checkpoint on the shrunk world
+new_comm, restored, meta = elastic.respawn(world, mgr)
+assert new_comm.size == len(local_ranks)
+print("SHRUNK", flush=True)
+
+# RESPAWN: launch a replacement controller, re-wire over the live
+# fabric (file modex — the old coordinator died with the victim),
+# finish an allreduce on the new 2-controller world
+repl = subprocess.Popen(
+    [sys.executable, "-c", open(os.path.join(handoff, "repl.py")).read(),
+     handoff, ckdir],
+    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    cwd="/root/repo",
+)
+# Re-wire on a FRESH endpoint: the dead victim's passive link id would
+# collide with the replacement's (same slice -> same connect cookie);
+# a clean listener is the re-wire step of the recovery protocol.
+ep2 = dcn.DcnEndpoint()
+tmp = os.path.join(handoff, "a_addr.json.tmp")
+with open(tmp, "w") as f:
+    json.dump({"ip": ep2.address[0], "port": ep2.address[1]}, f)
+os.replace(tmp, os.path.join(handoff, "a_addr.json"))
+deadline = time.monotonic() + 60
+r_path = os.path.join(handoff, "r_addr.json")
+while not os.path.exists(r_path):
+    if time.monotonic() > deadline:
+        repl.kill(); sys.exit("replacement never published")
+    time.sleep(0.02)
+with open(r_path) as f:
+    r = json.load(f)
+new_peer = ep2.connect(r["ip"], r["port"], cookie=1)  # we are slice 0
+h2 = hier.SliceHandle(comm=new_comm, endpoint=ep2, slice_id=0,
+                      n_slices=2, peer_ids={1: new_peer})
+((_, rows),) = restored.items()      # survivor shard (local ranks)
+rows = np.asarray(rows)
+out = np.asarray(hier.allreduce(h2, new_comm.put_rank_major(rows),
+                                timeout=60.0))
+expect = np.asarray(state["x"]).sum(axis=0)
+assert np.allclose(out, expect), out
+rout, _ = repl.communicate(timeout=90)
+assert repl.returncode == 0 and "REPLACEMENT OK" in rout, rout[-1500:]
+print("RESPAWNED-WORLD OK", flush=True)
+os._exit(0)
+"""
+
+
+def test_elastic_respawn_rewires_live_fabric(tmp_path):
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    from ompi_tpu.native import build
+
+    if not build.available():
+        pytest.skip("native library unavailable")
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    coord = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+    handoff = tmp_path / "handoff"
+    handoff.mkdir()
+    (handoff / "repl.py").write_text(_RESPAWN_REPLACEMENT)
+    ckdir = str(tmp_path / "ck")
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _RESPAWN_SURVIVOR, str(pid), coord,
+             str(handoff), ckdir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    rc0, out0, err0 = outs[0]
+    rc1, out1, err1 = outs[1]
+    assert rc1 == 17, f"victim should die deliberately: {rc1}\n{err1[-800:]}"
+    assert rc0 == 0, f"survivor failed:\n{err0[-3000:]}\n{out0[-500:]}"
+    assert "SHRUNK" in out0 and "RESPAWNED-WORLD OK" in out0

@@ -240,3 +240,114 @@ class TestShmem:
         assert ctx.test_lock(lk)              # free again: test acquires
         ctx.clear_lock(lk)
         ctx.free(lk)
+
+
+class TestShmemBreadth:
+    """Round-4 SHMEM API breadth (VERDICT r4 item 8): strided
+    iput/iget, typed single-element p/g, fence-vs-quiet split,
+    active-set collectives (reference: oshmem/shmem/c iput/iget and
+    the (PE_start, logPE_stride, PE_size) collective triplet)."""
+
+    def test_strided_iput_iget(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((12,), "float32")
+        # iput: 4 elems, source stride 2, target stride 3
+        src = np.arange(8, dtype=np.float32) * 10  # [0,10,...,70]
+        ctx.iput(x, src, tst=3, sst=2, nelems=4, pe=5)
+        ctx.quiet(x)
+        blk = np.asarray(x.local(5))
+        np.testing.assert_array_equal(blk[[0, 3, 6, 9]],
+                                      [0, 20, 40, 60])
+        assert np.all(blk[[1, 2, 4, 5, 7, 8, 10, 11]] == 0)
+        # iget: read them back at source stride 3, local stride 2
+        out = ctx.iget(x, tst=2, sst=3, nelems=4, pe=5)
+        np.testing.assert_array_equal(out[::2], [0, 20, 40, 60])
+        ctx.free(x)
+
+    def test_strided_multidim_and_bounds(self, world):
+        from ompi_tpu.core.errors import ArgumentError
+
+        ctx = pgas.init(world)
+        x = ctx.malloc((3, 4), "float32")
+        # flat element offsets unravel into the (3, 4) block
+        ctx.iput(x, np.asarray([1.0, 2.0, 3.0], np.float32),
+                 tst=5, sst=1, nelems=3, pe=2)
+        ctx.quiet(x)
+        blk = np.asarray(x.local(2))
+        assert blk[0, 0] == 1.0 and blk[1, 1] == 2.0 and blk[2, 2] == 3.0
+        with pytest.raises(ArgumentError, match="out of range"):
+            ctx.iput(x, np.zeros(4, np.float32), tst=4, sst=1,
+                     nelems=4, pe=2)
+        with pytest.raises(ArgumentError):
+            ctx.iput(x, np.zeros(4, np.float32), tst=0, sst=1,
+                     nelems=4, pe=2)
+        ctx.free(x)
+
+    def test_typed_p_g(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((6,), "int32")
+        ctx.p(x, 41, pe=3, offset=4)
+        ctx.quiet(x)
+        assert int(ctx.g(x, pe=3, offset=4)) == 41
+        assert int(ctx.g(x, pe=3, offset=0)) == 0
+        ctx.free(x)
+
+    def test_fence_orders_without_completing(self, world):
+        """fence is the WEAK barrier: same-PE puts stay ordered across
+        it (later put wins) but it must not force completion — pending
+        ops survive a fence and land at quiet."""
+        ctx = pgas.init(world)
+        x = ctx.malloc((2,), "float32")
+        ctx.put(x, np.full(2, 1.0, np.float32), pe=1)
+        ctx.fence(x)
+        ctx.put(x, np.full(2, 2.0, np.float32), pe=1)
+        # fence did not complete: the window still has pending ops
+        assert x._win._pending, "fence must not flush"
+        ctx.quiet(x)
+        assert not x._win._pending
+        np.testing.assert_array_equal(np.asarray(x.local(1)),
+                                      np.full(2, 2.0))
+        ctx.free(x)
+
+    def test_active_set_reduce_and_broadcast(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((2,), "float32")
+        for pe in range(ctx.n_pes):
+            ctx.put(x, np.full(2, float(pe + 1), np.float32), pe=pe)
+        ctx.quiet(x)
+        # active set {1, 3, 5, 7}: start=1, logPE_stride=1, size=4
+        ctx.reduce_active(x, "sum", start=1, log_stride=1, size=4)
+        arr = np.asarray(x.array)
+        exp = 2.0 + 4.0 + 6.0 + 8.0
+        for pe in (1, 3, 5, 7):
+            assert np.allclose(arr[pe], exp), arr[pe]
+        for pe in (0, 2, 4, 6):  # non-members untouched
+            assert np.allclose(arr[pe], pe + 1), arr[pe]
+
+        # broadcast within set {0, 2, 4, 6} from set-root index 2 (PE 4)
+        ctx.broadcast_active(x, root=2, start=0, log_stride=1, size=4)
+        arr = np.asarray(x.array)
+        for pe in (0, 2, 4, 6):
+            assert np.allclose(arr[pe], 5.0), arr[pe]
+        for pe in (1, 3, 5, 7):
+            assert np.allclose(arr[pe], exp), arr[pe]
+        ctx.free(x)
+
+    def test_active_set_collect_and_barrier(self, world):
+        ctx = pgas.init(world)
+        x = ctx.malloc((1,), "float32")
+        for pe in range(ctx.n_pes):
+            ctx.put(x, np.asarray([float(pe)], np.float32), pe=pe)
+        ctx.quiet(x)
+        out = np.asarray(ctx.collect_active(x, start=2, log_stride=0,
+                                            size=3))
+        # every member sees the concatenation of PEs 2, 3, 4
+        assert out.shape[-2:] == (3, 1)
+        np.testing.assert_array_equal(out.reshape(-1, 3, 1)[0].ravel(),
+                                      [2.0, 3.0, 4.0])
+        ctx.barrier_active(start=2, log_stride=0, size=3)
+        from ompi_tpu.core.errors import ArgumentError
+
+        with pytest.raises(ArgumentError, match="exceeds"):
+            ctx.reduce_active(x, start=4, log_stride=1, size=4)
+        ctx.free(x)
