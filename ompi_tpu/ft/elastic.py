@@ -32,6 +32,75 @@ logger = get_logger("ft.elastic")
 _failed: set[int] = set()  # world ranks reported dead
 _lock = threading.Lock()
 _handler_id: Optional[int] = None
+_abandoned: list[Any] = []  # detached runtime handles kept alive (no dtors)
+
+
+def recoverable() -> None:
+    """Arm multi-controller survival BEFORE jax.distributed.initialize.
+
+    The reference's failure semantics are that a peer death routes to
+    the application's errhandler and the runtime never kills survivors
+    (reference: ompi/runtime/ompi_mpi_init.c:524 — PMIx event
+    registration feeds errhandlers, not exit()). JAX's coordination
+    service defaults to the opposite: a missed-heartbeat on ANY task
+    fatally terminates every other task ("Terminating process because
+    the JAX distributed service detected fatal errors"). This flips the
+    client into recoverable mode (`jax_enable_recoverability`) so that
+    a dead peer is OUR event to handle — watch_dcn/shrink/respawn run
+    to completion even after the coordination-service heartbeat fuse
+    has fired. Must be called before jax.distributed.initialize; it is
+    a no-op (with a warning) afterwards.
+    """
+    import jax
+
+    from jax._src import distributed as jdist
+
+    if jdist.global_state.client is not None:
+        logger.warning(
+            "recoverable() called after jax.distributed.initialize; "
+            "the running client keeps its fatal failure handler"
+        )
+        return
+    jax.config.update("jax_enable_recoverability", True)
+    SPC.record("ft_recoverable_arms")
+
+
+def detach() -> None:
+    """Quiesce + leave the current jax.distributed job (idempotent).
+
+    Called by a survivor once peer failure is confirmed: the doomed
+    job's coordination client/service must not be re-entered by any
+    later code path (barriers, preemption sync, atexit shutdown) while
+    recovery re-wires the world over the live fabric. The handles are
+    moved into a module-level abandon list — NOT destroyed — because
+    their destructors perform blocking shutdown RPCs against a
+    coordinator that is dead or dying. This is the "leave the job"
+    step of the recovery protocol (the reference never needs it: its
+    RTE continues around failures, ompi_mpi_init.c:524).
+    """
+    import ctypes
+
+    from jax._src import distributed as jdist
+
+    st = jdist.global_state
+    left = False
+    for name in ("preemption_sync_manager", "client", "service"):
+        handle = getattr(st, name)
+        if handle is None:
+            continue
+        # A module-level list is not enough: interpreter finalization
+        # clears module globals, which would still run the handle's
+        # destructor (a blocking shutdown RPC against the dead
+        # coordinator). Pin the refcount permanently — the handle is
+        # leaked on purpose; the process is exiting anyway.
+        ctypes.pythonapi.Py_IncRef(ctypes.py_object(handle))
+        _abandoned.append(handle)
+        setattr(st, name, None)
+        left = True
+    if left:
+        st.coordinator_address = None
+        SPC.record("ft_detaches")
+        logger.info("detached from jax.distributed job (handles abandoned)")
 
 
 def _on_failure(ev: events.Event) -> None:

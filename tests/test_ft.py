@@ -472,11 +472,14 @@ peer = ep.connect(a["ip"], a["port"], cookie=2)  # we are slice 1
 h = hier.SliceHandle(comm=comm, endpoint=ep, slice_id=1, n_slices=2,
                      peer_ids={0: peer})
 
-state = CheckpointManager(ckdir).restore(1)
-rows = np.asarray(state["x"])[2:4]   # the replaced ranks' shard
+# restore() returns (state, meta); arrays-CRS without a template keys
+# leaves by keypath string ("['x']"), not by the original dict key
+state, _meta = CheckpointManager(ckdir).restore(1)
+x = np.asarray(state["['x']"])
+rows = x[2:4]                        # the replaced ranks' shard
 out = np.asarray(hier.allreduce(h, comm.put_rank_major(rows),
                                 timeout=60.0))
-expect = np.asarray(state["x"]).sum(axis=0)
+expect = x.sum(axis=0)
 assert np.allclose(out, expect), out
 ep.close()
 print("REPLACEMENT OK", flush=True)
@@ -500,9 +503,13 @@ from ompi_tpu.ft import elastic
 from ompi_tpu.ft.manager import CheckpointManager
 from ompi_tpu.runtime import modex
 
+# Arm survival BEFORE joining the job: without this the coordination
+# service's heartbeat fuse fatally kills the survivor mid-recovery.
+elastic.recoverable()
 jax.distributed.initialize(coordinator_address=coord,
                            num_processes=nprocs, process_id=pid,
-                           local_device_ids=[0, 1])
+                           local_device_ids=[0, 1],
+                           heartbeat_timeout_seconds=10)
 world = ompi_tpu.init()
 local_ranks = [r for r, p in enumerate(world.procs)
                if p.process_index == pid]
@@ -543,10 +550,17 @@ except dcn.DcnError:
 assert died, "peer death went undetected"
 assert set(elastic.failed_ranks()) == set(remote_ranks)
 
-# shrink: agree on survivors, restore the checkpoint on the shrunk world
+# leave the doomed job, then shrink: agree on survivors, restore the
+# checkpoint on the shrunk world
+elastic.detach()
 new_comm, restored, meta = elastic.respawn(world, mgr)
 assert new_comm.size == len(local_ranks)
 print("SHRUNK", flush=True)
+
+# Prove recovery survives the coordination-service fuse: sleep PAST the
+# 10 s heartbeat timeout before re-wiring. Pre-recoverable(), this is
+# exactly the window in which the survivor was fatally terminated.
+time.sleep(12)
 
 # RESPAWN: launch a replacement controller, re-wire over the live
 # fabric (file modex — the old coordinator died with the victim),
