@@ -60,10 +60,32 @@ _eager_limit_var = config.register(
                 "larger payloads chunk-stream (reference: btl/sm "
                 "32 KiB eager, btl_sm_component.c:243)",
 )
+_cma_var = config.register(
+    "btl", "sm", "use_cma", type=bool, default=True,
+    description="Single-copy bulk transfers via process_vm_readv when "
+                "the kernel allows it (probed per peer at connect; "
+                "reference: btl/sm CMA get, btl_sm_get.c:69, mechanism "
+                "selection btl_sm_component.c:453-478). Off or denied: "
+                "bulk chunk-streams through the shared rings.",
+)
+_cma_min_var = config.register(
+    "btl", "sm", "cma_min", type=int, default=256 * 1024,
+    description="Smallest payload that takes the single-copy CMA path. "
+                "CMA is a rendezvous (the sender parks until the "
+                "receiver reads the message); below this, bulk keeps "
+                "the buffered chunk tier and completes on return.",
+)
 
 
 class ShmError(OmpiTpuError):
     errclass = "ERR_OTHER"
+
+
+class ShmPullError(ShmError):
+    """A single-copy CMA pull failed mid-receive (sender exited or the
+    kernel withdrew permission). If the sender is alive it re-sends the
+    payload through the chunk tier, so waiters should keep waiting;
+    the progress pump converts this into a DEVICE_ERROR event."""
 
 
 def _declare(lib) -> None:
@@ -75,7 +97,8 @@ def _declare(lib) -> None:
     P = ctypes.c_void_p
     lib.shm_create.restype = P
     lib.shm_create.argtypes = [ctypes.c_char_p, ctypes.c_int,
-                               ctypes.c_int, LL, LL, LL]
+                               ctypes.c_int, LL, LL, LL, ctypes.c_int,
+                               LL]
     lib.shm_connect.restype = ctypes.c_int
     lib.shm_connect.argtypes = [P, ctypes.c_int, ctypes.c_int]
     lib.shm_send.restype = LL
@@ -96,10 +119,14 @@ def _declare(lib) -> None:
     lib.shm_notify.argtypes = [P]
     lib.shm_read.restype = LL
     lib.shm_read.argtypes = [P, LL, ctypes.c_void_p, LL]
+    lib.shm_requeue.restype = None
+    lib.shm_requeue.argtypes = [P, LL]
     lib.shm_stat.restype = LL
     lib.shm_stat.argtypes = [P, ctypes.c_int]
     lib.shm_peer_alive.restype = ctypes.c_int
     lib.shm_peer_alive.argtypes = [P, ctypes.c_int]
+    lib.shm_peer_cma.restype = ctypes.c_int
+    lib.shm_peer_cma.argtypes = [P, ctypes.c_int]
     lib.shm_destroy.restype = None
     lib.shm_destroy.argtypes = [P]
     lib._shm_declared = True
@@ -108,6 +135,8 @@ def _declare(lib) -> None:
 _STAT_NAMES = (
     "bytes_sent", "bytes_recv", "fbox_sends", "ring_sends",
     "chunk_msgs", "msgs_recvd", "send_stalls", "fbox_recvs", "peers",
+    "ns_stalled", "ns_sweep", "cma_sends", "cma_bytes_pulled",
+    "cma_fails", "proto_errors",
 )
 
 
@@ -127,7 +156,8 @@ class ShmEndpoint:
         self._ctx = lib.shm_create(
             prefix.encode(), my_rank, _max_peers_var.value,
             _fbox_var.value, _ring_var.value,
-            _eager_limit_var.value,
+            _eager_limit_var.value, int(_cma_var.value),
+            _cma_min_var.value,
         )
         if not self._ctx:
             raise ShmError(
@@ -175,7 +205,13 @@ class ShmEndpoint:
         SPC.record("sm_send_bytes", buf.nbytes)
         return 0  # copy semantics: complete on return
 
-    def poll_recv(self) -> Optional[tuple[int, int, bytes]]:
+    def poll_recv(self) -> Optional[tuple[int, int, Any]]:
+        """One completed message as (peer, tag, payload) or None.
+        Payload is `bytes` up to 64 KiB and a read-only memoryview
+        above (zero-copy delivery of single-copy CMA pulls); both
+        support len/slice/==/np.frombuffer. A failed CMA pull (sender
+        vanished mid-rendezvous) raises ShmPullError — progress pumps
+        convert it to a DEVICE_ERROR event and keep polling."""
         import ctypes
 
         peer = ctypes.c_int(0)
@@ -200,35 +236,87 @@ class ShmEndpoint:
         finally:
             guard.__exit__(None, None, None)
 
-    def _consume(self, msgid, peer, tag, length) -> tuple[int, int, bytes]:
+    def _consume(self, msgid, peer, tag, length):
         buf = np.empty(max(1, length.value), np.uint8)
         got = self._lib.shm_read(
             self._ctx, msgid, buf.ctypes.data, length.value
         )
+        if got == -3:
+            # If the sender is alive it re-sends via the chunk tier —
+            # this message id is gone but the payload is not.
+            raise ShmPullError(
+                f"shm CMA pull from peer {peer.value} failed"
+            )
         if got != length.value:
             raise ShmError(f"short shm read {got} != {length.value}")
         SPC.record("sm_recv_bytes", length.value)
-        return int(peer.value), int(tag.value), buf[:length.value].tobytes()
+        if length.value <= 65536:
+            payload = buf[:length.value].tobytes()
+        else:
+            # Bulk: a .tobytes() here would re-copy what may have just
+            # arrived as a SINGLE process_vm_readv into `buf`. The
+            # array is exclusively ours — hand out a read-only view.
+            payload = buf[:length.value].data.toreadonly()
+        return int(peer.value), int(tag.value), payload
 
-    def recv_bytes(self, timeout: float = 10.0) -> tuple[int, int, bytes]:
+    def _wait_msg(self, deadline, what):
+        """Shared park-until-message loop; returns (msgid, peer, tag,
+        length) ctypes cells, or raises ShmError on timeout."""
         import ctypes
 
-        deadline = time.monotonic() + timeout
         peer = ctypes.c_int(0)
         tag = ctypes.c_longlong(0)
         length = ctypes.c_longlong(0)
         while True:
             remaining = deadline - time.monotonic()
             slice_ms = max(1, min(100, int(remaining * 1000)))
-            with self._native_call(what="recv"):
+            with self._native_call(what=what):
                 msgid = self._lib.shm_wait_recv(
                     self._ctx, slice_ms, ctypes.byref(peer),
                     ctypes.byref(tag), ctypes.byref(length),
                 )
-                if msgid:
-                    return self._consume(msgid, peer, tag, length)
+            if msgid:
+                return msgid, peer, tag, length
             if time.monotonic() >= deadline:
                 raise ShmError("shm recv timeout")
+
+    def recv_into(self, out, timeout: float = 10.0) -> tuple[int, int, int]:
+        """Deliver the next message's payload into `out` (a writable
+        buffer-protocol object, e.g. a reused numpy array — warm pages
+        make the single-copy pull run at kernel-copy speed). Returns
+        (peer, tag, nbytes). If `out` is too small the message is
+        REQUEUED (front of the queue) and ShmError raised: retry with a
+        larger buffer; nothing is lost and the sender stays parked."""
+        dst = np.frombuffer(out, np.uint8)
+        deadline = time.monotonic() + timeout
+        msgid, peer, tag, length = self._wait_msg(deadline, "recv_into")
+        with self._native_call(what="recv_into"):
+            if length.value > dst.nbytes:
+                self._lib.shm_requeue(self._ctx, msgid)
+                raise ShmError(
+                    f"recv_into buffer too small "
+                    f"({dst.nbytes} < {length.value}); message requeued"
+                )
+            got = self._lib.shm_read(
+                self._ctx, msgid, dst.ctypes.data, dst.nbytes
+            )
+        if got == -3:
+            raise ShmPullError(
+                f"shm CMA pull from peer {peer.value} failed"
+            )
+        if got != length.value:
+            raise ShmError(f"short shm read {got} != {length.value}")
+        SPC.record("sm_recv_bytes", length.value)
+        return int(peer.value), int(tag.value), int(got)
+
+    def recv_bytes(self, timeout: float = 10.0) -> tuple[int, int, Any]:
+        """Next message as (peer, tag, payload); payload type follows
+        poll_recv's contract (bytes <= 64 KiB, read-only memoryview
+        above)."""
+        deadline = time.monotonic() + timeout
+        msgid, peer, tag, length = self._wait_msg(deadline, "recv")
+        with self._native_call(what="recv"):
+            return self._consume(msgid, peer, tag, length)
 
     def wait_event(self, timeout: float) -> bool:
         ms = max(1, min(200, int(timeout * 1000)))
@@ -254,6 +342,16 @@ class ShmEndpoint:
                 return bool(
                     self._lib.shm_peer_alive(self._ctx, peer_rank)
                 )
+        except ShmError:
+            return False
+
+    def peer_cma(self, peer_rank: int) -> bool:
+        """True when bulk sends to this peer use the single-copy
+        process_vm_readv path (probed at connect, may withdraw at
+        runtime if the kernel starts denying the pull)."""
+        try:
+            with self._native_call(what="peer_cma"):
+                return self._lib.shm_peer_cma(self._ctx, peer_rank) == 1
         except ShmError:
             return False
 
@@ -363,6 +461,33 @@ class SmBtl(BtlComponent):
             idx == me or idx in shm_peers
             for idx in (src_proc.process_index, dst_proc.process_index)
         )
+
+    def wire_label(self, comm, src_rank: int, dst_rank: int) -> str:
+        """comm_method detail: "sm/cma" when bulk toward the remote
+        side of this pair rides the single-copy pull, plain "sm"
+        otherwise (mirrors the reference printing the sm mechanism).
+        Local view only: pairs not involving this process render plain
+        "sm" even if those two processes negotiated CMA between
+        themselves — their mechanism is not observable from here."""
+        from ..pml.framework import PML
+
+        try:
+            eng = getattr(PML.component("ob1"), "_fabric", None)
+        except Exception:
+            return self.NAME
+        if eng is None or eng.shm is None:
+            return self.NAME
+        import jax
+
+        me = jax.process_index()
+        remote = [
+            p.process_index
+            for p in (comm.procs[src_rank], comm.procs[dst_rank])
+            if p.process_index != me
+        ]
+        if remote and all(eng.shm.peer_cma(idx) for idx in remote):
+            return f"{self.NAME}/cma"
+        return self.NAME
 
     def transfer(self, value, src_proc, dst_proc):
         from ..core.errors import CommError

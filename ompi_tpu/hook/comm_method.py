@@ -41,7 +41,9 @@ def transport_matrix(comm) -> list[list[str]]:
             if bml is None:
                 row.append("?")
             else:
-                row.append(bml.btl_for(s, d).NAME)
+                btl = bml.btl_for(s, d)
+                label = getattr(btl, "wire_label", None)
+                row.append(label(comm, s, d) if label else btl.NAME)
         out.append(row)
     return out
 

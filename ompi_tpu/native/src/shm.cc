@@ -24,6 +24,19 @@
 //    {sendid, total, off} reassembled receiver-side (copy semantics —
 //    the sender's buffer is free on return, so there is no FIN/pin
 //    protocol to deadlock).
+//  * Bulk single-copy (CMA): when process_vm_readv reaches the peer
+//    (probed once per connection against the peer's published mapping
+//    address), bulk messages publish ONE CMADESC frame {sendid, total,
+//    src_addr} and the receiver pulls the payload straight from the
+//    sender's pages in one syscall — the reference's btl/sm get path
+//    (reference: opal/mca/btl/sm/btl_sm_get.c:69 mca_btl_sm_get_cma;
+//    mechanism selection btl_sm_component.c:453-478). The sender
+//    blocks until the per-slot ack counter covers its sendid (its
+//    buffer must stay mapped while the receiver pulls), sweeping its
+//    own inbox while parked so two processes CMA-sending at each other
+//    pull each other's payloads and both complete. Pull failure posts
+//    the per-slot err counter and the sender falls back to chunk
+//    streaming (ptrace scope denial, peer exit).
 //  * Parking: each segment has a doorbell word. Senders bump+wake after
 //    publishing; a receiver with nothing pending futex-waits on it.
 //    This is the wait_sync analog (reference:
@@ -55,16 +68,18 @@
 #include <sys/mman.h>
 #include <sys/stat.h>
 #include <sys/syscall.h>
+#include <sys/uio.h>
 #include <time.h>
 #include <unistd.h>
 
 namespace {
 
 constexpr uint32_t kMagic = 0x534D5470;  // "SMTp"
-constexpr uint32_t kVersion = 1;
+constexpr uint32_t kVersion = 2;
 
-constexpr uint32_t kEager = 1;  // whole message inline
-constexpr uint32_t kChunk = 2;  // {sendid,total,off} + slice
+constexpr uint32_t kEager = 1;    // whole message inline
+constexpr uint32_t kChunk = 2;    // {sendid,total,off} + slice
+constexpr uint32_t kCmaDesc = 3;  // {sendid,total,src_addr}: pull me
 
 inline uint64_t align8(uint64_t v) { return (v + 7) & ~uint64_t(7); }
 inline uint64_t align64(uint64_t v) { return (v + 63) & ~uint64_t(63); }
@@ -110,6 +125,24 @@ struct ChunkHdr {
   uint64_t off;
 };
 
+struct CmaDesc {
+  uint64_t sendid;
+  uint64_t total;
+  uint64_t addr;  // source buffer in the SENDER's address space
+  int64_t pid;    // sender pid (the receiver's SegHdr.pid is its own)
+};
+
+// Per-slot single-copy rendezvous state, written by the segment owner
+// (the receiver), read by the slot's sender. Monotonic sendid counters;
+// one outstanding CMA send per slot (the sender serializes), so
+// "covers" is a plain >= compare.
+struct CmaMeta {
+  std::atomic<uint64_t> ack;  // highest sendid fully pulled
+  std::atomic<uint64_t> err;  // highest sendid whose pull FAILED
+  char pad[48];
+};
+static_assert(sizeof(CmaMeta) == 64, "cma meta layout");
+
 struct SegHdr {
   // Atomic: the creator's release-store of magic publishes the whole
   // initialized header; connectors acquire-load it before reading any
@@ -130,8 +163,9 @@ struct SegHdr {
   uint32_t pad0;
   uint64_t fbox_size;
   uint64_t ring_size;
+  uint64_t base_addr;  // creator's own mapping address (CMA probe target)
   // slot_owner[max_peers] follows (claimed by sender rank via CAS),
-  // then the per-slot (fastbox, ring) pairs, all 64-aligned.
+  // then the per-slot (CmaMeta, fastbox, ring) triples, all 64-aligned.
 };
 
 inline char* ring_data(RingHdr* r) {
@@ -139,7 +173,8 @@ inline char* ring_data(RingHdr* r) {
 }
 
 uint64_t slot_bytes(uint64_t fbox, uint64_t ring) {
-  return align64(sizeof(RingHdr) + fbox) + align64(sizeof(RingHdr) + ring);
+  return sizeof(CmaMeta) + align64(sizeof(RingHdr) + fbox) +
+         align64(sizeof(RingHdr) + ring);
 }
 
 uint64_t header_bytes(int max_peers) {
@@ -151,17 +186,21 @@ std::atomic<int32_t>* owner_table(SegHdr* seg) {
       reinterpret_cast<char*>(seg) + sizeof(SegHdr));
 }
 
-RingHdr* slot_fbox(SegHdr* seg, int slot) {
+CmaMeta* slot_cma(SegHdr* seg, int slot) {
   char* base = reinterpret_cast<char*>(seg) + header_bytes(seg->max_peers) +
                uint64_t(slot) * slot_bytes(seg->fbox_size, seg->ring_size);
-  return reinterpret_cast<RingHdr*>(base);
+  return reinterpret_cast<CmaMeta*>(base);
+}
+
+RingHdr* slot_fbox(SegHdr* seg, int slot) {
+  return reinterpret_cast<RingHdr*>(
+      reinterpret_cast<char*>(slot_cma(seg, slot)) + sizeof(CmaMeta));
 }
 
 RingHdr* slot_ring(SegHdr* seg, int slot) {
-  char* base = reinterpret_cast<char*>(seg) + header_bytes(seg->max_peers) +
-               uint64_t(slot) * slot_bytes(seg->fbox_size, seg->ring_size) +
-               align64(sizeof(RingHdr) + seg->fbox_size);
-  return reinterpret_cast<RingHdr*>(base);
+  return reinterpret_cast<RingHdr*>(
+      reinterpret_cast<char*>(slot_fbox(seg, slot)) +
+      align64(sizeof(RingHdr) + seg->fbox_size));
 }
 
 void copy_in(RingHdr* r, uint64_t pos, const void* src, uint64_t n) {
@@ -209,6 +248,15 @@ struct Msg {
   int peer;
   int64_t tag;
   Buf data;
+  // Pending single-copy pull: the payload still lives in the SENDER's
+  // pages (it is parked on our ack); shm_read pulls it straight into
+  // the consumer's buffer — the true single copy. cma_slot >= 0 marks
+  // a pending pull.
+  int cma_slot = -1;
+  int64_t cma_pid = 0;
+  uint64_t cma_sendid = 0;
+  uint64_t cma_addr = 0;
+  uint64_t cma_total = 0;
 };
 
 struct Assembly {
@@ -223,6 +271,11 @@ struct PeerConn {
   int slot = -1;           // our claimed slot in the peer's segment
   uint64_t next_sendid = 1;
   std::mutex mu;           // serializes this process's producers
+  // process_vm_readv reach (probed at connect, withdrawn on pull
+  // failure). Atomic: written in the send fallback while read lock-free
+  // at shm_send entry and by shm_peer_cma.
+  std::atomic<bool> cma_ok{false};
+  std::mutex cma_mu;       // one outstanding CMA send per slot
 };
 
 // A peer is gone when it flagged dead OR its pid vanished (SIGKILL
@@ -254,6 +307,11 @@ struct Ctx {
 
   uint64_t eager_limit = 32 * 1024;  // btl_sm_component.c:243 lineage
   uint64_t fbox_msg_limit = 0;       // fbox_size/4, reference :200 regime
+  bool cma_enabled = true;
+  // Below this, bulk keeps the buffered chunk tier: CMA is rendezvous
+  // (the sender parks until the receiver reads THIS message), and that
+  // semantic shift is only worth it once payloads dwarf the ring.
+  uint64_t cma_min = 256 * 1024;
 
   // stats
   std::atomic<int64_t> bytes_sent{0}, bytes_recv{0}, fbox_sends{0},
@@ -261,6 +319,9 @@ struct Ctx {
       fbox_recvs{0};
   // diagnostic timers (ns)
   std::atomic<int64_t> ns_stalled{0}, ns_sweep{0}, ns_push_copy{0};
+  // single-copy path
+  std::atomic<int64_t> cma_sends{0}, cma_bytes_pulled{0}, cma_fails{0},
+      proto_errors{0};
 };
 
 inline int64_t now_ns() {
@@ -296,6 +357,20 @@ void buf_release(Ctx* c, Buf& b) {
   }
   b.p = nullptr;
   b.len = b.cap = 0;
+}
+
+// Pull `total` bytes from (pid, addr) into dst in as few syscalls as
+// the kernel allows (partial transfers loop). Returns true on success.
+bool cma_pull(pid_t pid, uint64_t addr, char* dst, uint64_t total) {
+  uint64_t off = 0;
+  while (off < total) {
+    iovec liov{dst + off, (size_t)(total - off)};
+    iovec riov{(void*)(addr + off), (size_t)(total - off)};
+    ssize_t n = process_vm_readv(pid, &liov, 1, &riov, 1, 0);
+    if (n <= 0) return false;
+    off += (uint64_t)n;
+  }
+  return true;
 }
 
 // Sweep every owned slot of our own segment: move complete messages to
@@ -345,6 +420,18 @@ void sweep_locked(Ctx* c) {
             copy_out_wrap(r, head + sizeof(fh) + sizeof(ch),
                           a.buf.p + ch.off, n);
             a.got += n;
+          } else {
+            // An out-of-bounds chunk is a protocol error: the assembly
+            // can never complete, so drop it whole (keeping it would
+            // leak the buffer forever) and make the condition
+            // observable.
+            buf_release(c, a.buf);
+            c->assem.erase(key);
+            c->proto_errors.fetch_add(1, std::memory_order_relaxed);
+            r->head.store(head + sizeof(fh) + align8(fh.len),
+                          std::memory_order_release);
+            advanced = true;
+            continue;
           }
           if (a.got >= a.buf.len) {
             int64_t id = c->next_msgid++;
@@ -355,6 +442,24 @@ void sweep_locked(Ctx* c) {
             c->msgs_recvd.fetch_add(1, std::memory_order_relaxed);
             c->assem.erase(key);
           }
+        } else if (fh.kind == kCmaDesc && fh.len >= sizeof(CmaDesc)) {
+          // Single-copy bulk: record the descriptor; the pull happens
+          // lazily in shm_read, straight into the consumer's buffer
+          // (source is stable — the sender is parked on our ack/err).
+          CmaDesc d;
+          copy_out_wrap(r, head + sizeof(fh), &d, sizeof(d));
+          Msg m;
+          m.peer = owner;
+          m.tag = (int64_t)fh.tag;
+          m.cma_slot = slot;
+          m.cma_pid = d.pid;
+          m.cma_sendid = d.sendid;
+          m.cma_addr = d.addr;
+          m.cma_total = d.total;
+          int64_t id = c->next_msgid++;
+          c->msgs.emplace(id, m);
+          c->ready.push_back(id);
+          c->msgs_recvd.fetch_add(1, std::memory_order_relaxed);
         }
         // unknown kinds are skipped (forward compatibility)
         r->head.store(head + sizeof(fh) + align8(fh.len),
@@ -375,6 +480,54 @@ void ring_doorbell(SegHdr* seg) {
   seg->doorbell.fetch_add(1, std::memory_order_release);
   if (seg->doorbell_waiters.load(std::memory_order_acquire))
     futex_wake_all(&seg->doorbell);
+}
+
+// Post the pull outcome on our own segment's per-slot counters and
+// release the parked sender via the drain bell.
+void cma_post(Ctx* c, int slot, uint64_t sendid, bool ok) {
+  CmaMeta* meta = slot_cma(c->seg, slot);
+  (ok ? meta->ack : meta->err).store(sendid, std::memory_order_release);
+  c->seg->drain_bell.fetch_add(1, std::memory_order_release);
+  if (c->seg->drain_waiters.load(std::memory_order_acquire))
+    futex_wake_all(&c->seg->drain_bell);
+}
+
+// Execute one pending pull into dst (or an owned Buf when dst is
+// null). Caller holds sweep_mu. Returns pulled byte count or -3.
+long long cma_complete(Ctx* c, Msg& m, void* dst) {
+  Buf own;
+  char* target = (char*)dst;
+  if (target == nullptr) {
+    own = buf_grab(c, m.cma_total);
+    target = own.p;
+  }
+  bool ok = target != nullptr &&
+            cma_pull((pid_t)m.cma_pid, m.cma_addr, target, m.cma_total);
+  cma_post(c, m.cma_slot, m.cma_sendid, ok);
+  if (!ok) {
+    buf_release(c, own);
+    m.cma_slot = -2;  // failed: never re-pull, shm_read reports -3
+    c->cma_fails.fetch_add(1, std::memory_order_relaxed);
+    return -3;
+  }
+  c->bytes_recv.fetch_add((int64_t)m.cma_total, std::memory_order_relaxed);
+  c->cma_bytes_pulled.fetch_add((int64_t)m.cma_total,
+                                std::memory_order_relaxed);
+  if (dst == nullptr) {
+    m.data = own;  // resolved eagerly: now an ordinary buffered message
+    m.cma_slot = -1;
+  }
+  return (long long)m.cma_total;
+}
+
+// Resolve every pending pull into owned buffers. Called ONLY from
+// sender-stall paths: a thread parked in shm_send cannot reach
+// shm_read, so without this two processes CMA-sending at each other
+// would deadlock on their mutual acks. Caller holds sweep_mu.
+void cma_resolve_pending_locked(Ctx* c) {
+  for (auto& kv : c->msgs) {
+    if (kv.second.cma_slot >= 0) cma_complete(c, kv.second, nullptr);
+  }
 }
 
 // Push with sender-side progression: while the remote ring is full,
@@ -407,9 +560,12 @@ bool push_progress(Ctx* c, PeerConn* p, RingHdr* r, uint64_t tag,
     }
     if (t0 < 0) t0 = now_ns();
     c->send_stalls.fetch_add(1, std::memory_order_relaxed);
-    {  // drain our own inbox while stalled (deadlock avoidance)
+    {  // drain our own inbox while stalled (deadlock avoidance) —
+      // including pending CMA pulls, whose parked senders may be what
+      // keeps the remote consumer from draining our target ring
       std::lock_guard<std::mutex> g(c->sweep_mu);
       sweep_locked(c);
+      cma_resolve_pending_locked(c);
     }
     if (++spins < 16) {
       sched_yield();
@@ -429,12 +585,15 @@ extern "C" {
 
 void* shm_create(const char* prefix, int my_rank, int max_peers,
                  long long fbox_size, long long ring_size,
-                 long long eager_limit) {
+                 long long eager_limit, int enable_cma,
+                 long long cma_min) {
   if (max_peers <= 0 || fbox_size < 1024 || ring_size < 16 * 1024)
     return nullptr;
   Ctx* c = new Ctx();
   c->prefix = prefix;
   c->my_rank = my_rank;
+  c->cma_enabled = enable_cma != 0;
+  if (cma_min > 0) c->cma_min = (uint64_t)cma_min;
   // A whole eager frame must FIT the ring or shm_send would retry
   // forever on a legal-but-inconsistent config: clamp the inline tier
   // to a quarter ring (larger messages chunk-stream, which always
@@ -478,6 +637,7 @@ void* shm_create(const char* prefix, int my_rank, int max_peers,
   seg->max_peers = max_peers;
   seg->fbox_size = (uint64_t)fbox_size;
   seg->ring_size = (uint64_t)ring_size;
+  seg->base_addr = (uint64_t)base;  // CMA probe target for connectors
   std::atomic<int32_t>* owners = owner_table(seg);
   for (int i = 0; i < max_peers; ++i)
     owners[i].store(-1, std::memory_order_relaxed);
@@ -562,6 +722,24 @@ int shm_connect(void* ctx, int peer_rank, int timeout_ms) {
   p->seg = seg;
   p->map_len = total;
   p->slot = slot;
+  // CMA capability probe: read the peer's magic word through its own
+  // mapping address. One syscall settles uid/ptrace-scope policy for
+  // the life of the connection (reference: btl_sm_component.c:453-478
+  // selects XPMEM/CMA/KNEM at add_procs time).
+  // NOTE the probe direction: this proves WE can read the PEER, while
+  // the send path needs the peer to read US. Ptrace policy is
+  // symmetric in the common same-uid case; if an asymmetric setup
+  // (one-sided CAP_SYS_PTRACE / PR_SET_PTRACER) passes the probe but
+  // denies the receiver's pull, the first bulk send degrades
+  // gracefully: the receiver posts err, we fall back to chunk
+  // streaming the same payload, and cma_ok withdraws for good.
+  if (c->cma_enabled) {
+    uint32_t probe = 0;
+    p->cma_ok.store(cma_pull((pid_t)seg->pid, seg->base_addr,
+                             (char*)&probe, sizeof(probe)) &&
+                        probe == kMagic,
+                    std::memory_order_relaxed);
+  }
   std::lock_guard<std::mutex> g(c->conn_mu);
   c->peers.emplace(peer_rank, p);
   return 0;
@@ -604,7 +782,62 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
     c->bytes_sent.fetch_add(len, std::memory_order_relaxed);
     return 0;
   }
-  // Tier 3: chunk-stream bulk payloads through the eager ring. Chunk
+  // Tier 3a: single-copy pull (CMA). Publish ONE descriptor, park
+  // until the receiver's pull lands (our buffer must stay valid), and
+  // sweep our own inbox while parked so opposing CMA streams pull each
+  // other through. Serialized per slot: the per-slot ack/err counters
+  // track exactly one outstanding sendid.
+  if (n >= c->cma_min && p->cma_ok.load(std::memory_order_relaxed)) {
+    std::lock_guard<std::mutex> cg(p->cma_mu);
+    uint64_t sendid;
+    {
+      std::lock_guard<std::mutex> g(p->mu);
+      sendid = p->next_sendid++;
+    }
+    CmaDesc d{sendid, n, (uint64_t)buf, (int64_t)getpid()};
+    if (!push_progress(c, p, ring, (uint64_t)tag, kCmaDesc, &d, sizeof(d),
+                       nullptr, 0))
+      return -2;
+    CmaMeta* meta = slot_cma(p->seg, p->slot);
+    bool pulled = false, failed = false;
+    while (!pulled && !failed) {
+      if (meta->ack.load(std::memory_order_acquire) >= sendid) {
+        pulled = true;
+        break;
+      }
+      if (meta->err.load(std::memory_order_acquire) >= sendid) {
+        failed = true;
+        break;
+      }
+      if (peer_dead(p)) return -2;
+      uint32_t seen = p->seg->drain_bell.load(std::memory_order_acquire);
+      if (meta->ack.load(std::memory_order_acquire) >= sendid) {
+        pulled = true;
+        break;
+      }
+      {  // drain our own inbox while parked — resolving pending CMA
+        // pulls eagerly, or two opposing CMA senders would deadlock
+        // on their mutual acks
+        std::lock_guard<std::mutex> g(c->sweep_mu);
+        sweep_locked(c);
+        cma_resolve_pending_locked(c);
+      }
+      p->seg->drain_waiters.fetch_add(1, std::memory_order_acq_rel);
+      futex_wait(&p->seg->drain_bell, seen, 5);
+      p->seg->drain_waiters.fetch_sub(1, std::memory_order_acq_rel);
+    }
+    if (pulled) {
+      c->cma_sends.fetch_add(1, std::memory_order_relaxed);
+      c->bytes_sent.fetch_add(len, std::memory_order_relaxed);
+      return 0;
+    }
+    // Receiver could not pull (ptrace scope, policy change): disable
+    // the path for this connection and chunk-stream THIS message under
+    // a fresh sendid below.
+    p->cma_ok.store(false, std::memory_order_relaxed);
+    c->cma_fails.fetch_add(1, std::memory_order_relaxed);
+  }
+  // Tier 3b: chunk-stream bulk payloads through the eager ring. Chunk
   // size: a quarter ring so the receiver overlaps drain with our copy.
   uint64_t chunk = p->seg->ring_size / 4;
   if (chunk > (4u << 20)) chunk = 4u << 20;
@@ -637,21 +870,46 @@ long long shm_poll_recv(void* ctx, int* peer, long long* tag,
   Msg& m = c->msgs[id];
   *peer = m.peer;
   *tag = m.tag;
-  *len = (long long)m.data.len;
+  *len = (long long)(m.cma_slot >= 0 ? m.cma_total : m.data.len);
   return id;
 }
 
+// Deliver msgid into buf. For a pending CMA message this IS the single
+// copy: sender pages -> consumer buffer, one process_vm_readv. Returns
+// bytes, -1 unknown/too-small, -3 pull failed (sender falls back and
+// re-sends the payload as chunks — a fresh message).
 long long shm_read(void* ctx, long long msgid, void* buf, long long cap) {
   Ctx* c = static_cast<Ctx*>(ctx);
   std::lock_guard<std::mutex> g(c->sweep_mu);
   auto it = c->msgs.find(msgid);
   if (it == c->msgs.end()) return -1;
-  long long n = (long long)it->second.data.len;
+  Msg& m = it->second;
+  if (m.cma_slot == -2) {
+    c->msgs.erase(it);
+    return -3;
+  }
+  if (m.cma_slot >= 0) {
+    if ((long long)m.cma_total > cap) return -1;
+    long long n = cma_complete(c, m, buf);
+    c->msgs.erase(it);
+    return n;
+  }
+  long long n = (long long)m.data.len;
   if (n > cap) return -1;
-  memcpy(buf, it->second.data.p, (size_t)n);
-  buf_release(c, it->second.data);
+  memcpy(buf, m.data.p, (size_t)n);
+  buf_release(c, m.data);
   c->msgs.erase(it);
   return n;
+}
+
+// Put a polled-but-undelivered message back at the FRONT of the ready
+// queue (e.g. the consumer's buffer was too small): nothing is lost,
+// no duplicate is minted, and a pending CMA sender keeps its park
+// until a properly-sized read arrives.
+void shm_requeue(void* ctx, long long msgid) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  if (c->msgs.count(msgid)) c->ready.push_front(msgid);
 }
 
 // Park until a message is pending or ~timeout; returns a msgid like
@@ -659,21 +917,25 @@ long long shm_read(void* ctx, long long msgid, void* buf, long long cap) {
 long long shm_wait_recv(void* ctx, int timeout_ms, int* peer,
                         long long* tag, long long* len) {
   Ctx* c = static_cast<Ctx*>(ctx);
-  int64_t left = timeout_ms;
+  // Budget from a monotonic deadline, not by decrementing the nominal
+  // slice: futex_wait returns early on every doorbell bump (spurious
+  // or not), and under a busy doorbell the nominal accounting would
+  // expire the call long before timeout_ms real time elapsed.
+  int64_t deadline = now_ns() + int64_t(timeout_ms) * 1000000;
   for (;;) {
     long long id = shm_poll_recv(ctx, peer, tag, len);
     if (id) return id;
-    if (left <= 0) return 0;
+    int64_t left_ms = (deadline - now_ns()) / 1000000;
+    if (left_ms <= 0) return 0;
     uint32_t seen = c->seg->doorbell.load(std::memory_order_acquire);
     // re-check after reading the doorbell (the publish order is
     // ring write -> doorbell bump -> wake)
     id = shm_poll_recv(ctx, peer, tag, len);
     if (id) return id;
-    int slice = (int)std::min<int64_t>(left, 100);
+    int slice = (int)std::min<int64_t>(left_ms, 100);
     c->seg->doorbell_waiters.fetch_add(1, std::memory_order_acq_rel);
     futex_wait(&c->seg->doorbell, seen, slice);
     c->seg->doorbell_waiters.fetch_sub(1, std::memory_order_acq_rel);
-    left -= slice;
   }
 }
 
@@ -729,8 +991,22 @@ long long shm_stat(void* ctx, int what) {
     }
     case 9: return c->ns_stalled.load();
     case 10: return c->ns_sweep.load();
+    case 11: return c->cma_sends.load();
+    case 12: return c->cma_bytes_pulled.load();
+    case 13: return c->cma_fails.load();
+    case 14: return c->proto_errors.load();
   }
   return -1;
+}
+
+// 1 when the CMA (process_vm_readv) single-copy path is active toward
+// this peer, 0 when bulk falls back to chunk streaming, -1 unknown.
+int shm_peer_cma(void* ctx, int peer_rank) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->conn_mu);
+  auto it = c->peers.find(peer_rank);
+  if (it == c->peers.end()) return -1;
+  return it->second->cma_ok.load(std::memory_order_relaxed) ? 1 : 0;
 }
 
 void shm_destroy(void* ctx) {

@@ -66,11 +66,22 @@ class ShmemContext:
     # -- symmetric heap ----------------------------------------------------
 
     def malloc(self, shape, dtype="float32", fill=0) -> SymmetricArray:
-        """shmem_malloc: collective; same block on every PE."""
+        """shmem_malloc: collective; same block on every PE.
+
+        The dtype is canonicalized to the platform word up front: SHMEM
+        code habitually allocates `long` (int64) lock/flag words, and
+        under JAX's default x64-disabled mode those become int32. The
+        explicit canonicalization keeps that mapping deliberate and
+        silent (CAS/swap semantics are width-independent here) instead
+        of a per-allocation truncation warning.
+        """
+        import jax
         import jax.numpy as jnp
+        import numpy as np
 
         from ..runtime.proc import spans_processes
 
+        dtype = jax.dtypes.canonicalize_dtype(np.dtype(dtype))
         n_blocks = self.comm.size
         if spans_processes(self.comm):
             # each controller allocates its LOCAL PEs' blocks; remote

@@ -418,8 +418,25 @@ class FabricEngine:
         n = 0
         # shm first: same-host frames are the latency-critical tier
         if self.shm is not None:
+            from ..btl.sm import ShmPullError
+
             while True:
-                got = self.shm.poll_recv()
+                try:
+                    got = self.shm.poll_recv()
+                except ShmPullError as exc:
+                    # A CMA rendezvous died under us (sender exited
+                    # mid-pull). That is a PEER failure, not a failure
+                    # of whatever request is pumping progress: raise
+                    # the event (ft/elastic routes it) and keep
+                    # draining the healthy traffic.
+                    from ..ft import events
+
+                    events.raise_event(
+                        events.EventClass.DEVICE_ERROR,
+                        transport="sm", detail=str(exc),
+                    )
+                    logger.warning("shm pull failure absorbed: %s", exc)
+                    continue
                 if got is None:
                     break
                 src_idx, tag, raw = got  # shm peers ARE process indices
