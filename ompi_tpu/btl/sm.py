@@ -20,6 +20,7 @@ peers here while inter-host peers stay on DCN.
 from __future__ import annotations
 
 import contextlib
+import ctypes
 import os
 import threading
 import time
@@ -103,6 +104,9 @@ def _declare(lib) -> None:
     lib.shm_connect.argtypes = [P, ctypes.c_int, ctypes.c_int]
     lib.shm_send.restype = LL
     lib.shm_send.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL]
+    lib.shm_send2.restype = LL
+    lib.shm_send2.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL,
+                              ctypes.c_void_p, LL]
     lib.shm_poll_recv.restype = LL
     lib.shm_poll_recv.argtypes = [
         P, ctypes.POINTER(ctypes.c_int), ctypes.POINTER(LL),
@@ -168,6 +172,19 @@ class ShmEndpoint:
         self._closed = False
         self.peers: set[int] = set()
 
+    def _begin(self, what: str) -> None:
+        """Hot-path guard entry (the contextmanager variant costs ~3 us
+        per call in generator machinery — real money at fastbox rates).
+        Pair with _end() in a finally block."""
+        with self._mu:
+            if self._closed:
+                raise ShmError(f"endpoint closed during {what}")
+            self._inflight += 1
+
+    def _end(self) -> None:
+        with self._mu:
+            self._inflight -= 1
+
     @contextlib.contextmanager
     def _native_call(self, *, what: str):
         with self._mu:
@@ -192,18 +209,50 @@ class ShmEndpoint:
             )
         self.peers.add(peer_rank)
 
-    def send_bytes(self, peer_rank: int, tag: int, data) -> int:
-        buf = np.ascontiguousarray(np.frombuffer(data, np.uint8))
-        with self._native_call(what="send"):
-            rc = self._lib.shm_send(
-                self._ctx, peer_rank, tag, buf.ctypes.data, buf.nbytes
-            )
+    @staticmethod
+    def _as_ptr(data):
+        """(address, nbytes, keepalive) for a bytes-like or array
+        source with NO copy: ctypes reads the object's buffer in
+        place (the engine's tiers never write through it)."""
+        if isinstance(data, bytes):
+            return (ctypes.cast(ctypes.c_char_p(data), ctypes.c_void_p),
+                    len(data), data)
+        arr = np.frombuffer(data, np.uint8)  # bytearray/memoryview/array
+        return arr.ctypes.data, arr.nbytes, arr
+
+    def _check_send_rc(self, rc, peer_rank: int, nbytes: int) -> int:
         if rc == -1:
             raise ShmError(f"send to unconnected shm peer {peer_rank}")
         if rc == -2:
             raise ShmError(f"shm peer {peer_rank} is dead")
-        SPC.record("sm_send_bytes", buf.nbytes)
-        return 0  # copy semantics: complete on return
+        SPC.record("sm_send_bytes", nbytes)
+        return 0  # copy/rendezvous semantics: complete on return
+
+    def send_bytes(self, peer_rank: int, tag: int, data) -> int:
+        ptr, n, keep = self._as_ptr(data)
+        self._begin("send")
+        try:
+            rc = self._lib.shm_send(self._ctx, peer_rank, tag, ptr, n)
+        finally:
+            self._end()
+        del keep
+        return self._check_send_rc(rc, peer_rank, n)
+
+    def send_bytes2(self, peer_rank: int, tag: int, hdr, payload) -> int:
+        """Framed send (header + payload) with no Python-side
+        concatenation: both buffers go to the engine as a gather pair;
+        the receiver sees ONE message of len(hdr)+len(payload) bytes."""
+        hp, hn, hkeep = self._as_ptr(hdr)
+        pp, pn, pkeep = self._as_ptr(payload)
+        self._begin("send2")
+        try:
+            rc = self._lib.shm_send2(
+                self._ctx, peer_rank, tag, hp, hn, pp, pn
+            )
+        finally:
+            self._end()
+        del hkeep, pkeep
+        return self._check_send_rc(rc, peer_rank, hn + pn)
 
     def poll_recv(self) -> Optional[tuple[int, int, Any]]:
         """One completed message as (peer, tag, payload) or None.
@@ -212,17 +261,14 @@ class ShmEndpoint:
         support len/slice/==/np.frombuffer. A failed CMA pull (sender
         vanished mid-rendezvous) raises ShmPullError — progress pumps
         convert it to a DEVICE_ERROR event and keep polling."""
-        import ctypes
-
         peer = ctypes.c_int(0)
         tag = ctypes.c_longlong(0)
         length = ctypes.c_longlong(0)
         # Only the closed-endpoint race (guard entry) maps to "no
         # message"; a _consume failure after the native side already
         # popped the message must propagate, not silently drop it.
-        guard = self._native_call(what="poll")
         try:
-            guard.__enter__()
+            self._begin("poll")
         except ShmError:
             return None  # closed
         try:
@@ -234,7 +280,7 @@ class ShmEndpoint:
                 return None
             return self._consume(msgid, peer, tag, length)
         finally:
-            guard.__exit__(None, None, None)
+            self._end()
 
     def _consume(self, msgid, peer, tag, length):
         buf = np.empty(max(1, length.value), np.uint8)
@@ -262,19 +308,20 @@ class ShmEndpoint:
     def _wait_msg(self, deadline, what):
         """Shared park-until-message loop; returns (msgid, peer, tag,
         length) ctypes cells, or raises ShmError on timeout."""
-        import ctypes
-
         peer = ctypes.c_int(0)
         tag = ctypes.c_longlong(0)
         length = ctypes.c_longlong(0)
         while True:
             remaining = deadline - time.monotonic()
             slice_ms = max(1, min(100, int(remaining * 1000)))
-            with self._native_call(what=what):
+            self._begin(what)
+            try:
                 msgid = self._lib.shm_wait_recv(
                     self._ctx, slice_ms, ctypes.byref(peer),
                     ctypes.byref(tag), ctypes.byref(length),
                 )
+            finally:
+                self._end()
             if msgid:
                 return msgid, peer, tag, length
             if time.monotonic() >= deadline:
@@ -480,11 +527,11 @@ class SmBtl(BtlComponent):
         import jax
 
         me = jax.process_index()
-        remote = [
-            p.process_index
-            for p in (comm.procs[src_rank], comm.procs[dst_rank])
-            if p.process_index != me
-        ]
+        indices = {comm.procs[src_rank].process_index,
+                   comm.procs[dst_rank].process_index}
+        if me not in indices:
+            return self.NAME  # not our pair: mechanism unobservable
+        remote = [idx for idx in indices if idx != me]
         if remote and all(eng.shm.peer_cma(idx) for idx in remote):
             return f"{self.NAME}/cma"
         return self.NAME

@@ -57,8 +57,15 @@ class CounterRegistry:
             return c
 
     def record(self, name: str, amount: float = 1) -> None:
+        # Hot path (several calls per message): skip the registry lock
+        # for the overwhelmingly-common already-registered case — dict
+        # get is atomic under the GIL, and a racing first registration
+        # just falls through to the locked counter() path.
         if self.enabled:
-            self.counter(name).add(amount)
+            c = self._counters.get(name)
+            if c is None:
+                c = self.counter(name)
+            c.add(amount)
 
     @contextmanager
     def timer(self, name: str) -> Iterator[None]:

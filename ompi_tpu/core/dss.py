@@ -62,7 +62,12 @@ def _pack_item(out: bytearray, v: Any) -> None:
         out += raw
     elif isinstance(v, np.ndarray):
         out.append(_T_NDARRAY)
-        dt = v.dtype.str.encode()
+        # Extension dtypes (bfloat16, float8_* from ml_dtypes) have
+        # dtype.str '<V2'-style void codes that do NOT round-trip; ship
+        # their NAME instead — np.dtype("bfloat16") resolves once
+        # ml_dtypes is registered (it is wherever jax is installed).
+        dt = (v.dtype.name if v.dtype.kind == "V"
+              else v.dtype.str).encode()
         out += struct.pack("<q", len(dt))
         out += dt
         out += struct.pack("<q", v.ndim)
@@ -122,7 +127,15 @@ def _unpack_item(r: _Reader) -> Any:
     if t == _T_BYTES:
         return r.take(r.i64())
     if t == _T_NDARRAY:
-        dt = np.dtype(r.take(r.i64()).decode())
+        name = r.take(r.i64()).decode()
+        try:
+            dt = np.dtype(name)
+        except TypeError:
+            # extension dtype name not registered with numpy directly:
+            # resolve through ml_dtypes (bfloat16, float8_* family)
+            import ml_dtypes
+
+            dt = np.dtype(getattr(ml_dtypes, name))
         ndim = r.i64()
         shape = tuple(r.i64() for _ in range(ndim))
         raw = r.take(r.i64())

@@ -127,9 +127,12 @@ struct ChunkHdr {
 
 struct CmaDesc {
   uint64_t sendid;
-  uint64_t total;
-  uint64_t addr;  // source buffer in the SENDER's address space
-  int64_t pid;    // sender pid (the receiver's SegHdr.pid is its own)
+  uint64_t total;  // len0 + len1
+  uint64_t addr0;  // source segments in the SENDER's address space —
+  uint64_t len0;   // two of them so a framed send (header + payload)
+  uint64_t addr1;  // needs no sender-side concatenation; the receiver
+                   // pulls both in ONE process_vm_readv (riov[2])
+  int64_t pid;     // sender pid (the receiver's SegHdr.pid is its own)
 };
 
 // Per-slot single-copy rendezvous state, written by the segment owner
@@ -251,11 +254,13 @@ struct Msg {
   // Pending single-copy pull: the payload still lives in the SENDER's
   // pages (it is parked on our ack); shm_read pulls it straight into
   // the consumer's buffer — the true single copy. cma_slot >= 0 marks
-  // a pending pull.
+  // a pending pull. Two source segments (header + payload gather).
   int cma_slot = -1;
   int64_t cma_pid = 0;
   uint64_t cma_sendid = 0;
-  uint64_t cma_addr = 0;
+  uint64_t cma_addr0 = 0;
+  uint64_t cma_len0 = 0;
+  uint64_t cma_addr1 = 0;
   uint64_t cma_total = 0;
 };
 
@@ -373,6 +378,28 @@ bool cma_pull(pid_t pid, uint64_t addr, char* dst, uint64_t total) {
   return true;
 }
 
+// Scatter-gather pull of up to two remote segments into one dst.
+bool cma_pull2(pid_t pid, uint64_t a0, uint64_t l0, uint64_t a1,
+               uint64_t l1, char* dst, uint64_t total) {
+  if (l0 + l1 != total) return false;
+  uint64_t off = 0;
+  while (off < total) {
+    iovec liov{dst + off, (size_t)(total - off)};
+    iovec riov[2];
+    int nr = 0;
+    if (off < l0) {
+      riov[nr++] = {(void*)(a0 + off), (size_t)(l0 - off)};
+      if (l1) riov[nr++] = {(void*)a1, (size_t)l1};
+    } else {
+      riov[nr++] = {(void*)(a1 + (off - l0)), (size_t)(total - off)};
+    }
+    ssize_t n = process_vm_readv(pid, &liov, 1, riov, nr, 0);
+    if (n <= 0) return false;
+    off += (uint64_t)n;
+  }
+  return true;
+}
+
 // Sweep every owned slot of our own segment: move complete messages to
 // the ready queue. Caller holds sweep_mu. Rings the drain bell when any
 // ring head advanced so a full-ring producer unparks immediately
@@ -454,7 +481,9 @@ void sweep_locked(Ctx* c) {
           m.cma_slot = slot;
           m.cma_pid = d.pid;
           m.cma_sendid = d.sendid;
-          m.cma_addr = d.addr;
+          m.cma_addr0 = d.addr0;
+          m.cma_len0 = d.len0;
+          m.cma_addr1 = d.addr1;
           m.cma_total = d.total;
           int64_t id = c->next_msgid++;
           c->msgs.emplace(id, m);
@@ -502,7 +531,9 @@ long long cma_complete(Ctx* c, Msg& m, void* dst) {
     target = own.p;
   }
   bool ok = target != nullptr &&
-            cma_pull((pid_t)m.cma_pid, m.cma_addr, target, m.cma_total);
+            cma_pull2((pid_t)m.cma_pid, m.cma_addr0, m.cma_len0,
+                      m.cma_addr1, m.cma_total - m.cma_len0, target,
+                      m.cma_total);
   cma_post(c, m.cma_slot, m.cma_sendid, ok);
   if (!ok) {
     buf_release(c, own);
@@ -681,6 +712,13 @@ int shm_connect(void* ctx, int peer_rank, int timeout_ms) {
                  && tries++ < 1000)
             sched_yield();
           if (s->magic.load(std::memory_order_acquire) == kMagic) {
+            // Layout version gate: v1<->v2 differ in SegHdr and slot
+            // geometry (CmaMeta prefix); attaching across versions
+            // would compute wrong offsets and corrupt the segment.
+            if (s->version != kVersion) {
+              munmap(base, (size_t)st.st_size);
+              return -1;
+            }
             seg = s;
             total = (size_t)st.st_size;
             break;
@@ -745,11 +783,12 @@ int shm_connect(void* ctx, int peer_rank, int timeout_ms) {
   return 0;
 }
 
-// Send a complete message (copy semantics: the caller's buffer is free
-// on return). Returns 0 on success, -1 unknown peer, -2 peer dead.
-long long shm_send(void* ctx, int peer_rank, long long tag,
-                   const void* buf, long long len) {
-  Ctx* c = static_cast<Ctx*>(ctx);
+// Two-buffer send core (a framed message = header + payload with no
+// sender-side concatenation). buf1/len1 may be null/0.
+// Returns 0 on success, -1 unknown peer, -2 peer dead.
+static long long send_iov2(Ctx* c, int peer_rank, long long tag,
+                           const void* buf0, uint64_t len0,
+                           const void* buf1, uint64_t len1) {
   PeerConn* p;
   {
     std::lock_guard<std::mutex> g(c->conn_mu);
@@ -758,15 +797,15 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
     p = it->second;
   }
   if (p->seg->dead.load(std::memory_order_acquire)) return -2;
-  uint64_t n = (uint64_t)len;
+  uint64_t n = len0 + len1;
   // Tier 1: fastbox (reference: <=25% of the 4 KiB box)
   if (n <= c->fbox_msg_limit) {
     std::lock_guard<std::mutex> g(p->mu);
-    if (ring_push(slot_fbox(p->seg, p->slot), (uint64_t)tag, kEager, buf,
-                  n, nullptr, 0)) {
+    if (ring_push(slot_fbox(p->seg, p->slot), (uint64_t)tag, kEager,
+                  buf0, len0, buf1, len1)) {
       ring_doorbell(p->seg);
       c->fbox_sends.fetch_add(1, std::memory_order_relaxed);
-      c->bytes_sent.fetch_add(len, std::memory_order_relaxed);
+      c->bytes_sent.fetch_add((int64_t)n, std::memory_order_relaxed);
       return 0;
     }
     // fastbox full: fall through to the eager ring (reference does the
@@ -775,15 +814,15 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
   RingHdr* ring = slot_ring(p->seg, p->slot);
   // Tier 2: whole message inline on the eager ring
   if (n <= c->eager_limit) {
-    if (!push_progress(c, p, ring, (uint64_t)tag, kEager, buf, n, nullptr,
-                       0))
+    if (!push_progress(c, p, ring, (uint64_t)tag, kEager, buf0, len0,
+                       buf1, len1))
       return -2;
     c->ring_sends.fetch_add(1, std::memory_order_relaxed);
-    c->bytes_sent.fetch_add(len, std::memory_order_relaxed);
+    c->bytes_sent.fetch_add((int64_t)n, std::memory_order_relaxed);
     return 0;
   }
   // Tier 3a: single-copy pull (CMA). Publish ONE descriptor, park
-  // until the receiver's pull lands (our buffer must stay valid), and
+  // until the receiver's pull lands (our buffers must stay valid), and
   // sweep our own inbox while parked so opposing CMA streams pull each
   // other through. Serialized per slot: the per-slot ack/err counters
   // track exactly one outstanding sendid.
@@ -794,7 +833,8 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
       std::lock_guard<std::mutex> g(p->mu);
       sendid = p->next_sendid++;
     }
-    CmaDesc d{sendid, n, (uint64_t)buf, (int64_t)getpid()};
+    CmaDesc d{sendid, n, (uint64_t)buf0, len0, (uint64_t)buf1,
+              (int64_t)getpid()};
     if (!push_progress(c, p, ring, (uint64_t)tag, kCmaDesc, &d, sizeof(d),
                        nullptr, 0))
       return -2;
@@ -828,7 +868,7 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
     }
     if (pulled) {
       c->cma_sends.fetch_add(1, std::memory_order_relaxed);
-      c->bytes_sent.fetch_add(len, std::memory_order_relaxed);
+      c->bytes_sent.fetch_add((int64_t)n, std::memory_order_relaxed);
       return 0;
     }
     // Receiver could not pull (ptrace scope, policy change): disable
@@ -839,6 +879,8 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
   }
   // Tier 3b: chunk-stream bulk payloads through the eager ring. Chunk
   // size: a quarter ring so the receiver overlaps drain with our copy.
+  // Chunks carry absolute offsets into the LOGICAL message, walking
+  // buf0 then buf1.
   uint64_t chunk = p->seg->ring_size / 4;
   if (chunk > (4u << 20)) chunk = 4u << 20;
   uint64_t sendid;
@@ -846,16 +888,44 @@ long long shm_send(void* ctx, int peer_rank, long long tag,
     std::lock_guard<std::mutex> g(p->mu);
     sendid = p->next_sendid++;
   }
-  for (uint64_t off = 0; off < n; off += chunk) {
+  for (uint64_t off = 0; off < n;) {
     uint64_t this_len = std::min(chunk, n - off);
+    // clamp to the buffer the offset falls in (a chunk never
+    // straddles); off advances by the CLAMPED length
+    const char* src;
+    if (off < len0) {
+      this_len = std::min(this_len, len0 - off);
+      src = (const char*)buf0 + off;
+    } else {
+      src = (const char*)buf1 + (off - len0);
+    }
     ChunkHdr ch{sendid, n, off};
     if (!push_progress(c, p, ring, (uint64_t)tag, kChunk, &ch, sizeof(ch),
-                       (const char*)buf + off, this_len))
+                       src, this_len))
       return -2;
+    off += this_len;
   }
   c->chunk_msgs.fetch_add(1, std::memory_order_relaxed);
-  c->bytes_sent.fetch_add(len, std::memory_order_relaxed);
+  c->bytes_sent.fetch_add((int64_t)n, std::memory_order_relaxed);
   return 0;
+}
+
+// Send a complete message (copy semantics: the caller's buffer is free
+// on return). Returns 0 on success, -1 unknown peer, -2 peer dead.
+long long shm_send(void* ctx, int peer_rank, long long tag,
+                   const void* buf, long long len) {
+  return send_iov2(static_cast<Ctx*>(ctx), peer_rank, tag, buf,
+                   (uint64_t)len, nullptr, 0);
+}
+
+// Framed send: header + payload as separate source buffers (no
+// sender-side concatenation on any tier; the CMA descriptor carries
+// both segments and the receiver gathers them in one pull).
+long long shm_send2(void* ctx, int peer_rank, long long tag,
+                    const void* hdr, long long hlen, const void* pay,
+                    long long plen) {
+  return send_iov2(static_cast<Ctx*>(ctx), peer_rank, tag, hdr,
+                   (uint64_t)hlen, pay, (uint64_t)plen);
 }
 
 // One completed message, or 0. Out-params mirror dcn_poll_recv.

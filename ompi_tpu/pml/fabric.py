@@ -44,7 +44,8 @@ from typing import Any, Optional
 
 import numpy as np
 
-from ..core import config, dss
+from ..btl.sm import ShmPullError
+from ..core import config, dss, peruse
 from ..core import progress as _progress
 from ..core.counters import SPC
 from ..core.errors import CommError, OmpiTpuError
@@ -86,9 +87,15 @@ _timeout_var = config.register(
     description="Blocking wait/probe timeout for cross-process p2p",
 )
 _fastbox_var = config.register(
-    "pml", "fabric", "fastbox", type=int, default=4096,
+    "pml", "fabric", "fastbox", type=int, default=64 * 1024,
     description="Largest single-array payload sent via the fixed-header "
-                "fast path (reference: btl/sm 4 KiB fastbox)",
+                "fast frame — the WHOLE eager tier for array payloads; "
+                "dss serialization is reserved for pytree payloads and "
+                "control-plane messages (reference: ob1 puts the full "
+                "envelope in fixed MATCH headers at every size, "
+                "pml_ob1_hdr.h:43-51). NOTE on CPU destinations with "
+                "pml_fabric_strict_placement=False these messages "
+                "deliver as writable host ndarrays, not jax.Arrays.",
 )
 _segment_var = config.register(
     "pml", "fabric", "pipeline_segment", type=int, default=1 << 20,
@@ -121,23 +128,47 @@ _FAST_MAX_DIMS = 6
 _DATA_HDR = struct.Struct("<Iiiiiqqqii")
 _DATA_MAGIC = 0x4FA57B0D
 
+#: ob1's envelope type, bound on first arrival (fabric and ob1 import
+#: each other lazily; a module-level import would order-couple them)
+_Envelope = None
+
+
+def _is_plain_array(value) -> bool:
+    """A single numeric array/scalar whose dtype round-trips through
+    dtype.str (extension dtypes like bfloat16 do not — they take the
+    dss path)."""
+    if not (isinstance(value, (np.ndarray, np.generic))
+            or (hasattr(value, "devices") and hasattr(value, "dtype"))):
+        return False
+    try:
+        return np.dtype(value.dtype).kind in "biufc"
+    except TypeError:
+        return False
+
 
 def _fast_eligible(value, limit: int):
     """A single contiguous numeric array/scalar small enough for the
-    fastbox: returns the host ndarray or None."""
-    if not (isinstance(value, (np.ndarray, np.generic))
-            or (hasattr(value, "devices") and hasattr(value, "dtype"))):
-        return None
+    fast fixed-header frame: returns the host ndarray or None."""
     # size/shape/dtype are metadata — reject BEFORE any device readback
     # so large rendezvous sends don't pay a D2H just to be turned away
-    if (getattr(value, "nbytes", limit + 1) > limit
-            or getattr(value, "ndim", _FAST_MAX_DIMS + 1) > _FAST_MAX_DIMS
-            or np.dtype(value.dtype).kind not in "biufc"):
-        # extension dtypes (bfloat16 etc.) don't round-trip through
-        # dtype.str — they take the dss path
+    if (not _is_plain_array(value)
+            or getattr(value, "nbytes", limit + 1) > limit
+            or getattr(value, "ndim", _FAST_MAX_DIMS + 1)
+            > _FAST_MAX_DIMS):
         return None
-    arr = np.asarray(value)  # host readback only for fastbox-sized data
-    return np.ascontiguousarray(arr)
+    arr = np.asarray(value)  # host readback only for fast-tier data
+    # ascontiguousarray PROMOTES 0-d to 1-d — preserve scalar shape
+    # (a 0-d array is trivially contiguous)
+    return arr if arr.ndim == 0 else np.ascontiguousarray(arr)
+
+
+def _rndv_meta(value):
+    """(dtype_str, shape) when a rendezvous payload can ship as raw
+    array bytes with the metadata riding the RTS — else None and the
+    payload dss-packs (pytrees, extension dtypes)."""
+    if not _is_plain_array(value):
+        return None
+    return (np.dtype(value.dtype).str, tuple(int(s) for s in value.shape))
 
 
 
@@ -309,6 +340,27 @@ class FabricEngine:
         self.ep.check_peer(pid, what=f"process {dst_idx}")
         self.ep.send_bytes(pid, dcn_tag, raw)
 
+    def _send_framed(self, dst_idx: int, dcn_tag: int, hdr: bytes,
+                     payload) -> None:
+        """Header + payload as one wire message. Over shm the pair goes
+        as a gather (no concatenation on any tier — the CMA descriptor
+        carries both source segments); DCN joins them host-side."""
+        if self.shm is not None and dst_idx in self.shm_peers:
+            self.shm.send_bytes2(dst_idx, dcn_tag, hdr, payload)
+            SPC.record("fabric_sm_sends")
+            return
+        self._send_raw(dst_idx, dcn_tag, hdr + bytes(payload))
+
+    def _seg_size(self, dst_idx: int, nbytes: int) -> int:
+        """Rendezvous segment size per transport: shm ships the whole
+        payload as ONE segment (a single CMA pull straight into the
+        landing frame — splitting only adds rendezvous round-trips);
+        DCN keeps the pipelined segments that overlap the striped TCP
+        links."""
+        if self.shm is not None and dst_idx in self.shm_peers:
+            return max(1, nbytes)
+        return max(1, int(_segment_var.value))
+
     def _send(self, dst_idx: int, msg: dict) -> None:
         self._send_raw(dst_idx, P2P_TAG, dss.pack(msg))
 
@@ -323,8 +375,6 @@ class FabricEngine:
         nbytes = _nbytes_of(value)
         env = _Envelope(src=src, dst=dst, tag=tag, nbytes=nbytes)
         req = SendRequest(env)
-        from ..core import peruse
-
         peruse.fire(peruse.PeruseEvent.REQ_ACTIVATE, request=req,
                     kind="send")
         with self._lock:
@@ -355,6 +405,13 @@ class FabricEngine:
             req._mark_sent(value)
         else:
             head["k"] = K_RTS
+            # Single-array payloads advertise (dtype, shape) in the RTS
+            # so DATA segments ship raw array bytes — no dss pack on
+            # either side (the envelope-in-header design; reference:
+            # ob1 RNDV carries the match header + size only)
+            meta = _rndv_meta(value)
+            if meta is not None:
+                head["am"] = meta
             with self._lock:
                 self._rndv_out[(dst_idx, comm.cid, seq)] = (value, req)
             self._send(dst_idx, head)
@@ -418,23 +475,18 @@ class FabricEngine:
         n = 0
         # shm first: same-host frames are the latency-critical tier
         if self.shm is not None:
-            from ..btl.sm import ShmPullError
-
             while True:
                 try:
                     got = self.shm.poll_recv()
                 except ShmPullError as exc:
-                    # A CMA rendezvous died under us (sender exited
-                    # mid-pull). That is a PEER failure, not a failure
-                    # of whatever request is pumping progress: raise
-                    # the event (ft/elastic routes it) and keep
-                    # draining the healthy traffic.
-                    from ..ft import events
-
-                    events.raise_event(
-                        events.EventClass.DEVICE_ERROR,
-                        transport="sm", detail=str(exc),
-                    )
+                    # A CMA rendezvous failed under us. If the sender
+                    # is alive (ptrace denial) it re-delivers the same
+                    # payload via the chunk tier, so this is NOT a
+                    # peer-failure event — broadcasting one would trip
+                    # every comm's errhandler for a self-healing
+                    # condition. A genuinely dead sender is caught by
+                    # the liveness probes (peer_alive / watch paths).
+                    SPC.record("fabric_sm_pull_failures")
                     logger.warning("shm pull failure absorbed: %s", exc)
                     continue
                 if got is None:
@@ -538,8 +590,11 @@ class FabricEngine:
                 self._expect[key] = expect + 1
 
     def _match_arrival(self, comm, src_idx: int, msg: dict) -> None:
-        from .ob1 import _Envelope
+        global _Envelope
+        if _Envelope is None:
+            from .ob1 import _Envelope as _E
 
+            _Envelope = _E
         env = _Envelope(
             src=msg["src"], dst=msg["dst"], tag=msg["tag"],
             nbytes=msg["nb"],
@@ -547,7 +602,7 @@ class FabricEngine:
         payload = msg.get("pay") if msg["k"] == K_EAGER else None
         self._pml._remote_arrival(
             comm, env, fabric=self, src_idx=src_idx, seq=msg["seq"],
-            payload_bytes=payload,
+            payload_bytes=payload, array_meta=msg.get("am"),
         )
 
     def request_payload(self, pending, req) -> None:
@@ -555,9 +610,12 @@ class FabricEngine:
         when DATA lands (ob1: the ACK that schedules the sender's
         FRAG pipeline)."""
         env = pending.env
+        state = {}
+        if pending.array_meta is not None:
+            state["am"] = pending.array_meta
         with self._lock:
             self._await_data[(pending.src_idx, pending.comm_cid,
-                              pending.seq)] = (req, pending, {})
+                              pending.seq)] = (req, pending, state)
         req.block_on_progress = True
         self._send(pending.src_idx, {
             "k": K_CTS, "cid": pending.comm_cid, "seq": pending.seq,
@@ -597,18 +655,25 @@ class FabricEngine:
         # Raw binary frames (fixed header + payload slice) — the dss
         # dict-per-segment path cost two extra full-payload copies plus
         # per-segment parse work on the receiver.
-        raw = pack_value(value)
-        view = memoryview(raw)
-        seg = max(1, int(_segment_var.value))
-        n_seg = max(1, -(-len(raw) // seg))
+        # Single-array payloads (the RTS advertised dtype/shape) slice
+        # straight out of the array's memory: no dss pack, no staging
+        # copy at all.
+        if _rndv_meta(value) is not None:
+            arr = np.ascontiguousarray(np.asarray(value))
+            view = memoryview(arr).cast("B")
+        else:
+            view = memoryview(pack_value(value))
+        total = len(view)
+        seg = self._seg_size(src_idx, total)
+        n_seg = max(1, -(-total // seg))
         for si in range(n_seg):
             off = si * seg
-            frame = bytearray(_DATA_HDR.pack(
+            hdr = _DATA_HDR.pack(
                 _DATA_MAGIC, msg["cid"], msg["src"], msg["dst"],
-                msg["tag"], msg["seq"], len(raw), off, n_seg, si,
-            ))
-            frame += view[off:off + seg]  # single payload copy
-            self._send_raw(src_idx, P2P_DATA_TAG, frame)
+                msg["tag"], msg["seq"], total, off, n_seg, si,
+            )
+            self._send_framed(src_idx, P2P_DATA_TAG, hdr,
+                              view[off:off + seg])
             SPC.record("fabric_data_segments_sent")
 
     def _on_data(self, src_idx: int, msg: dict) -> None:
@@ -682,11 +747,25 @@ class FabricEngine:
                     f"mixed DATA framing for one message (cid={cid} "
                     f"seq={seq})"
                 )
+            whole = None
+            if (state.get("buf") is None and off == 0
+                    and len(raw) - _DATA_HDR.size == rawlen):
+                # Whole message in one segment (the shm path: a single
+                # CMA pull landed it in this frame's exclusively-owned
+                # buffer): complete straight from the frame view — no
+                # assembly buffer, no copy.
+                self._await_data.pop(key, None)
+                whole = memoryview(raw)[_DATA_HDR.size:]
+                SPC.record("fabric_data_segments_recvd")
             buf = state.get("buf")
-            if buf is None:
+            if whole is None and buf is None:
                 buf = state["buf"] = bytearray(rawlen)
                 state["seen"] = {}  # off -> payload length written
                 state["bytes"] = 0
+        if whole is not None:
+            self._deliver_data(req, pending, state, whole)
+            return
+        with self._lock:
             # Wire-derived fields are untrusted: rawlen is pinned by
             # the FIRST frame of the message (a forged larger value on
             # a later frame would defeat the bounds check below), and
@@ -736,8 +815,23 @@ class FabricEngine:
                     f"{len(buf)}, cid={cid} seq={seq})"
                 )
             self._await_data.pop(key, None)
-        value = unpack_value(bytes(buf),
-                             device=pending.dst_proc.device)
+        self._deliver_data(req, pending, state, buf)
+
+    def _deliver_data(self, req, pending, state, payload) -> None:
+        """Complete a rendezvous recv from its assembled payload bytes.
+        RTS-advertised array metadata means the bytes ARE the array:
+        reconstruct by view — no dss parse, no pre-placement copy."""
+        import jax
+
+        meta = state.get("am")
+        if meta is not None:
+            dtype_s, shape = meta
+            arr = np.frombuffer(payload, np.dtype(dtype_s))
+            arr = arr.reshape(tuple(shape))
+            value = jax.device_put(arr, pending.dst_proc.device)
+        else:
+            value = unpack_value(bytes(payload),
+                                 device=pending.dst_proc.device)
         req._matched(pending.env, value)
         SPC.record("fabric_rndv_delivered")
 

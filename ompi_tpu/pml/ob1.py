@@ -30,6 +30,7 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Optional
 
+from ..core import peruse
 from ..core.counters import SPC
 from ..core.errors import CommError, RankError, RequestError, TagError
 from ..core.request import ANY_SOURCE, ANY_TAG, Request, Status
@@ -147,6 +148,7 @@ class _PendingSend:
     seq: int = -1      # fabric stream sequence number
     payload_bytes: Any = None  # packed eager payload (unpacked at match)
     comm_cid: int = -1
+    array_meta: Any = None  # (dtype_str, shape) for raw-array rendezvous
 
 
 class _CommP2P:
@@ -363,8 +365,6 @@ class Ob1Pml(PmlComponent):
             req.block_on_progress = True
         st = self._state(comm)
         SPC.record("pml_irecv_calls")
-        from ..core import peruse
-
         peruse.fire(peruse.PeruseEvent.REQ_ACTIVATE, request=req,
                     kind="recv")
         with self._mu:
@@ -397,8 +397,6 @@ class Ob1Pml(PmlComponent):
         return True
 
     def _deliver(self, pending: _PendingSend, req: RecvRequest) -> None:
-        from ..core import peruse
-
         peruse.fire(
             peruse.PeruseEvent.REQ_MATCH,
             env=pending.env, recv=req,
@@ -431,7 +429,8 @@ class Ob1Pml(PmlComponent):
         req._matched(pending.env, pending.transferred)
 
     def _remote_arrival(self, comm, env: _Envelope, *, fabric, src_idx: int,
-                        seq: int, payload_bytes) -> None:
+                        seq: int, payload_bytes,
+                        array_meta=None) -> None:
         """An MPI envelope arrived from another controller (called by
         fabric.progress in stream order): run receive-side matching
         exactly as the reference does on the target process
@@ -444,10 +443,9 @@ class Ob1Pml(PmlComponent):
             src_proc=comm.procs[env.src], dst_proc=comm.procs[env.dst],
             btl=None, remote=True, fabric=fabric, src_idx=src_idx,
             seq=seq, payload_bytes=payload_bytes, comm_cid=comm.cid,
+            array_meta=array_meta,
         )
         SPC.record("pml_remote_arrivals")
-        from ..core import peruse
-
         with self._mu:
             if not self._match_posted(st, pending):
                 st.unexpected.append(pending)

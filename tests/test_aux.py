@@ -41,6 +41,22 @@ def test_dss_ndarray():
     assert got.dtype == arr.dtype
 
 
+def test_dss_extension_dtypes_roundtrip():
+    """bfloat16 / float8 arrays must keep their dtype across the wire:
+    dtype.str for ml_dtypes extension types is a void code ('<V2') that
+    numpy resolves to raw bytes, silently losing the type (regression:
+    cross-process bf16 payloads arrived as |V2 and jax.device_put
+    rejected them)."""
+    import ml_dtypes
+
+    for dt in (ml_dtypes.bfloat16, ml_dtypes.float8_e4m3fn):
+        arr = np.ones((5,), dt)
+        (got,) = dss.unpack(dss.pack(arr))
+        assert got.dtype == arr.dtype
+        np.testing.assert_array_equal(
+            got.astype(np.float32), arr.astype(np.float32))
+
+
 def test_dss_rejects_garbage():
     with pytest.raises(dss.DssError):
         dss.unpack(b"not a dss buffer")
