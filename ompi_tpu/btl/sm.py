@@ -133,7 +133,97 @@ def _declare(lib) -> None:
     lib.shm_peer_cma.argtypes = [P, ctypes.c_int]
     lib.shm_destroy.restype = None
     lib.shm_destroy.argtypes = [P]
+    lib.cma_read.restype = ctypes.c_int
+    lib.cma_read.argtypes = [LL, ctypes.c_ulonglong, ctypes.c_void_p, LL]
+    lib.cma_write.restype = ctypes.c_int
+    lib.cma_write.argtypes = [LL, ctypes.c_ulonglong, ctypes.c_void_p,
+                              LL]
+    lib.winseg_open.restype = P
+    lib.winseg_open.argtypes = [ctypes.c_char_p, LL, ctypes.c_int]
+    lib.winseg_close.restype = None
+    lib.winseg_close.argtypes = [P, LL, ctypes.c_char_p, ctypes.c_int]
+    lib.winseg_cas.restype = ctypes.c_int
+    lib.winseg_cas.argtypes = [P, LL, ctypes.c_int, ctypes.c_int]
+    lib.winseg_load.restype = ctypes.c_int
+    lib.winseg_load.argtypes = [P, LL]
+    lib.winseg_store.restype = None
+    lib.winseg_store.argtypes = [P, LL, ctypes.c_int]
+    lib.winseg_add.restype = ctypes.c_int
+    lib.winseg_add.argtypes = [P, LL, ctypes.c_int]
+    lib.winseg_wait.restype = ctypes.c_int
+    lib.winseg_wait.argtypes = [P, LL, ctypes.c_int, ctypes.c_int]
+    lib.winseg_wake.restype = None
+    lib.winseg_wake.argtypes = [P, LL]
     lib._shm_declared = True
+
+
+class WinSyncSeg:
+    """Shared 32-bit word array for one RMA window's same-host sync:
+    word 0 is a modification counter, words 1..n per-rank
+    readers-writer lock words (0 free, -1 exclusive, k>0 shared) —
+    the osc/sm passive-target state, CPU atomics + futex parking
+    (reference: osc_sm_passive_target.c)."""
+
+    def __init__(self, name: str, n_words: int, create: bool) -> None:
+        lib = build.get_lib()
+        if lib is None or not hasattr(lib, "winseg_open"):
+            raise ShmError("native winseg unavailable")
+        _declare(lib)
+        self._lib = lib
+        self.name = name
+        self.n_words = n_words
+        self.creator = create
+        self._base = lib.winseg_open(name.encode(), n_words,
+                                     int(create))
+        if not self._base:
+            raise ShmError(f"cannot {'create' if create else 'attach'} "
+                           f"window sync segment {name}")
+
+    def cas(self, idx: int, expect: int, desired: int) -> int:
+        return self._lib.winseg_cas(self._base, idx, expect, desired)
+
+    def load(self, idx: int) -> int:
+        return self._lib.winseg_load(self._base, idx)
+
+    def store(self, idx: int, value: int) -> None:
+        self._lib.winseg_store(self._base, idx, value)
+
+    def add(self, idx: int, delta: int) -> int:
+        return self._lib.winseg_add(self._base, idx, delta)
+
+    def wait(self, idx: int, while_value: int, timeout_ms: int) -> int:
+        return self._lib.winseg_wait(self._base, idx, while_value,
+                                     timeout_ms)
+
+    def wake(self, idx: int) -> None:
+        self._lib.winseg_wake(self._base, idx)
+
+    def close(self) -> None:
+        if self._base:
+            self._lib.winseg_close(self._base, self.n_words,
+                                   self.name.encode(),
+                                   int(self.creator))
+            self._base = None
+
+
+def cma_read_into(pid: int, addr: int, arr: np.ndarray) -> None:
+    """Pull arr.nbytes from (pid, addr) into `arr` (contiguous) — the
+    osc/sm direct-get data plane."""
+    lib = build.get_lib()
+    _declare(lib)
+    rc = lib.cma_read(pid, addr, arr.ctypes.data, arr.nbytes)
+    if rc != 0:
+        raise ShmError(f"cma_read from pid {pid} failed")
+
+
+def cma_write_from(pid: int, addr: int, arr: np.ndarray) -> None:
+    """Push `arr` (contiguous) into (pid, addr) — the osc/sm direct-put
+    data plane."""
+    lib = build.get_lib()
+    _declare(lib)
+    rc = lib.cma_write(pid, addr, arr.ctypes.data, arr.nbytes)
+    if rc != 0:
+        raise ShmError(f"cma_write to pid {pid} failed")
 
 
 _STAT_NAMES = (

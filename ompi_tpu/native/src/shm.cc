@@ -682,6 +682,124 @@ void* shm_create(const char* prefix, int my_rank, int max_peers,
   return c;
 }
 
+// Standalone cross-memory transfers for the osc/sm direct data plane
+// (window host mirrors): plain process_vm_readv/writev against a
+// published {pid, addr} — the reference's osc/sm load/store path done
+// with CMA instead of a shared mapping (the window memory itself stays
+// process-private; only epoch-coherent mirrors are exposed).
+// Return 0 on success, -1 on failure (ptrace scope, peer exit).
+int cma_read(long long pid, unsigned long long addr, void* dst,
+             long long len) {
+  return cma_pull((pid_t)pid, (uint64_t)addr, (char*)dst, (uint64_t)len)
+             ? 0
+             : -1;
+}
+
+int cma_write(long long pid, unsigned long long addr, const void* src,
+              long long len) {
+  uint64_t off = 0, total = (uint64_t)len;
+  while (off < total) {
+    iovec liov{(void*)((const char*)src + off), (size_t)(total - off)};
+    iovec riov{(void*)(addr + off), (size_t)(total - off)};
+    ssize_t n = process_vm_writev((pid_t)pid, &liov, 1, &riov, 1, 0);
+    if (n <= 0) return -1;
+    off += (uint64_t)n;
+  }
+  return 0;
+}
+
+// ---- window sync segment (osc/sm lock words) -------------------------------
+// A tiny POSIX shm segment of 32-bit words shared by every same-host
+// controller of one RMA window: word 0 is a modification counter,
+// words 1..n are per-rank readers-writer lock words (0 free, -1
+// exclusive, k>0 shared holders) manipulated with CPU atomics + futex
+// parking — the reference's osc/sm passive-target design
+// (osc_sm_passive_target.c: lock state lives in the shared segment,
+// not in messages).
+
+int32_t* winseg_open(const char* name, long long n_words, int create) {
+  size_t bytes = sizeof(std::atomic<int32_t>) * (size_t)n_words;
+  int fd = -1;
+  if (create) {
+    shm_unlink(name);
+    fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    if (ftruncate(fd, (off_t)bytes) != 0) {
+      close(fd);
+      shm_unlink(name);
+      return nullptr;
+    }
+  } else {
+    // attach: the creator may not have created it yet — bounded retry
+    for (int tries = 0; tries < 5000; ++tries) {
+      fd = shm_open(name, O_RDWR, 0600);
+      if (fd >= 0) {
+        struct stat st;
+        if (fstat(fd, &st) == 0 && (size_t)st.st_size >= bytes) break;
+        close(fd);
+        fd = -1;
+      }
+      timespec ts{0, 2000000};  // 2 ms
+      nanosleep(&ts, nullptr);
+    }
+    if (fd < 0) return nullptr;
+  }
+  void* base =
+      mmap(nullptr, bytes, PROT_READ | PROT_WRITE, MAP_SHARED, fd, 0);
+  close(fd);
+  if (base == MAP_FAILED) return nullptr;
+  return reinterpret_cast<int32_t*>(base);
+}
+
+void winseg_close(int32_t* base, long long n_words, const char* name,
+                  int unlink) {
+  if (base)
+    munmap(base, sizeof(std::atomic<int32_t>) * (size_t)n_words);
+  if (unlink) shm_unlink(name);
+}
+
+static std::atomic<int32_t>* winseg_word(int32_t* base, long long idx) {
+  return reinterpret_cast<std::atomic<int32_t>*>(base) + idx;
+}
+
+// Atomic CAS on word idx; returns the PREVIOUS value.
+int winseg_cas(int32_t* base, long long idx, int expect, int desired) {
+  int32_t e = expect;
+  winseg_word(base, idx)->compare_exchange_strong(
+      e, desired, std::memory_order_acq_rel);
+  return e;
+}
+
+int winseg_load(int32_t* base, long long idx) {
+  return winseg_word(base, idx)->load(std::memory_order_acquire);
+}
+
+void winseg_store(int32_t* base, long long idx, int value) {
+  winseg_word(base, idx)->store(value, std::memory_order_release);
+}
+
+int winseg_add(int32_t* base, long long idx, int delta) {
+  return winseg_word(base, idx)->fetch_add(delta,
+                                           std::memory_order_acq_rel) +
+         delta;
+}
+
+// Park while word idx still holds `while_value` (futex compare
+// semantics), up to timeout_ms. Returns the current value.
+int winseg_wait(int32_t* base, long long idx, int while_value,
+                int timeout_ms) {
+  auto* w = winseg_word(base, idx);
+  if (w->load(std::memory_order_acquire) == while_value)
+    futex_wait(reinterpret_cast<std::atomic<uint32_t>*>(w),
+               (uint32_t)while_value, timeout_ms);
+  return w->load(std::memory_order_acquire);
+}
+
+void winseg_wake(int32_t* base, long long idx) {
+  futex_wake_all(
+      reinterpret_cast<std::atomic<uint32_t>*>(winseg_word(base, idx)));
+}
+
 // Map the peer's segment and claim a sender slot. Retries until the
 // peer's segment exists (bounded by timeout_ms). Returns 0, or -1.
 int shm_connect(void* ctx, int peer_rank, int timeout_ms) {

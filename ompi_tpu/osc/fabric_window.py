@@ -60,6 +60,7 @@ _T_REPLY = 1   # per-batch reply: get/fetch results + application ack
 _T_LOCK = 2    # lock request
 _T_GRANT = 3   # lock grant
 _T_POST = 4    # PSCW exposure-epoch notification (post -> origins)
+_T_XCHG = 5    # osc/sm direct-mode mirror exchange at creation
 
 def _enc_index(idx) -> Any:
     """dss-able encoding of a window index (None | int | slice |
@@ -143,14 +144,42 @@ class FabricWindow:
         self._pscw_posted = False
         self._held: list = []  # future-epoch messages
         self._in_handler = False
+        self._in_close = False
+        self._arming = False
         self._freed = False
+        # osc/sm direct data plane (reference: osc/sm maps the window
+        # into every same-node process and does loads/stores,
+        # osc_sm_component.c / osc_sm_passive_target.c:269). Here each
+        # controller exposes a HOST MIRROR of its local blocks; when
+        # every peer controller is same-host with CMA reach, put/get
+        # against contiguous spans go straight at the target's mirror
+        # with process_vm_writev/readv — no op batch, no reply round.
+        # Accumulates/cswaps stay on the active-message path (the
+        # target controller applies them, giving element-atomicity),
+        # but apply to the mirror. The device array re-lands lazily at
+        # epoch boundaries (.array materializes it on demand).
+        self._direct = False
+        self._mirror: Optional[np.ndarray] = None
+        self._mirror_dirty = False
+        self._peer_mirrors: dict[int, tuple[int, int]] = {}
+        self._slice_ranks: dict[int, list[int]] = {}
         _progress.register(self._handle_arrivals)
+        self._try_direct_mode()
 
     # -- accessors ---------------------------------------------------------
 
     @property
     def array(self):
-        """This controller's local blocks (rank-major over local ranks)."""
+        """This controller's local blocks (rank-major over local
+        ranks). Direct mode re-lands the host mirror onto the local
+        devices lazily — once per epoch with remote writes, not per
+        access."""
+        if self._direct:
+            mod = self._winseg.load(0)
+            if self._mirror_dirty or mod != self._seen_mod:
+                self._inner._set_array(self._mirror)
+                self._mirror_dirty = False
+                self._seen_mod = mod
         return self._inner.array
 
     @property
@@ -160,6 +189,10 @@ class FabricWindow:
     def _set_array(self, arr) -> None:
         """Replace this controller's LOCAL blocks (SHMEM collectives
         deliver local rank-major results on spanning comms)."""
+        if self._direct:
+            np.copyto(self._mirror, np.asarray(arr))
+            self._mirror_dirty = True
+            return
         self._inner._set_array(arr)
 
     def _local_idx_or_raise(self, pe: int) -> int:
@@ -207,6 +240,180 @@ class FabricWindow:
                     f"start() group {self._pscw_targets}"
                 )
 
+    # -- osc/sm direct data plane ------------------------------------------
+
+    def _try_direct_mode(self) -> None:
+        """Collective capability exchange: direct mode arms only when
+        EVERY controller sees every peer over shm with CMA (the
+        reference's osc/sm selects only for single-node comms,
+        osc_sm_component.c query)."""
+        self._arming = True
+        try:
+            self._try_direct_mode_inner()
+        finally:
+            self._arming = False
+            self._release_held()
+
+    def _try_direct_mode_inner(self) -> None:
+        import os
+
+        from ..pml.framework import PML
+
+        try:
+            eng = getattr(PML.component("ob1"), "_fabric", None)
+        except Exception:
+            return
+        peers = [s for s in range(self.h.n_slices)
+                 if s != self.h.slice_id]
+        leader_idx = {
+            s: self.comm.procs[self._leader(s)].process_index
+            for s in peers
+        }
+        if (eng is None or eng.shm is None
+                or not all(idx in eng.shm_peers
+                           for idx in leader_idx.values())):
+            return  # not same-host-complete: no exchange (symmetric
+                    # knowledge — shm_peers comes from the modex)
+        from ..btl.sm import ShmError, WinSyncSeg
+
+        my_ok = all(eng.shm.peer_cma(idx)
+                    for idx in leader_idx.values())
+        # Lock-word segment (word 0 = modification counter, 1..size =
+        # per-rank rw-lock words; reference: osc_sm_passive_target.c).
+        # The CREATOR builds it BEFORE phase 1, so by the time any
+        # attacher acts, a stale same-name segment from a crashed run
+        # has already been unlinked and replaced — attach can never
+        # land on the old one.
+        seg_name = (f"/{eng.shm.prefix}_w{self.comm.cid % 0xFFFF}_"
+                    f"{self.win_id % 0xFFFF}")
+        creator = self.h.slice_id == 0
+        winseg = None
+        if creator and my_ok:
+            try:
+                winseg = WinSyncSeg(seg_name, 1 + self.comm.size,
+                                    create=True)
+            except ShmError:
+                my_ok = False
+        # explicit copy: np.asarray over a jax array is a READ-ONLY
+        # view and ascontiguousarray would pass it through unchanged
+        self._mirror = np.array(self._inner.array, copy=True)
+        me = self._my_leader()
+        # phase 1: capabilities + mirror addresses
+        for s in peers:
+            self._send_msg(s, _T_XCHG, {
+                "win": self.win_id, "cma": my_ok,
+                "pid": os.getpid(), "addr": self._mirror.ctypes.data,
+            })
+        ok = my_ok
+        for s in peers:
+            rec = self.comm.recv(source=self._leader(s),
+                                 tag=self._tag(_T_XCHG), dest=me)
+            if rec.get("win") != self.win_id:
+                raise WinError(f"{self.name}: foreign mirror exchange")
+            ok = ok and bool(rec.get("cma"))
+            self._peer_mirrors[s] = (int(rec["pid"]), int(rec["addr"]))
+        # attach only once phase 1 proved the creator built the segment
+        if ok and not creator:
+            try:
+                winseg = WinSyncSeg(seg_name, 1 + self.comm.size,
+                                    create=False)
+            except ShmError:
+                ok = False
+        # phase 2: confirm — ANY rank's failure (winseg attach,
+        # /dev/shm pressure) disarms EVERY rank, or the data planes
+        # would diverge mid-window
+        for s in peers:
+            self._send_msg(s, _T_XCHG, {"win": self.win_id, "ok": ok})
+        final = ok
+        for s in peers:
+            rec = self.comm.recv(source=self._leader(s),
+                                 tag=self._tag(_T_XCHG), dest=me)
+            final = final and bool(rec.get("ok"))
+        if not final:
+            if winseg is not None:
+                winseg.close()
+            self._mirror = None
+            self._peer_mirrors.clear()
+            return
+        for s in range(self.h.n_slices):
+            self._slice_ranks[s] = [
+                r for r in range(self.comm.size)
+                if self.h.rank_slice[r] == s
+            ]
+        self._winseg = winseg
+        self._seen_mod = self._winseg.load(0)
+        self._direct = True
+        SPC.record("osc_sm_direct_windows")
+
+    def _direct_span(self, index) -> Optional[tuple[int, tuple]]:
+        """(byte offset, shape) of a contiguous span of one block, or
+        None when the index needs the general apply path (step slices,
+        index arrays, tuples)."""
+        bshape = tuple(self._mirror.shape[1:])
+        itemsize = self._mirror.dtype.itemsize
+        if index is None:
+            return 0, bshape
+        if not bshape:
+            return None  # scalar blocks: only whole-block access
+        row = itemsize
+        for d in bshape[1:]:
+            row *= int(d)
+        if isinstance(index, (int, np.integer)):
+            i = int(index)
+            if not -bshape[0] <= i < bshape[0]:
+                return None
+            return (i % bshape[0]) * row, bshape[1:]
+        if isinstance(index, slice):
+            if index.step not in (None, 1):
+                return None
+            start, stop, _ = index.indices(bshape[0])
+            if stop <= start:
+                return None
+            return start * row, (stop - start,) + bshape[1:]
+        return None
+
+    def _mirror_addr(self, s: int, target: int, off: int) -> tuple[int, int]:
+        """(pid, absolute address) of byte `off` within `target`'s
+        block inside slice s's mirror."""
+        pid, base = self._peer_mirrors[s]
+        lidx = self._slice_ranks[s].index(target)
+        return pid, base + lidx * self._mirror[0].nbytes + off
+
+    def _host_apply(self, kind: str, lidx: int, index, value, op,
+                    compare) -> Optional[np.ndarray]:
+        """Apply one RMA op to the local mirror (host-side twin of
+        Window._apply_pending's device semantics; ops use their
+        np_reduce host path)."""
+        from ..ops.op import NO_OP, REPLACE
+
+        block = self._mirror[lidx]
+        idx = index if index is not None else Ellipsis
+        if kind == "put":
+            self._mirror_dirty = True
+            block[idx] = value
+            return None
+        cur = np.copy(block[idx])
+        if kind == "get":
+            return cur  # pure read: device copy stays fresh
+        self._mirror_dirty = True
+        if op is not None and not hasattr(op, "np_reduce"):
+            op = op_lookup(op)
+        val = (None if value is None
+               else np.asarray(value, dtype=block.dtype))
+        if kind == "acc":
+            block[idx] = val if op is REPLACE else op.np_reduce(cur, val)
+            return None
+        if kind == "get_acc":
+            if op is not NO_OP:
+                block[idx] = (val if op is REPLACE
+                              else op.np_reduce(cur, val))
+            return cur
+        if kind == "cswap":
+            eq = cur == np.asarray(compare, dtype=block.dtype)
+            block[idx] = np.where(eq, val, cur)
+            return cur
+        raise WinError(f"unknown RMA op {kind}")
+
     # -- RMA operations ----------------------------------------------------
 
     def _queue_remote(self, kind: str, target: int, value, index,
@@ -231,7 +438,35 @@ class FabricWindow:
     def put(self, value, target: int, index=None) -> None:
         self._check_alive()
         self._check_epoch(target)
-        if self._slice_of(target) == self.h.slice_id:
+        s = self._slice_of(target)
+        if self._direct:
+            if s == self.h.slice_id:
+                self._host_apply("put", self._local_idx(target), index,
+                                 np.asarray(value), None, None)
+                return
+            # Direct writes are immediate, which fits passive/PSCW
+            # epochs (the target has ceded the memory: lock held, or
+            # post() promised no local access). FENCE-epoch puts ride
+            # the batch — the AM epoch gate is what keeps them from
+            # landing before the target even enters the epoch. A
+            # queued AM batch to this slice also pins ordering.
+            span = self._direct_span(index)
+            if (span is not None
+                    and self._sync in (SyncType.LOCK, SyncType.LOCK_ALL,
+                                       SyncType.PSCW)
+                    and not self._remote_pending.get(s)):
+                from ..btl import sm as _sm
+
+                off, shp = span
+                val = np.ascontiguousarray(np.broadcast_to(
+                    np.asarray(value, self._mirror.dtype), shp))
+                pid, addr = self._mirror_addr(s, target, off)
+                _sm.cma_write_from(pid, addr, val)
+                SPC.record("osc_sm_direct_puts")
+                return
+            self._queue_remote("put", target, value, index)
+            return
+        if s == self.h.slice_id:
             self._inner.put(value, self._local_idx(target), index)
             return
         self._queue_remote("put", target, value, index)
@@ -239,7 +474,33 @@ class FabricWindow:
     def get(self, target: int, index=None) -> WindowResult:
         self._check_alive()
         self._check_epoch(target)
-        if self._slice_of(target) == self.h.slice_id:
+        s = self._slice_of(target)
+        if self._direct:
+            import jax
+
+            if s == self.h.slice_id:
+                out = self._host_apply("get", self._local_idx(target),
+                                       index, None, None, None)
+                return WindowResult([jax.device_put(out)], self)
+            # Direct gets complete IMMEDIATELY, which fits passive and
+            # PSCW epochs (osc/sm's load path); fence-epoch gets keep
+            # the apply-at-close contract (they observe the whole
+            # epoch's accumulates) and ride the batch.
+            span = self._direct_span(index)
+            if (span is not None
+                    and self._sync in (SyncType.LOCK, SyncType.LOCK_ALL,
+                                       SyncType.PSCW)
+                    and not self._remote_pending.get(s)):
+                from ..btl import sm as _sm
+
+                off, shp = span
+                out = np.empty(shp, self._mirror.dtype)
+                pid, addr = self._mirror_addr(s, target, off)
+                _sm.cma_read_into(pid, addr, out)
+                SPC.record("osc_sm_direct_gets")
+                return WindowResult([jax.device_put(out)], self)
+            return self._queue_remote("get", target, None, index)
+        if s == self.h.slice_id:
             return self._inner.get(self._local_idx(target), index)
         return self._queue_remote("get", target, None, index)
 
@@ -248,6 +509,10 @@ class FabricWindow:
         self._check_epoch(target)
         op = op_lookup(op)
         if self._slice_of(target) == self.h.slice_id:
+            if self._direct:
+                self._host_apply("acc", self._local_idx(target), index,
+                                 np.asarray(value), op, None)
+                return
             self._inner.accumulate(value, self._local_idx(target),
                                    op, index)
             return
@@ -259,6 +524,14 @@ class FabricWindow:
         self._check_epoch(target)
         op = op_lookup(op)
         if self._slice_of(target) == self.h.slice_id:
+            if self._direct:
+                import jax
+
+                out = self._host_apply(
+                    "get_acc", self._local_idx(target), index,
+                    None if value is None else np.asarray(value), op,
+                    None)
+                return WindowResult([jax.device_put(out)], self)
             return self._inner.get_accumulate(
                 value, self._local_idx(target), op, index)
         return self._queue_remote("get_acc", target, value, index, op=op)
@@ -272,6 +545,13 @@ class FabricWindow:
         self._check_alive()
         self._check_epoch(target)
         if self._slice_of(target) == self.h.slice_id:
+            if self._direct:
+                import jax
+
+                out = self._host_apply(
+                    "cswap", self._local_idx(target), index,
+                    np.asarray(value), None, compare)
+                return WindowResult([jax.device_put(out)], self)
             return self._inner.compare_and_swap(
                 value, compare, self._local_idx(target), index)
         return self._queue_remote("cswap", target, value, index,
@@ -330,10 +610,27 @@ class FabricWindow:
         if msg.get("win") != self.win_id:
             # another window's traffic shares no tags; this is a bug
             raise WinError(f"{self.name}: foreign window message {msg}")
+        if self._arming:
+            # Window creation is collective but NOT a barrier: a fast
+            # peer can finish its side of the mirror exchange and send
+            # ops while we are still arming — and our exchange recv
+            # pumps progress. Applying now would pick the WRONG data
+            # plane (the _direct decision isn't made yet); park until
+            # arming resolves.
+            self._held.append((sub, msg))
+            return
         if sub == _T_BATCH:
-            if msg["ep"] not in (-1, -2) and msg["ep"] != self._epoch:
-                self._held.append((sub, msg))  # future fence epoch
-                return
+            if msg["ep"] not in (-1, -2):
+                if msg["ep"] != self._epoch:
+                    self._held.append((sub, msg))  # future fence epoch
+                    return
+                if self._direct and not self._in_close:
+                    # direct mode: local ops hit the mirror immediately
+                    # instead of queueing, so a fence batch applied by
+                    # an early pump would reorder against local ops
+                    # still being issued — park it until OUR close
+                    self._held.append((sub, msg))
+                    return
             self._apply_batch(msg)
         elif sub == _T_LOCK:
             self._handle_lock_req(msg)
@@ -346,22 +643,34 @@ class FabricWindow:
     def _apply_batch(self, msg: dict) -> None:
         org = msg["org"]
         results: list = []
-        for d in msg["ops"]:
-            lidx = self._local_idx(d["t"])
-            idx = _dec_index(d["i"])
-            kind = d["k"]
-            opname = d.get("o")
-            op = op_lookup(opname) if opname else None
-            pending = _PendingOp(
-                kind={"fetch_op": "get_acc"}.get(kind, kind),
-                target=lidx, value=d.get("v"), index=idx, op=op,
-                compare=d.get("c"),
-                result_slot=[] if kind in self.RESULT_KINDS else None,
-            )
-            self._inner._pending.append(pending)
-            if pending.result_slot is not None:
-                results.append(pending.result_slot)
-        self._inner._apply_pending()
+        if self._direct:
+            # direct mode: the mirror is the epoch-time store — AM ops
+            # (accumulates, fancy-index put/get) apply host-side so
+            # they compose with peers' direct writes on the same memory
+            for d in msg["ops"]:
+                kind = {"fetch_op": "get_acc"}.get(d["k"], d["k"])
+                res = self._host_apply(
+                    kind, self._local_idx(d["t"]), _dec_index(d["i"]),
+                    d.get("v"), d.get("o"), d.get("c"))
+                if d["k"] in self.RESULT_KINDS:
+                    results.append([res])
+        else:
+            for d in msg["ops"]:
+                lidx = self._local_idx(d["t"])
+                idx = _dec_index(d["i"])
+                kind = d["k"]
+                opname = d.get("o")
+                op = op_lookup(opname) if opname else None
+                pending = _PendingOp(
+                    kind={"fetch_op": "get_acc"}.get(kind, kind),
+                    target=lidx, value=d.get("v"), index=idx, op=op,
+                    compare=d.get("c"),
+                    result_slot=[] if kind in self.RESULT_KINDS else None,
+                )
+                self._inner._pending.append(pending)
+                if pending.result_slot is not None:
+                    results.append(pending.result_slot)
+            self._inner._apply_pending()
         SPC.record("osc_fabric_batches_applied")
         vals = [np.asarray(r[0]) if r else None for r in results]
         self._send_msg(org, _T_REPLY, {
@@ -386,6 +695,8 @@ class FabricWindow:
                 st[1].discard(org)
                 if not st[1]:
                     st[0] = ""
+                if self._direct:
+                    self._mirror_dirty = True  # origin's epoch closed
                 self._grant_waiters(rank, st)
                 return
             if self._lock_compatible(st, mode):
@@ -454,15 +765,25 @@ class FabricWindow:
         # reply to OUR batches (get results + acks) came back
         peers = [s for s in range(self.h.n_slices)
                  if s != self.h.slice_id]
-        for s in peers:
-            self._flush_slice(s, self._epoch)
-        self._collect_replies(peers, self._epoch)
-        self._pump_until(
-            lambda: all(s in self._got_batches for s in peers),
-            "peer fence batches",
-        )
+        self._in_close = True
+        try:
+            self._release_held()  # direct mode parks same-epoch batches
+            for s in peers:
+                self._flush_slice(s, self._epoch)
+            self._collect_replies(peers, self._epoch)
+            self._pump_until(
+                lambda: all(s in self._got_batches for s in peers),
+                "peer fence batches",
+            )
+        finally:
+            self._in_close = False
         self._got_batches.clear()
         self.comm.barrier()
+        if self._direct:
+            # peers' direct writes into our mirror are invisible to us:
+            # after the closing barrier they are complete — mark the
+            # device copy stale
+            self._mirror_dirty = True
 
     def _collect_replies(self, slices, ep: int) -> None:
         """Receive one reply per outstanding batch, filling result
@@ -498,6 +819,32 @@ class FabricWindow:
                 f"{self.name}: lock inside {self._sync.value} epoch"
             )
         target = self.comm.check_rank(target)
+        if self._direct:
+            # one CAS on the shared lock word (0 free / -1 exclusive /
+            # k>0 shared holders); contended acquires park on the futex
+            # between progress pumps
+            word = 1 + target
+            want_excl = lock_type == LOCK_EXCLUSIVE
+
+            def _try():
+                cur = self._winseg.load(word)
+                if want_excl:
+                    if cur != 0:
+                        self._winseg.wait(word, cur, 2)
+                        return False
+                    return self._winseg.cas(word, 0, -1) == 0
+                while cur >= 0:
+                    if self._winseg.cas(word, cur, cur + 1) == cur:
+                        return True
+                    cur = self._winseg.load(word)
+                self._winseg.wait(word, cur, 2)
+                return False
+
+            self._pump_until(_try, f"shared lock word for {target}")
+            self._locks[target] = lock_type
+            self._sync = SyncType.LOCK
+            SPC.record("osc_lock_calls")
+            return
         s = self._slice_of(target)
         if s == self.h.slice_id:
             # local target: same lock manager, no messages (the inner
@@ -541,6 +888,26 @@ class FabricWindow:
         if target not in self._locks:
             raise RMASyncError(f"{self.name}: rank {target} not locked")
         s = self._slice_of(target)
+        if self._direct:
+            # complete outstanding AM ops (accumulates, fancy indices)
+            # for this slice, then drop the shared lock word and bump
+            # the window modification counter (the target re-lands its
+            # device copy when it observes the bump)
+            if s != self.h.slice_id and (
+                    s in self._remote_pending or s in self._result_slots):
+                self._flush_slice(s, -1)
+                self._collect_replies([s], -1)
+            word = 1 + target
+            if self._locks[target] == LOCK_EXCLUSIVE:
+                self._winseg.store(word, 0)
+            else:
+                self._winseg.add(word, -1)
+            self._winseg.wake(word)
+            self._winseg.add(0, 1)
+            del self._locks[target]
+            if not self._locks:
+                self._sync = SyncType.NONE
+            return
         if s == self.h.slice_id:
             self._inner._apply_pending(self._local_idx(target))
             with self._lock_mu:
@@ -649,6 +1016,8 @@ class FabricWindow:
                 return True
 
         self._pump_until(_all_done, "PSCW origin completions")
+        if self._direct:
+            self._mirror_dirty = True  # exposure epoch closed
         self._pscw_origins = []
         self._pscw_posted = False
 
@@ -722,6 +1091,8 @@ class FabricWindow:
         # re-enter an unmatchable barrier.
         _progress.unregister(self._handle_arrivals)
         self._freed = True
+        if self._direct:
+            self._winseg.close()
         self._inner._pending.clear()
         self._inner._sync = SyncType.NONE
         self._inner.free()

@@ -44,6 +44,10 @@ _WORKER = textwrap.dedent(r"""
 
     win = osc.allocate_window(world, (3,), "float32")
     assert type(win).__name__ == "FabricWindow"
+    # same-host 2-controller job: the osc/sm direct data plane must arm
+    # (host mirrors + CMA put/get + shared lock words); ptrace-denied
+    # hosts legitimately fall back to pure AM
+    direct = win._direct
 
     # ---- fence epoch: cross-process put + accumulate + get -------------
     win.fence()
@@ -126,8 +130,31 @@ _WORKER = textwrap.dedent(r"""
         assert np.allclose(np.asarray(win.array)[1], 11.0)
 
     world.barrier()
+
+    # contended EXCLUSIVE lock through the shared lock words: both
+    # controllers increment the same remote element under lock; the
+    # CAS/futex protocol must serialize them (reference:
+    # osc_sm_passive_target.c lock state in shared memory)
+    if direct:
+        from ompi_tpu.core.counters import SPC
+        for i in range(20):
+            win.lock(0, osc.LOCK_EXCLUSIVE)
+            cur = np.asarray(win.get(target=0).value())
+            win.put(cur + 1.0, target=0)
+            win.unlock(0)
+        world.barrier()
+        if pid == 0:
+            final = np.asarray(win.array)[0]
+            assert np.allclose(final, 40.0), final  # 2 origins x 20
+        else:
+            # rank 0 is remote from here: the loop's ops rode the
+            # single-copy plane (pid 0's own ops are local-mirror)
+            assert SPC.snapshot().get("osc_sm_direct_gets", 0) >= 20
+            assert SPC.snapshot().get("osc_sm_direct_puts", 0) >= 20
+
+    world.barrier()
     win.free()
-    print(f"WORKER {pid} OK", flush=True)
+    print(f"WORKER {pid} OK direct={direct}", flush=True)
 """)
 
 
