@@ -898,12 +898,16 @@ class FabricWindow:
                 self._flush_slice(s, -1)
                 self._collect_replies([s], -1)
             word = 1 + target
+            # bump the modification counter BEFORE releasing the lock
+            # word: the next holder's very first win.array read must
+            # already see mod != seen and re-land — release-then-bump
+            # would let it run in the gap and serve stale device data
+            self._winseg.add(0, 1)
             if self._locks[target] == LOCK_EXCLUSIVE:
                 self._winseg.store(word, 0)
             else:
                 self._winseg.add(word, -1)
             self._winseg.wake(word)
-            self._winseg.add(0, 1)
             del self._locks[target]
             if not self._locks:
                 self._sync = SyncType.NONE
@@ -1092,6 +1096,10 @@ class FabricWindow:
         _progress.unregister(self._handle_arrivals)
         self._freed = True
         if self._direct:
+            # drop direct mode BEFORE closing the segment: a post-free
+            # .array access must fall through to the (harmless) inner
+            # array, not winseg_load a NULL base
+            self._direct = False
             self._winseg.close()
         self._inner._pending.clear()
         self._inner._sync = SyncType.NONE

@@ -103,6 +103,16 @@ _segment_var = config.register(
                 "RDMA/FRAG pipeline, pml_ob1_sendreq.h:385-455; 1 MiB "
                 "tuned segment)",
 )
+_pipeline_d2h_var = config.register(
+    "pml", "fabric", "pipeline_d2h", type=str, default="auto",
+    description="Pipelined device->host readback for multi-segment "
+                "rendezvous of device arrays (the smcuda staged-"
+                "fragment analog, btl_smcuda.c:919-1187). 'auto': only "
+                "on accelerator backends, where the D2H DMA engine "
+                "genuinely overlaps the wire; on the CPU backend "
+                "np.asarray is zero-copy and slicing is pure overhead. "
+                "'on'/'off' force.",
+)
 _strict_place_var = config.register(
     "pml", "fabric", "strict_placement", type=bool, default=False,
     description="Force jax.Array delivery (device_put) even for "
@@ -657,8 +667,13 @@ class FabricEngine:
         # per-segment parse work on the receiver.
         # Single-array payloads (the RTS advertised dtype/shape) slice
         # straight out of the array's memory: no dss pack, no staging
-        # copy at all.
-        if _rndv_meta(value) is not None:
+        # copy at all. Device-resident arrays going out in multiple
+        # segments take the PIPELINED readback below instead.
+        meta = _rndv_meta(value)
+        if (meta is not None and hasattr(value, "copy_to_host_async")
+                and self._send_data_pipelined(src_idx, msg, value)):
+            return
+        if meta is not None:
             arr = np.ascontiguousarray(np.asarray(value))
             view = memoryview(arr).cast("B")
         else:
@@ -675,6 +690,52 @@ class FabricEngine:
             self._send_framed(src_idx, P2P_DATA_TAG, hdr,
                               view[off:off + seg])
             SPC.record("fabric_data_segments_sent")
+
+    def _send_data_pipelined(self, src_idx: int, msg: dict,
+                             value) -> bool:
+        """Pipelined device->host readback for multi-segment rendezvous
+        of a device-resident array: every segment's D2H copy is started
+        asynchronously up front (copy_to_host_async), so segment k's
+        readback DMA overlaps segment k-1's wire transfer — the smcuda
+        staged-fragment pipeline (reference: opal/mca/btl/smcuda/
+        btl_smcuda.c:919-1187; pml CUDA RNDV pml_ob1_sendreq.h:446-449).
+        Returns False when the shape doesn't segment cleanly (single
+        segment, element-splitting sizes) or the platform gate says the
+        plain path wins — the caller handles those."""
+        mode = _pipeline_d2h_var.value
+        if mode == "off":
+            return False
+        if mode != "on":
+            try:
+                platforms = {d.platform for d in value.devices()}
+            except Exception:
+                return False
+            if platforms <= {"cpu"}:
+                return False  # zero-copy host view beats slicing
+        itemsize = np.dtype(value.dtype).itemsize
+        total = int(value.nbytes)
+        seg = self._seg_size(src_idx, total)
+        if seg % itemsize or total <= seg:
+            return False
+        n_seg = -(-total // seg)
+        elems = seg // itemsize
+        flat = value.reshape(-1)  # device-side view, same layout
+        parts = [flat[si * elems:(si + 1) * elems]
+                 for si in range(n_seg)]
+        for p in parts:  # launch ALL readbacks; they complete in order
+            p.copy_to_host_async()
+        for si, p in enumerate(parts):
+            off = si * seg
+            hdr = _DATA_HDR.pack(
+                _DATA_MAGIC, msg["cid"], msg["src"], msg["dst"],
+                msg["tag"], msg["seq"], total, off, n_seg, si,
+            )
+            host = np.asarray(p)  # ready or nearly so: DMA overlapped
+            self._send_framed(src_idx, P2P_DATA_TAG, hdr,
+                              memoryview(host).cast("B"))
+            SPC.record("fabric_data_segments_sent")
+            SPC.record("fabric_pipelined_segments")
+        return True
 
     def _on_data(self, src_idx: int, msg: dict) -> None:
         """A rendezvous payload segment arrived (dss-framed legacy

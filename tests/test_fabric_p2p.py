@@ -618,3 +618,78 @@ def test_native_matching_non_overtaking():
     finally:
         a.close()
         b.close()
+
+
+_PIPELINE_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1]); coord = sys.argv[2]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu.core import config
+    from ompi_tpu.core.counters import SPC
+    from ompi_tpu.pml import fabric
+
+    # force the DCN transport so rendezvous goes multi-segment and the
+    # pipelined device readback engages (over shm a single CMA pull is
+    # already optimal and the pipeline correctly stands down)
+    config.set("btl_sm_enable", False)
+    config.set("pml_fabric_pipeline_segment", 256 * 1024)
+    config.set("pml_fabric_pipeline_d2h", "on")  # CPU mesh: force
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=2, process_id=pid,
+                               local_device_ids=[0, 1])
+    world = ompi_tpu.init()
+    fabric.wire_up()
+
+    import jax.numpy as jnp
+    big = jnp.arange(1 << 20, dtype=jnp.float32)  # 4 MiB, DEVICE array
+    if pid == 0:
+        world.rank(0).send(big, dest=2, tag=21)
+        back = world.rank(0).recv(source=2, tag=22)
+        assert float(jnp.sum(back)) == float(jnp.sum(big)) * 2
+        snap = SPC.snapshot()
+        # 4 MiB / 256 KiB = 16 pipelined segments
+        assert snap.get("fabric_pipelined_segments", 0) >= 16, snap
+    else:
+        got = world.rank(2).recv(source=0, tag=21)
+        arr = np.asarray(got)
+        np.testing.assert_array_equal(arr, np.arange(1 << 20,
+                                                     dtype=np.float32))
+        world.rank(2).send(got * 2, dest=0, tag=22)
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def test_two_process_pipelined_device_rendezvous():
+    """Multi-segment rendezvous of a DEVICE array over DCN launches all
+    D2H readbacks asynchronously before the wire sends (the smcuda
+    staged-fragment pipeline, btl_smcuda.c:919-1187)."""
+    nprocs = 2
+    coord = f"127.0.0.1:{_free_port()}"
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", _PIPELINE_WORKER, str(pid), coord],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(nprocs)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=240)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
