@@ -44,6 +44,19 @@ _root_var = config.register(
     description="Directory backing the local object-store fake; empty "
                 "disables the gcs component unless a client is set",
 )
+_endpoint_var = config.register(
+    "fs", "gcs", "endpoint", type=str, default="",
+    description="HTTP(S) endpoint of a real GCS-compatible store "
+                "(JSON API). Empty: fall back to STORAGE_EMULATOR_HOST "
+                "from the environment, else the local fake / none. "
+                "Production value: https://storage.googleapis.com",
+)
+_token_var = config.register(
+    "fs", "gcs", "token", type=str, default="",
+    description="Bearer token for the GCS JSON API. Empty: try the "
+                "GCE metadata server (the TPU-VM service-account flow), "
+                "else anonymous (emulators).",
+)
 
 
 class ObjectStoreClient:
@@ -104,18 +117,153 @@ class LocalObjectStore(ObjectStoreClient):
         return os.path.exists(self._path(bucket, key))
 
 
+class HttpGcsClient(ObjectStoreClient):
+    """Real object-store client over the GCS JSON API, stdlib-only
+    (urllib — TPU VMs need no extra deps). Auth: an explicit bearer
+    token, else the GCE metadata server's service-account token (the
+    flow TPU VMs use), else anonymous (emulators like fake-gcs-server).
+    Reference breadth analog: ompi/mca/fs ships one component per real
+    filesystem (ufs/lustre/gpfs/pvfs2/ime); this is the GCS one."""
+
+    def __init__(self, endpoint: str, token: str = "",
+                 timeout_s: float = 60.0) -> None:
+        self.endpoint = endpoint.rstrip("/")
+        self._token = token
+        self._token_expiry = float("inf") if token else 0.0
+        self.timeout_s = timeout_s
+        self._mu = threading.Lock()
+
+    # -- auth --------------------------------------------------------------
+
+    _METADATA_TOKEN_URL = (
+        "http://metadata.google.internal/computeMetadata/v1/instance/"
+        "service-accounts/default/token"
+    )
+
+    def _bearer(self) -> str:
+        import json as _json
+        import time as _time
+        import urllib.request
+
+        with self._mu:
+            if self._token and _time.monotonic() < self._token_expiry:
+                return self._token
+            try:
+                req = urllib.request.Request(
+                    self._METADATA_TOKEN_URL,
+                    headers={"Metadata-Flavor": "Google"},
+                )
+                with urllib.request.urlopen(req, timeout=2.0) as r:
+                    tok = _json.loads(r.read())
+                self._token = tok["access_token"]
+                self._token_expiry = (
+                    _time.monotonic() + int(tok.get("expires_in", 300))
+                    - 60
+                )
+            except Exception:
+                # anonymous: emulators accept it; a real bucket will
+                # answer 401 and the op raises with that status
+                self._token = ""
+                self._token_expiry = _time.monotonic() + 60
+            return self._token
+
+    def _request(self, method: str, url: str, data: bytes = None,
+                 ok=(200,), content_type: str = None):
+        import urllib.error
+        import urllib.request
+
+        headers = {}
+        tok = self._bearer()
+        if tok:
+            headers["Authorization"] = f"Bearer {tok}"
+        if content_type:
+            headers["Content-Type"] = content_type
+        req = urllib.request.Request(url, data=data, headers=headers,
+                                     method=method)
+        try:
+            with urllib.request.urlopen(req,
+                                        timeout=self.timeout_s) as r:
+                body = r.read()
+                if r.status not in ok:
+                    raise IOError_(f"{method} {url}: HTTP {r.status}")
+                return body
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            raise IOError_(
+                f"{method} {url}: HTTP {exc.code} {exc.reason}"
+            ) from exc
+        except OSError as exc:
+            raise IOError_(f"{method} {url}: {exc}") from exc
+
+    def _obj_url(self, bucket: str, key: str, media: bool) -> str:
+        import urllib.parse
+
+        enc = urllib.parse.quote(key, safe="")
+        url = f"{self.endpoint}/storage/v1/b/{bucket}/o/{enc}"
+        return url + "?alt=media" if media else url
+
+    # -- ObjectStoreClient surface -----------------------------------------
+
+    def download(self, bucket: str, key: str) -> Optional[bytes]:
+        return self._request("GET", self._obj_url(bucket, key, True))
+
+    def upload(self, bucket: str, key: str, data: bytes) -> None:
+        import urllib.parse
+
+        name = urllib.parse.quote(key, safe="")
+        url = (f"{self.endpoint}/upload/storage/v1/b/{bucket}/o"
+               f"?uploadType=media&name={name}")
+        if self._request("POST", url, data=bytes(data),
+                         content_type="application/octet-stream"
+                         ) is None:
+            raise IOError_(f"gs://{bucket}/{key}: upload target 404")
+
+    def delete(self, bucket: str, key: str) -> None:
+        out = self._request("DELETE", self._obj_url(bucket, key, False),
+                            ok=(200, 204))
+        if out is None:
+            raise IOError_(f"gs://{bucket}/{key}: no such object")
+
+    def exists(self, bucket: str, key: str) -> bool:
+        return self._request(
+            "GET", self._obj_url(bucket, key, False)) is not None
+
+
 _client: Optional[ObjectStoreClient] = None
+#: (endpoint, token) -> HttpGcsClient — the token/metadata cache lives
+#: on the instance, so clients must be reused across operations or
+#: every open/sync/close re-pays auth discovery
+_http_clients: dict = {}
 
 
 def set_client(client: Optional[ObjectStoreClient]) -> None:
     """Install the store backend (a real GCS client in production)."""
     global _client
     _client = client
+    _http_clients.clear()
 
 
 def get_client() -> Optional[ObjectStoreClient]:
+    """Backend selection, most-real first: explicit set_client, then a
+    configured/announced HTTP endpoint (fs_gcs_endpoint or
+    STORAGE_EMULATOR_HOST), then the local fake, else None — and with
+    None the component withdraws from selection (available() False),
+    the MCA graceful-withdraw contract."""
     if _client is not None:
         return _client
+    endpoint = ((_endpoint_var.value or "").strip()
+                or os.environ.get("STORAGE_EMULATOR_HOST", "").strip())
+    if endpoint:
+        if "://" not in endpoint:
+            endpoint = "http://" + endpoint
+        key = (endpoint, _token_var.value or "")
+        cli = _http_clients.get(key)
+        if cli is None:
+            _http_clients.clear()  # config changed: drop stale caches
+            cli = _http_clients[key] = HttpGcsClient(
+                endpoint, token=key[1])
+        return cli
     root = (_root_var.value or "").strip()
     if root:
         return LocalObjectStore(root)

@@ -316,23 +316,24 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_tagged_p2p():
-    nprocs = 2
-    coord = f"127.0.0.1:{_free_port()}"
+def _run_worker_pair(worker: str, *extra_args, timeout: int = 240):
+    """Spawn the worker as pid 0/1 (argv: pid, *extra_args), assert
+    both exit 0 and printed OK — the shared 2-controller harness."""
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     procs = [
         subprocess.Popen(
-            [sys.executable, "-c", _WORKER, str(pid), str(nprocs), coord],
+            [sys.executable, "-c", worker, str(pid),
+             *[str(a) for a in extra_args]],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd="/root/repo",
         )
-        for pid in range(nprocs)
+        for pid in range(2)
     ]
     outs = []
     try:
         for p in procs:
-            out, err = p.communicate(timeout=240)
+            out, err = p.communicate(timeout=timeout)
             outs.append((p.returncode, out, err))
     finally:
         for p in procs:
@@ -341,6 +342,10 @@ def test_two_process_tagged_p2p():
     for rc, out, err in outs:
         assert rc == 0, f"worker failed:\n{err[-3000:]}"
         assert "OK" in out
+
+
+def test_two_process_tagged_p2p():
+    _run_worker_pair(_WORKER, 2, f"127.0.0.1:{_free_port()}")
 
 
 def test_unknown_cid_holds_until_comm_exists():
@@ -551,31 +556,7 @@ _CM_WORKER = textwrap.dedent(r"""
 
 
 def test_two_process_cm_mtl_offload():
-    nprocs = 2
-    coord = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _CM_WORKER, str(pid), str(nprocs),
-             coord],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd="/root/repo",
-        )
-        for pid in range(nprocs)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rc, out, err in outs:
-        assert rc == 0, f"worker failed:\n{err[-3000:]}"
-        assert "OK" in out
+    _run_worker_pair(_CM_WORKER, 2, f"127.0.0.1:{_free_port()}")
 
 
 def test_native_matching_non_overtaking():
@@ -670,26 +651,4 @@ def test_two_process_pipelined_device_rendezvous():
     """Multi-segment rendezvous of a DEVICE array over DCN launches all
     D2H readbacks asynchronously before the wire sends (the smcuda
     staged-fragment pipeline, btl_smcuda.c:919-1187)."""
-    nprocs = 2
-    coord = f"127.0.0.1:{_free_port()}"
-    env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
-    procs = [
-        subprocess.Popen(
-            [sys.executable, "-c", _PIPELINE_WORKER, str(pid), coord],
-            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
-            env=env, cwd="/root/repo",
-        )
-        for pid in range(nprocs)
-    ]
-    outs = []
-    try:
-        for p in procs:
-            out, err = p.communicate(timeout=240)
-            outs.append((p.returncode, out, err))
-    finally:
-        for p in procs:
-            if p.poll() is None:
-                p.kill()
-    for rc, out, err in outs:
-        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+    _run_worker_pair(_PIPELINE_WORKER, f"127.0.0.1:{_free_port()}")
