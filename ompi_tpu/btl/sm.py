@@ -154,6 +154,21 @@ def _declare(lib) -> None:
     lib.winseg_wait.argtypes = [P, LL, ctypes.c_int, ctypes.c_int]
     lib.winseg_wake.restype = None
     lib.winseg_wake.argtypes = [P, LL]
+    lib.shm_enable_matching.restype = None
+    lib.shm_enable_matching.argtypes = [P, LL]
+    lib.shm_post_recv.restype = LL
+    lib.shm_post_recv.argtypes = [P, LL, ctypes.c_int, ctypes.c_int,
+                                  ctypes.c_int, ctypes.c_int]
+    lib.shm_poll_matched.restype = LL
+    lib.shm_poll_matched.argtypes = [P, ctypes.POINTER(LL)]
+    lib.shm_match_probe.restype = ctypes.c_int
+    lib.shm_match_probe.argtypes = [
+        P, ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_int), ctypes.POINTER(ctypes.c_int),
+        ctypes.POINTER(LL),
+    ]
+    lib.shm_msg_len.restype = LL
+    lib.shm_msg_len.argtypes = [P, LL]
     lib._shm_declared = True
 
 
@@ -230,7 +245,8 @@ _STAT_NAMES = (
     "bytes_sent", "bytes_recv", "fbox_sends", "ring_sends",
     "chunk_msgs", "msgs_recvd", "send_stalls", "fbox_recvs", "peers",
     "ns_stalled", "ns_sweep", "cma_sends", "cma_bytes_pulled",
-    "cma_fails", "proto_errors",
+    "cma_fails", "proto_errors", "offload_matches",
+    "offload_unexpected",
 )
 
 
@@ -372,27 +388,28 @@ class ShmEndpoint:
         finally:
             self._end()
 
-    def _consume(self, msgid, peer, tag, length):
-        buf = np.empty(max(1, length.value), np.uint8)
-        got = self._lib.shm_read(
-            self._ctx, msgid, buf.ctypes.data, length.value
-        )
+    def _read_payload(self, msgid: int, n: int):
+        """shm_read msgid into a fresh buffer; payload typed per the
+        poll_recv contract (bytes <= 64 KiB, read-only memoryview
+        above). Caller holds the _begin/_end guard."""
+        buf = np.empty(max(1, n), np.uint8)
+        got = self._lib.shm_read(self._ctx, msgid, buf.ctypes.data, n)
         if got == -3:
             # If the sender is alive it re-sends via the chunk tier —
             # this message id is gone but the payload is not.
-            raise ShmPullError(
-                f"shm CMA pull from peer {peer.value} failed"
-            )
-        if got != length.value:
-            raise ShmError(f"short shm read {got} != {length.value}")
-        SPC.record("sm_recv_bytes", length.value)
-        if length.value <= 65536:
-            payload = buf[:length.value].tobytes()
-        else:
-            # Bulk: a .tobytes() here would re-copy what may have just
-            # arrived as a SINGLE process_vm_readv into `buf`. The
-            # array is exclusively ours — hand out a read-only view.
-            payload = buf[:length.value].data.toreadonly()
+            raise ShmPullError("shm CMA pull failed (peer gone?)")
+        if got != n:
+            raise ShmError(f"short shm read {got} != {n}")
+        SPC.record("sm_recv_bytes", n)
+        if n <= 65536:
+            return buf[:n].tobytes()
+        # Bulk: a .tobytes() here would re-copy what may have just
+        # arrived as a SINGLE process_vm_readv into `buf`. The array
+        # is exclusively ours — hand out a read-only view.
+        return buf[:n].data.toreadonly()
+
+    def _consume(self, msgid, peer, tag, length):
+        payload = self._read_payload(msgid, length.value)
         return int(peer.value), int(tag.value), payload
 
     def _wait_msg(self, deadline, what):
@@ -481,6 +498,75 @@ class ShmEndpoint:
                 )
         except ShmError:
             return False
+
+    # -- tag-matching offload (reference: mtl.h:418-421; mirrors the
+    # DcnEndpoint surface so the MTL muxes both engines) -------------------
+
+    def enable_matching(self, wire_tag: int) -> None:
+        """Divert completed messages carrying `wire_tag` into the
+        engine's matcher (-1 disables)."""
+        self._begin("enable_matching")
+        try:
+            self._lib.shm_enable_matching(self._ctx, wire_tag)
+        finally:
+            self._end()
+
+    def _read_matched_locked(self, msgid: int):
+        """Matched-message delivery; caller holds the guard (the read
+        must not race close()'s destroy — _inflight is the drain
+        barrier before the segment unmaps)."""
+        n = self._lib.shm_msg_len(self._ctx, msgid)
+        if n < 0:
+            raise ShmError(f"unknown matched message {msgid}")
+        return self._read_payload(msgid, n)
+
+    def post_recv(self, handle: int, cid: int, src: int, dst: int,
+                  tag: int):
+        """Post a receive (src/tag < 0 wildcard). Returns the payload
+        immediately when an unexpected message already matches; None
+        when queued for the sweep."""
+        self._begin("post_recv")
+        try:
+            msgid = self._lib.shm_post_recv(
+                self._ctx, handle, cid, src, dst, tag
+            )
+            if not msgid:
+                return None
+            return self._read_matched_locked(msgid)
+        finally:
+            self._end()
+
+    def poll_matched(self):
+        """(handle, payload) of one sweep-side match, or None."""
+        handle = ctypes.c_longlong(0)
+        self._begin("poll_matched")
+        try:
+            msgid = self._lib.shm_poll_matched(
+                self._ctx, ctypes.byref(handle)
+            )
+            if not msgid:
+                return None
+            return int(handle.value), self._read_matched_locked(msgid)
+        finally:
+            self._end()
+
+    def match_probe(self, cid: int, src: int, dst: int, tag: int):
+        """(src, tag, nbytes) of the first compatible unexpected
+        message without consuming it (MPI_Iprobe)."""
+        o_src = ctypes.c_int(0)
+        o_tag = ctypes.c_int(0)
+        o_len = ctypes.c_longlong(0)
+        self._begin("match_probe")
+        try:
+            hit = self._lib.shm_match_probe(
+                self._ctx, cid, src, dst, tag, ctypes.byref(o_src),
+                ctypes.byref(o_tag), ctypes.byref(o_len),
+            )
+        finally:
+            self._end()
+        if not hit:
+            return None
+        return int(o_src.value), int(o_tag.value), int(o_len.value)
 
     def peer_cma(self, peer_rank: int) -> bool:
         """True when bulk sends to this peer use the single-copy

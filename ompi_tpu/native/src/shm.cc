@@ -49,6 +49,7 @@
 // Exposed as flat C functions loaded via ctypes (no pybind11 in the
 // image); Python wrapper: ompi_tpu/btl/sm.py.
 
+#include <array>
 #include <atomic>
 #include <cerrno>
 #include <cstdint>
@@ -310,6 +311,22 @@ struct Ctx {
   std::mutex conn_mu;
   std::unordered_map<int, PeerConn*> peers;  // peer rank -> conn
 
+  // -- tag-matching offload (the mtl model: envelopes of frames on
+  // match_tag parse and match HERE, in the sweep, not in Python —
+  // reference: mtl.h:418-421; same design as dcn.cc's matcher) -------
+  struct PostedRecv {
+    int64_t handle;
+    int32_t cid, src, dst, tag;  // src/tag < 0 = wildcard
+  };
+  std::atomic<int64_t> match_tag{-1};  // -1 = offload disabled
+  std::deque<PostedRecv> posted;
+  std::deque<int64_t> unexpected_m;              // msgids, arrival order
+  std::deque<std::array<int64_t, 2>> matched_m;  // {handle, msgid}
+  // per-(peer,cid,src,dst) stream release in envelope-seq order
+  std::map<std::array<int64_t, 4>, int64_t> match_expect;
+  std::map<std::array<int64_t, 4>, std::map<int64_t, int64_t>> match_held;
+  std::atomic<int64_t> offload_matches{0}, offload_unexpected{0};
+
   uint64_t eager_limit = 32 * 1024;  // btl_sm_component.c:243 lineage
   uint64_t fbox_msg_limit = 0;       // fbox_size/4, reference :200 regime
   bool cma_enabled = true;
@@ -400,6 +417,98 @@ bool cma_pull2(pid_t pid, uint64_t a0, uint64_t l0, uint64_t a1,
   return true;
 }
 
+// -- matching engine (caller holds sweep_mu) ---------------------------------
+
+constexpr uint32_t kEnvMagic = 0x4FA57B0C;  // pml/fabric _FAST_MAGIC
+// full fast-frame header (magic + envelope + ndim/dtype/shape) — the
+// same constant dcn.cc keeps; probe counts exclude it
+constexpr size_t kEnvSize = 4 + 4 * 4 + 8 + 1 + 8 + 6 * 4;
+
+struct MpiEnv {
+  int32_t cid = 0, src = 0, dst = 0, tag = 0;
+  int64_t seq = 0;
+  bool ok = false;
+};
+
+MpiEnv parse_env(const Buf& b) {
+  MpiEnv e;
+  if (b.len < kEnvSize || b.p == nullptr) return e;
+  uint32_t magic;
+  memcpy(&magic, b.p, 4);
+  if (magic != kEnvMagic) return e;
+  memcpy(&e.cid, b.p + 4, 4);
+  memcpy(&e.src, b.p + 8, 4);
+  memcpy(&e.dst, b.p + 12, 4);
+  memcpy(&e.tag, b.p + 16, 4);
+  memcpy(&e.seq, b.p + 20, 8);
+  e.ok = true;
+  return e;
+}
+
+bool env_matches(const Ctx::PostedRecv& r, const MpiEnv& e) {
+  return r.cid == e.cid && r.dst == e.dst &&
+         (r.src < 0 || r.src == e.src) && (r.tag < 0 || r.tag == e.tag);
+}
+
+void match_one(Ctx* c, int64_t id, const MpiEnv& e) {
+  for (auto pit = c->posted.begin(); pit != c->posted.end(); ++pit) {
+    if (env_matches(*pit, e)) {
+      c->matched_m.push_back({pit->handle, id});
+      c->posted.erase(pit);
+      c->offload_matches.fetch_add(1, std::memory_order_relaxed);
+      return;
+    }
+  }
+  c->unexpected_m.push_back(id);
+  c->offload_unexpected.fetch_add(1, std::memory_order_relaxed);
+}
+
+bool cma_resolve_one(Ctx* c, Msg& m);  // fwd (defined with cma_complete)
+
+// Route one completed message id: into the matcher (envelope-seq order
+// per stream) when its wire tag is the offloaded one, else the plain
+// ready queue. A pending CMA message on the matched tag resolves its
+// pull NOW — the envelope lives in the payload.
+void route_msg(Ctx* c, int64_t id) {
+  Msg& m = c->msgs[id];
+  if (c->match_tag.load(std::memory_order_relaxed) != m.tag) {
+    c->ready.push_back(id);
+    return;
+  }
+  if (m.cma_slot >= 0 && !cma_resolve_one(c, m)) {
+    c->ready.push_back(id);  // pull failed: surface via normal path
+    return;
+  }
+  MpiEnv e = parse_env(m.data);
+  if (!e.ok) {
+    c->ready.push_back(id);
+    return;
+  }
+  std::array<int64_t, 4> stream{(int64_t)m.peer, e.cid, e.src, e.dst};
+  int64_t& expect = c->match_expect[stream];
+  if (e.seq != expect) {
+    c->match_held[stream][e.seq] = id;  // early: hold for the gap
+    return;
+  }
+  match_one(c, id, e);
+  expect++;
+  auto hit = c->match_held.find(stream);
+  if (hit != c->match_held.end()) {
+    auto& held = hit->second;
+    while (!held.empty() && held.begin()->first == expect) {
+      int64_t hid = held.begin()->second;
+      held.erase(held.begin());
+      auto mit = c->msgs.find(hid);
+      if (mit != c->msgs.end()) {
+        MpiEnv he = parse_env(mit->second.data);
+        if (he.ok) match_one(c, hid, he);
+      }
+      expect++;
+    }
+    if (held.empty()) c->match_held.erase(hit);
+  }
+}
+
 // Sweep every owned slot of our own segment: move complete messages to
 // the ready queue. Caller holds sweep_mu. Rings the drain bell when any
 // ring head advanced so a full-ring producer unparks immediately
@@ -428,7 +537,7 @@ void sweep_locked(Ctx* c) {
           copy_out_wrap(r, head + sizeof(fh), pay.p, fh.len);
           int64_t id = c->next_msgid++;
           c->msgs.emplace(id, Msg{owner, (int64_t)fh.tag, pay});
-          c->ready.push_back(id);
+          route_msg(c, id);
           c->msgs_recvd.fetch_add(1, std::memory_order_relaxed);
           c->bytes_recv.fetch_add(fh.len, std::memory_order_relaxed);
         } else if (fh.kind == kChunk && fh.len >= sizeof(ChunkHdr)) {
@@ -465,7 +574,7 @@ void sweep_locked(Ctx* c) {
             c->bytes_recv.fetch_add(a.buf.len,
                                     std::memory_order_relaxed);
             c->msgs.emplace(id, Msg{owner, a.tag, a.buf});
-            c->ready.push_back(id);
+            route_msg(c, id);
             c->msgs_recvd.fetch_add(1, std::memory_order_relaxed);
             c->assem.erase(key);
           }
@@ -487,7 +596,7 @@ void sweep_locked(Ctx* c) {
           m.cma_total = d.total;
           int64_t id = c->next_msgid++;
           c->msgs.emplace(id, m);
-          c->ready.push_back(id);
+          route_msg(c, id);
           c->msgs_recvd.fetch_add(1, std::memory_order_relaxed);
         }
         // unknown kinds are skipped (forward compatibility)
@@ -549,6 +658,12 @@ long long cma_complete(Ctx* c, Msg& m, void* dst) {
     m.cma_slot = -1;
   }
   return (long long)m.cma_total;
+}
+
+// Resolve one pending pull into an owned buffer (the matcher needs
+// the payload to parse the envelope). Caller holds sweep_mu.
+bool cma_resolve_one(Ctx* c, Msg& m) {
+  return cma_complete(c, m, nullptr) >= 0;
 }
 
 // Resolve every pending pull into owned buffers. Called ONLY from
@@ -1162,6 +1277,85 @@ int shm_peer_alive(void* ctx, int peer_rank) {
   return peer_dead(it->second) ? 0 : 1;
 }
 
+// -- tag-matching offload exports (mirror dcn.cc's: enable / post /
+// poll / probe; delivery reuses shm_read by msgid) ---------------------------
+
+void shm_enable_matching(void* ctx, long long tag) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  c->match_tag.store(tag, std::memory_order_relaxed);
+}
+
+// Post a receive (src/tag < 0 wildcard). Returns a matched msgid when
+// an unexpected message already satisfies it (read it with shm_read),
+// else 0 — the sweep will surface the match via shm_poll_matched.
+long long shm_post_recv(void* ctx, long long handle, int cid, int src,
+                        int dst, int tag) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  sweep_locked(c);
+  Ctx::PostedRecv r{handle, cid, src, dst, tag};
+  for (auto it = c->unexpected_m.begin(); it != c->unexpected_m.end();
+       ++it) {
+    auto mit = c->msgs.find(*it);
+    if (mit == c->msgs.end()) {
+      continue;
+    }
+    MpiEnv e = parse_env(mit->second.data);
+    if (e.ok && env_matches(r, e)) {
+      int64_t id = *it;
+      c->unexpected_m.erase(it);
+      return id;
+    }
+  }
+  c->posted.push_back(r);
+  return 0;
+}
+
+// Byte length of a held message (matched-path consumers size their
+// landing buffer with this before shm_read). -1 unknown id.
+long long shm_msg_len(void* ctx, long long msgid) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  auto it = c->msgs.find(msgid);
+  if (it == c->msgs.end()) return -1;
+  Msg& m = it->second;
+  return (long long)(m.cma_slot >= 0 ? m.cma_total : m.data.len);
+}
+
+// One transport-side match: *handle out, returns the msgid (0 = none).
+long long shm_poll_matched(void* ctx, long long* handle) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  if (c->matched_m.empty()) sweep_locked(c);
+  if (c->matched_m.empty()) return 0;
+  auto m = c->matched_m.front();
+  c->matched_m.pop_front();
+  *handle = m[0];
+  return m[1];
+}
+
+// MPI_Iprobe over the unexpected queue: first compatible envelope,
+// not consumed. Returns 1 and fills out-params, else 0.
+int shm_match_probe(void* ctx, int cid, int src, int dst, int tag,
+                    int* o_src, int* o_tag, long long* o_len) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  sweep_locked(c);
+  Ctx::PostedRecv r{0, cid, src, dst, tag};
+  for (int64_t id : c->unexpected_m) {
+    auto mit = c->msgs.find(id);
+    if (mit == c->msgs.end()) continue;
+    MpiEnv e = parse_env(mit->second.data);
+    if (e.ok && env_matches(r, e)) {
+      *o_src = e.src;
+      *o_tag = e.tag;
+      *o_len = (long long)(mit->second.data.len - kEnvSize);
+      return 1;
+    }
+  }
+  return 0;
+}
+
 long long shm_stat(void* ctx, int what) {
   Ctx* c = static_cast<Ctx*>(ctx);
   switch (what) {
@@ -1183,6 +1377,8 @@ long long shm_stat(void* ctx, int what) {
     case 12: return c->cma_bytes_pulled.load();
     case 13: return c->cma_fails.load();
     case 14: return c->proto_errors.load();
+    case 15: return c->offload_matches.load();
+    case 16: return c->offload_unexpected.load();
   }
   return -1;
 }

@@ -278,6 +278,11 @@ class FabricEngine:
         # global process index directly.
         self.shm = None  # ShmEndpoint | None
         self.shm_peers: set[int] = set()
+        #: True when a co-located peer's shm outcome could not be read:
+        #: OUR view of shm_peers may disagree with THEIRS of us. ob1
+        #: tolerates that (one matcher drains both wires); pml/cm's
+        #: per-transport matchers must fall back to DCN-only then.
+        self.shm_view_partial = False
         self._lock = threading.RLock()
         self._send_seq: dict[tuple[int, int], int] = {}  # (cid,dst_idx)
         self._expect: dict[tuple[int, int], int] = {}    # (cid,src_idx)
@@ -897,23 +902,7 @@ class FabricEngine:
         SPC.record("fabric_rndv_delivered")
 
     def place(self, payload_bytes, dst_proc) -> Any:
-        import jax
-
-        if isinstance(payload_bytes, _FastPayload):
-            arr = payload_bytes.to_array()
-            if (getattr(dst_proc.device, "platform", None) == "cpu"
-                    and not _strict_place_var.value):
-                # Fastbox tier on a CPU destination: a host ndarray IS
-                # device-resident there, and jax.device_put would add
-                # ~40 us of backend bookkeeping per message — the exact
-                # regime this path exists to keep short. Delivered as a
-                # WRITABLE copy (frombuffer views are read-only);
-                # pml_fabric_strict_placement restores jax.Array
-                # delivery. Bulk/rendezvous always keeps the jax.Array
-                # placement contract.
-                return np.array(arr)
-            return jax.device_put(arr, dst_proc.device)
-        return unpack_value(payload_bytes, device=dst_proc.device)
+        return place_payload(payload_bytes, dst_proc)
 
     def idle_wait(self, budget: float) -> bool:
         """Progress-engine idle hook: when a blocked wait's sweep found
@@ -962,6 +951,29 @@ class FabricEngine:
         n = getattr(self.ep, "notify", None)
         if n is not None:
             n()
+
+
+
+def place_payload(payload_bytes, dst_proc) -> Any:
+    """Deliver a decoded payload onto the destination rank's device
+    (module-level: the mtl's matched delivery shares it)."""
+    import jax
+
+    if isinstance(payload_bytes, _FastPayload):
+        arr = payload_bytes.to_array()
+        if (getattr(dst_proc.device, "platform", None) == "cpu"
+                and not _strict_place_var.value):
+            # Fastbox tier on a CPU destination: a host ndarray IS
+            # device-resident there, and jax.device_put would add
+            # ~40 us of backend bookkeeping per message — the exact
+            # regime this path exists to keep short. Delivered as a
+            # WRITABLE copy (frombuffer views are read-only);
+            # pml_fabric_strict_placement restores jax.Array
+            # delivery. Bulk/rendezvous always keeps the jax.Array
+            # placement contract.
+            return np.array(arr)
+        return jax.device_put(arr, dst_proc.device)
+    return unpack_value(payload_bytes, device=dst_proc.device)
 
 
 def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
@@ -1030,10 +1042,21 @@ def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
             if modex.get(f"shm_ok/{idx}", timeout_s=timeout_s):
                 good.add(idx)
         except Exception:
-            pass  # peer never reported: leave it on DCN
+            # peer never reported: leave it on DCN. Mark the view
+            # PARTIAL — that peer may still list US in its shm set, so
+            # per-transport matchers (pml/cm) must not trust shm
+            # routing symmetry on this engine.
+            engine.shm_view_partial = True
     engine.shm = shm
     engine.shm_peers = good
     engine.open_channel(COLL_SM_TAG)  # before any peer's coll/sm frame
+    # Arm the shm matcher NOW (not at the mtl's first call): a peer's
+    # first MTL frame can land before this process touches pml/cm, and
+    # an unarmed sweep would route it to the plain queue where the
+    # progress loop discards unknown tags.
+    from .mtl import MTL_MATCH_TAG
+
+    shm.enable_matching(MTL_MATCH_TAG)
     SPC.record("fabric_sm_peers", len(good))
     logger.info("shm wired: process %d, co-located peers %s", my,
                 sorted(good))

@@ -27,6 +27,16 @@ Two domains:
 
 Select with ``--mca pml cm`` (config: ``pml_select=cm``); ob1 remains
 the default (full wildcard + rendezvous semantics across both domains).
+
+Transport note: same-host peers ride the shm engine's matcher, others
+the DCN engine's. The shm set must be SYMMETRIC between two processes
+for cm (the sender's routing decides which matcher sees the frame); a
+partial shm view — a co-located peer whose wiring outcome could not be
+read from the modex — makes this process fall back to DCN-only
+matching. If the asymmetric peer still routes to shm from its side
+(both failure modes coinciding requires a modex timeout, i.e. a
+controller already in trouble), use ob1, whose single matcher drains
+both wires.
 """
 
 from __future__ import annotations
@@ -104,7 +114,9 @@ class FabricMtl(MtlComponent):
 
     def _fabric_engine(self):
         """The wired cross-process engine (pml/fabric.wire_up attaches
-        it to ob1; the mtl rides the same endpoint)."""
+        it to ob1; the mtl rides the same endpoints — matching armed on
+        BOTH wires: the DCN epoll thread's matcher and the shm sweep's
+        (same C machinery, native/src/{dcn,shm}.cc)."""
         if self._engine is None:
             ob1 = PML.component("ob1")
             eng = getattr(ob1, "_fabric", None)
@@ -114,8 +126,17 @@ class FabricMtl(MtlComponent):
                     "(pml.fabric.wire_up) — no DCN engine attached"
                 )
             self._engine = eng
-            eng.ep.enable_matching(MTL_MATCH_TAG)
-        return self._engine
+        return self._engine  # both matchers are armed at wire_up
+
+    def _shm_owns(self, eng, process_index: int) -> bool:
+        """True when the mtl may use shm for this peer: the SYMMETRIC
+        subset only — with a partial shm view the sender's routing and
+        the receiver's matcher placement could disagree (the recv
+        would wait at the wrong engine forever), so everything falls
+        back to DCN."""
+        return (eng.shm is not None
+                and not getattr(eng, "shm_view_partial", False)
+                and process_index in eng.shm_peers)
 
     # -- local domain ------------------------------------------------------
 
@@ -134,27 +155,60 @@ class FabricMtl(MtlComponent):
 
         eng = self._fabric_engine()
         dst_idx = comm.procs[dst].process_index
-        pid = eng.peer_ids.get(dst_idx)
-        if pid is None:
-            raise CommError(f"no fabric wiring to process {dst_idx}")
-        raw = fmod.pack_value(value)
         with self._lock:
             key = (comm.cid, src, dst)
             seq = self._seqs.get(key, 0)
             self._seqs[key] = seq + 1
-        # the engine releases messages to the matcher in seq order per
-        # (cid,src,dst) stream (MPI non-overtaking: an eager frame must
-        # not overtake an earlier rendezvous with the same envelope)
-        frame = fmod.encode_fast(
-            comm.cid, src, dst, tag, seq,
-            np.frombuffer(raw, np.uint8),
-        )
-        eng.ep.check_peer(pid, what=f"process {dst_idx}")
-        eng.ep.send_bytes(pid, MTL_MATCH_TAG, frame)
+        # Single plain arrays ship as raw typed fast frames (no dss on
+        # the hot path — the same split ob1 uses); pytrees dss-pack
+        # into a 1-D uint8 payload. That exact shape is the dss MARKER:
+        # genuine 1-D uint8 user arrays also dss-pack so the receiver
+        # can tell the two apart. The engine releases messages to the
+        # matcher in seq order per (cid,src,dst) stream (MPI
+        # non-overtaking).
+        arr = fmod._fast_eligible(value, 1 << 62)
+        if arr is None or (arr.dtype == np.uint8 and arr.ndim == 1):
+            arr = np.frombuffer(fmod.pack_value(value), np.uint8)
+        frame = fmod.encode_fast(comm.cid, src, dst, tag, seq, arr)
+        if self._shm_owns(eng, dst_idx):
+            eng.shm.send_bytes(dst_idx, MTL_MATCH_TAG, frame)
+        else:
+            pid = eng.peer_ids.get(dst_idx)
+            if pid is None:
+                raise CommError(f"no fabric wiring to process {dst_idx}")
+            eng.ep.check_peer(pid, what=f"process {dst_idx}")
+            eng.ep.send_bytes(pid, MTL_MATCH_TAG, frame)
         SPC.record("mtl_remote_sends")
         # cm semantics: the matching transport owns buffering; local
-        # completion on hand-off (the DCN engine copies the frame).
+        # completion on hand-off (the engine copies the frame).
         return CompletedRequest(value, Status(source=src, tag=tag))
+
+    def _match_domain(self, eng, comm, source):
+        """The engine whose matcher owns this receive: the source's
+        transport, or — for wildcards — whichever single transport
+        carries ALL of this comm's remote peers (a mixed-transport
+        wildcard would need cross-engine cancel; ob1 handles those)."""
+        import jax
+
+        if source is not None and source >= 0:
+            idx = comm.procs[source].process_index
+            return eng.shm if self._shm_owns(eng, idx) else eng.ep
+        me = jax.process_index()
+        remote = {p.process_index for p in comm.procs
+                  if p.process_index != me}
+        if (eng.shm is not None
+                and not getattr(eng, "shm_view_partial", False)
+                and remote <= eng.shm_peers):
+            return eng.shm
+        if (eng.shm is None
+                or getattr(eng, "shm_view_partial", False)
+                or not (remote & eng.shm_peers)):
+            return eng.ep
+        raise CommError(
+            "pml/cm wildcard-source recv on a comm spanning BOTH shm "
+            "and DCN peers is unsupported (single-matcher offload); "
+            "select pml ob1 for mixed-transport wildcards"
+        )
 
     def irecv_remote(self, comm, source, dst, tag) -> Request:
         eng = self._fabric_engine()
@@ -162,7 +216,10 @@ class FabricMtl(MtlComponent):
         req = _MatchedRecv(self, handle, comm)
         with self._lock:
             self._outstanding[handle] = req
-        payload = eng.ep.post_recv(handle, comm.cid, source, dst, tag)
+        dom = self._match_domain(eng, comm, source)
+        payload = dom.post_recv(handle, comm.cid,
+                                -1 if source is None else source,
+                                dst, tag)
         if payload is not None:
             with self._lock:
                 self._outstanding.pop(handle, None)
@@ -176,7 +233,9 @@ class FabricMtl(MtlComponent):
 
     def iprobe_remote(self, comm, source, dst, tag) -> Optional[Status]:
         eng = self._fabric_engine()
-        hit = eng.ep.match_probe(comm.cid, source, dst, tag)
+        dom = self._match_domain(eng, comm, source)
+        hit = dom.match_probe(comm.cid,
+                              -1 if source is None else source, dst, tag)
         if hit is None:
             return None
         src, got_tag, nbytes = hit
@@ -189,29 +248,39 @@ class FabricMtl(MtlComponent):
         if eng is None:
             return 0
         n = 0
-        while True:
-            got = eng.ep.poll_matched()
-            if got is None:
-                break
-            handle, payload = got
-            with self._lock:
-                req = self._outstanding.pop(handle, None)
-            if req is None:
-                continue  # cancelled
-            self._deliver(req, req._comm, payload)
-            n += 1
+        sources = [eng.ep.poll_matched]
+        if eng.shm is not None:
+            sources.insert(0, eng.shm.poll_matched)  # latency tier first
+        for poll in sources:
+            while True:
+                got = poll()
+                if got is None:
+                    break
+                handle, payload = got
+                with self._lock:
+                    req = self._outstanding.pop(handle, None)
+                if req is None:
+                    continue  # cancelled
+                self._deliver(req, req._comm, payload)
+                n += 1
         if n:
             SPC.record("mtl_engine_matches", n)
         return n
 
-    def _deliver(self, req: _MatchedRecv, comm, payload: bytes) -> None:
+    def _deliver(self, req: _MatchedRecv, comm, payload) -> None:
         from . import fabric as fmod
 
         msg = fmod.decode_fast(payload)
-        value = fmod.unpack_value(
-            bytes(msg["pay"].raw),
-            device=comm.procs[msg["dst"]].device,
-        )
+        pay = msg["pay"]
+        if pay.dtype == np.uint8 and len(pay.shape) == 1:
+            # dss marker shape (pytrees and genuine u1 vectors)
+            value = fmod.unpack_value(
+                bytes(pay.raw),
+                device=comm.procs[msg["dst"]].device,
+            )
+        else:
+            # raw typed array: same delivery contract as ob1's place()
+            value = fmod.place_payload(pay, comm.procs[msg["dst"]])
         req._complete(value, Status(source=msg["src"], tag=msg["tag"],
                                     count=msg["nb"]))
         SPC.record("mtl_matched_recvs")

@@ -14,6 +14,7 @@ import subprocess
 import sys
 import textwrap
 import threading
+import time
 import uuid
 
 import numpy as np
@@ -184,6 +185,69 @@ def test_recv_into_requeues_on_small_buffer():
         if a.peer_cma(1):
             assert a.stats()["cma_fails"] == 0
             assert a.stats()["cma_sends"] == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_shm_native_matching_offload():
+    """The shm sweep's C matcher: posted-recv FIFO, unexpected queue,
+    wildcards, probe, and per-stream seq ordering — the same offload
+    dcn.cc gives the MTL (reference: mtl.h:418-421)."""
+    from ompi_tpu.pml import fabric as fmod
+
+    a, b = _pair()
+    tag = 0x4D544C4D
+    b.enable_matching(tag)
+    try:
+        # unexpected-first: frame arrives before the recv posts
+        f0 = fmod.encode_fast(5, 0, 1, 7, 0, np.arange(3, dtype=np.float32))
+        a.send_bytes(1, tag, f0)
+        # let the sweep route it (poll_matched sweeps internally)
+        assert b.poll_matched() is None  # nothing posted yet
+        hit = b.match_probe(5, -1, 1, -1)
+        assert hit is not None and hit[0] == 0 and hit[1] == 7
+        got = b.post_recv(101, 5, 0, 1, 7)   # immediate unexpected hit
+        assert got is not None
+        np.testing.assert_array_equal(
+            fmod.decode_fast(got)["pay"].to_array(), [0, 1, 2])
+
+        # posted-first + wildcard source/tag
+        assert b.post_recv(102, 5, -1, 1, -1) is None
+        f1 = fmod.encode_fast(5, 0, 1, 9, 1, np.float32(4.0))
+        a.send_bytes(1, tag, f1)
+        out = None
+        for _ in range(200):
+            out = b.poll_matched()
+            if out:
+                break
+            time.sleep(0.002)
+        assert out is not None and out[0] == 102
+        assert float(fmod.decode_fast(out[1])["pay"].to_array()) == 4.0
+
+        # seq ordering: seq 3 held until seq 2 lands
+        b.post_recv(103, 5, 0, 1, 11)
+        b.post_recv(104, 5, 0, 1, 11)
+        a.send_bytes(1, tag,
+                     fmod.encode_fast(5, 0, 1, 11, 3, np.float32(30.0)))
+        time.sleep(0.05)
+        assert b.poll_matched() is None   # early seq parked
+        a.send_bytes(1, tag,
+                     fmod.encode_fast(5, 0, 1, 11, 2, np.float32(20.0)))
+        got = []
+        for _ in range(200):
+            m = b.poll_matched()
+            if m:
+                got.append(m)
+            if len(got) == 2:
+                break
+            time.sleep(0.002)
+        assert [g[0] for g in got] == [103, 104]
+        vals = [float(fmod.decode_fast(g[1])["pay"].to_array())
+                for g in got]
+        assert vals == [20.0, 30.0]  # released in seq order
+        assert b.stats()["offload_matches"] >= 3
+        assert b.stats()["offload_unexpected"] >= 1
     finally:
         a.close()
         b.close()
