@@ -169,6 +169,8 @@ def _declare(lib) -> None:
     ]
     lib.shm_msg_len.restype = LL
     lib.shm_msg_len.argtypes = [P, LL]
+    lib.shm_wait_matched.restype = LL
+    lib.shm_wait_matched.argtypes = [P, LL, ctypes.c_int]
     lib._shm_declared = True
 
 
@@ -529,6 +531,22 @@ class ShmEndpoint:
         try:
             msgid = self._lib.shm_post_recv(
                 self._ctx, handle, cid, src, dst, tag
+            )
+            if not msgid:
+                return None
+            return self._read_matched_locked(msgid)
+        finally:
+            self._end()
+
+    def wait_matched(self, handle: int, timeout: float):
+        """Block NATIVELY until `handle`'s posted recv matches (sweep +
+        doorbell futex in C — no Python progress per message); returns
+        the payload, or None on timeout. Other handles' matches are
+        left for their own collectors."""
+        self._begin("wait_matched")
+        try:
+            msgid = self._lib.shm_wait_matched(
+                self._ctx, handle, max(1, int(timeout * 1000))
             )
             if not msgid:
                 return None

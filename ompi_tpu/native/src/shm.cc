@@ -1334,6 +1334,46 @@ long long shm_poll_matched(void* ctx, long long* handle) {
   return m[1];
 }
 
+// Blocking wait for a SPECIFIC posted handle to match: sweeps and
+// parks on the doorbell futex entirely in native code — the per-
+// message Python progress machinery never runs. Other handles' matches
+// stay queued for their own waiters. Returns the msgid, or 0 on
+// timeout.
+static long long take_matched(Ctx* c, long long handle) {
+  // sweep + extract THIS handle's match (others stay queued for their
+  // own waiters); caller does NOT hold sweep_mu
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  sweep_locked(c);
+  for (auto it = c->matched_m.begin(); it != c->matched_m.end(); ++it) {
+    if ((*it)[0] == handle) {
+      int64_t id = (*it)[1];
+      c->matched_m.erase(it);
+      return id;
+    }
+  }
+  return 0;
+}
+
+long long shm_wait_matched(void* ctx, long long handle,
+                           int timeout_ms) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  int64_t deadline = now_ns() + int64_t(timeout_ms) * 1000000;
+  for (;;) {
+    // sample the doorbell BEFORE the scan: a publish between the
+    // failed scan and the park then fails the futex compare and we
+    // re-scan immediately instead of sleeping through the wake
+    uint32_t seen = c->seg->doorbell.load(std::memory_order_acquire);
+    long long id = take_matched(c, handle);
+    if (id) return id;
+    int64_t left_ms = (deadline - now_ns()) / 1000000;
+    if (left_ms <= 0) return 0;
+    int slice = (int)std::min<int64_t>(left_ms, 100);
+    c->seg->doorbell_waiters.fetch_add(1, std::memory_order_acq_rel);
+    futex_wait(&c->seg->doorbell, seen, slice);
+    c->seg->doorbell_waiters.fetch_sub(1, std::memory_order_acq_rel);
+  }
+}
+
 // MPI_Iprobe over the unexpected queue: first compatible envelope,
 // not consumed. Returns 1 and fills out-params, else 0.
 int shm_match_probe(void* ctx, int cid, int src, int dst, int tag,

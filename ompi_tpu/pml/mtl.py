@@ -78,16 +78,48 @@ class MtlComponent(mca.Component):
 class _MatchedRecv(Request):
     """A receive posted into the native matching engine."""
 
-    def __init__(self, mtl: "FabricMtl", handle: int, comm) -> None:
+    def __init__(self, mtl: "FabricMtl", handle: int, comm,
+                 domain=None) -> None:
         super().__init__()
         self._mtl = mtl
         self.handle = handle
         self._comm = comm
+        self._dom = domain
 
     def _poll(self) -> bool:
         if not self.done:
             self._mtl.progress()
         return self.done
+
+    def wait(self, timeout: float | None = None) -> Status:
+        """Blocking wait: when the matching domain offers a native
+        blocking collector (the shm engine), park IN the engine until
+        this handle matches — no per-message Python progress. Slices
+        re-check done so a concurrent progress() collector winning the
+        race cannot strand us."""
+        import time as _time
+
+        waiter = getattr(self._dom, "wait_matched", None)
+        if waiter is None or self.done:
+            return super().wait(timeout)
+        from . import fabric as _f
+
+        to = timeout if timeout is not None else _f.default_timeout()
+        deadline = _time.monotonic() + to
+        while not self.done:
+            left = deadline - _time.monotonic()
+            if left <= 0:
+                break
+            payload = waiter(self.handle, min(left, 0.05))
+            if payload is not None:
+                with self._mtl._lock:
+                    self._mtl._outstanding.pop(self.handle, None)
+                self._mtl._deliver(self, self._comm, payload)
+                break
+        # hand super() only the REMAINING budget — the native park
+        # already consumed its share (a fresh full timeout here would
+        # double the caller's wait on the miss path)
+        return super().wait(max(0.001, deadline - _time.monotonic()))
 
 
 @MTL.register
@@ -151,7 +183,7 @@ class FabricMtl(MtlComponent):
     # -- remote domain: the real offload -----------------------------------
 
     def isend_remote(self, comm, value, src, dst, tag) -> Request:
-        from . import fabric as fmod
+        from . import fabric as fmod  # sys.modules hit after first call
 
         eng = self._fabric_engine()
         dst_idx = comm.procs[dst].process_index
@@ -193,6 +225,7 @@ class FabricMtl(MtlComponent):
         if source is not None and source >= 0:
             idx = comm.procs[source].process_index
             return eng.shm if self._shm_owns(eng, idx) else eng.ep
+        # NOT cached: elastic shrink/re-wire can renumber processes
         me = jax.process_index()
         remote = {p.process_index for p in comm.procs
                   if p.process_index != me}
@@ -217,6 +250,7 @@ class FabricMtl(MtlComponent):
         with self._lock:
             self._outstanding[handle] = req
         dom = self._match_domain(eng, comm, source)
+        req._dom = dom
         payload = dom.post_recv(handle, comm.cid,
                                 -1 if source is None else source,
                                 dst, tag)

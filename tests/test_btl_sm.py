@@ -253,6 +253,48 @@ def test_shm_native_matching_offload():
         b.close()
 
 
+def test_shm_wait_matched_blocking():
+    """The native blocking collector: parks on the doorbell futex until
+    THIS handle matches (other handles' matches stay queued), honors
+    the timeout, and wakes promptly on arrival."""
+    from ompi_tpu.pml import fabric as fmod
+
+    a, b = _pair()
+    tag = 0x4D544C4D
+    b.enable_matching(tag)
+    try:
+        # timeout path: nothing posted/sent -> None after ~the budget
+        b.post_recv(301, 6, 0, 1, 5)
+        t0 = time.monotonic()
+        assert b.wait_matched(301, 0.15) is None
+        assert 0.1 <= time.monotonic() - t0 < 2.0
+
+        # wake path: a waiter thread parks, the send releases it with
+        # the right payload; an unrelated handle's match stays queued
+        b.post_recv(302, 6, 0, 1, 6)
+        got = {}
+
+        def waiter():
+            got["p"] = b.wait_matched(302, 10.0)
+
+        t = threading.Thread(target=waiter)
+        t.start()
+        time.sleep(0.05)  # let it park
+        a.send_bytes(1, tag, fmod.encode_fast(6, 0, 1, 5,  0,
+                                              np.float32(1.0)))
+        a.send_bytes(1, tag, fmod.encode_fast(6, 0, 1, 6, 1,
+                                              np.float32(2.0)))
+        t.join(10)
+        assert not t.is_alive()
+        assert float(fmod.decode_fast(got["p"])["pay"].to_array()) == 2.0
+        # handle 301's match was NOT consumed by 302's waiter
+        p301 = b.wait_matched(301, 5.0)
+        assert float(fmod.decode_fast(p301)["pay"].to_array()) == 1.0
+    finally:
+        a.close()
+        b.close()
+
+
 def test_fastbox_overflow_falls_through_to_ring():
     """A burst of tiny messages larger than the 4 KiB fastbox keeps
     flowing (reference: fbox_sendi returns false -> regular path)."""
