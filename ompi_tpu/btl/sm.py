@@ -542,17 +542,25 @@ class ShmEndpoint:
         """Block NATIVELY until `handle`'s posted recv matches (sweep +
         doorbell futex in C — no Python progress per message); returns
         the payload, or None on timeout. Other handles' matches are
-        left for their own collectors."""
-        self._begin("wait_matched")
-        try:
-            msgid = self._lib.shm_wait_matched(
-                self._ctx, handle, max(1, int(timeout * 1000))
-            )
-            if not msgid:
+        left for their own collectors. Parks in <=100 ms slices per
+        guard entry (same discipline as _wait_msg) so a concurrent
+        close() observes the drain within one slice instead of
+        stalling its 5 s deadline and leaking the mapping."""
+        deadline = time.monotonic() + timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
                 return None
-            return self._read_matched_locked(msgid)
-        finally:
-            self._end()
+            slice_ms = max(1, min(100, int(remaining * 1000)))
+            self._begin("wait_matched")
+            try:
+                msgid = self._lib.shm_wait_matched(
+                    self._ctx, handle, slice_ms
+                )
+                if msgid:
+                    return self._read_matched_locked(msgid)
+            finally:
+                self._end()
 
     def poll_matched(self):
         """(handle, payload) of one sweep-side match, or None."""

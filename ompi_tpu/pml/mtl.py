@@ -246,11 +246,10 @@ class FabricMtl(MtlComponent):
     def irecv_remote(self, comm, source, dst, tag) -> Request:
         eng = self._fabric_engine()
         handle = next(self._handles)
-        req = _MatchedRecv(self, handle, comm)
+        dom = self._match_domain(eng, comm, source)
+        req = _MatchedRecv(self, handle, comm, domain=dom)
         with self._lock:
             self._outstanding[handle] = req
-        dom = self._match_domain(eng, comm, source)
-        req._dom = dom
         payload = dom.post_recv(handle, comm.cid,
                                 -1 if source is None else source,
                                 dst, tag)

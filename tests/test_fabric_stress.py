@@ -22,6 +22,7 @@ _WORKER = textwrap.dedent(r"""
     pid = int(sys.argv[1])
     nprocs = int(sys.argv[2])
     coord = sys.argv[3]
+    pml = sys.argv[4] if len(sys.argv) > 4 else "ob1"
     os.environ["XLA_FLAGS"] = (
         os.environ.get("XLA_FLAGS", "")
         + " --xla_force_host_platform_device_count=2"
@@ -38,6 +39,7 @@ _WORKER = textwrap.dedent(r"""
         local_device_ids=[0, 1],
     )
     config.set("pml_fabric_pipeline_segment", 32 * 1024)
+    config.set("pml_select", pml)
     world = ompi_tpu.init()   # ranks 0,1 <-> 2,3
     eng = fabric.wire_up()
 
@@ -103,7 +105,11 @@ def _free_port() -> int:
     return port
 
 
-def test_two_process_threaded_p2p_storm():
+@pytest.mark.parametrize("pml", ["ob1", "cm"])
+def test_two_process_threaded_p2p_storm(pml):
+    """ob1: Python matching + rendezvous. cm: the native matchers —
+    concurrent posted recvs, per-handle wait_matched isolation, and
+    CMA-tier frames under 4 sender + 4 receiver threads."""
     nprocs = 2
     coord = f"127.0.0.1:{_free_port()}"
     env = dict(os.environ)
@@ -111,7 +117,7 @@ def test_two_process_threaded_p2p_storm():
     procs = [
         subprocess.Popen(
             [sys.executable, "-c", _WORKER, str(pid), str(nprocs),
-             coord],
+             coord, pml],
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
             env=env, cwd="/root/repo",
         )
