@@ -504,14 +504,17 @@ def _shm_2proc() -> dict:
 _FABRIC_PERF_WORKER = r"""
 import json, os, sys, time
 pid = int(sys.argv[1]); coord = sys.argv[2]; nprocs = int(sys.argv[3])
+pml = sys.argv[4] if len(sys.argv) > 4 else "ob1"
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
                            + " --xla_force_host_platform_device_count=2")
 import jax
 jax.config.update("jax_platforms", "cpu")
 import numpy as np
 import ompi_tpu
+from ompi_tpu.core import config as _config
 from ompi_tpu.pml import fabric
 
+_config.set("pml_select", pml)
 jax.distributed.initialize(coordinator_address=coord,
                            num_processes=nprocs, process_id=pid,
                            local_device_ids=[0, 1])
@@ -560,14 +563,26 @@ print("WORKER %d OK" % pid, flush=True)
 def _fabric_2proc() -> dict:
     """MPI-level p2p perf ACROSS two controller processes (pml/fabric
     over shm/DCN): small-message ping-pong RTT (the fastbox/eager
-    regime) and 8 MiB rendezvous bandwidth. Host/CPU subprocesses —
-    no TPU in the path."""
+    regime) and 8 MiB rendezvous bandwidth, under ob1 (default,
+    Python matching) AND cm (native-matcher offload with native
+    blocking waits). Host/CPU subprocesses — no TPU in the path."""
     try:
         from ompi_tpu.native import build
 
         if not build.available():
             return {"skipped": "native library unavailable"}
-        return _run_pair(_FABRIC_PERF_WORKER, "FABRICPERF", 2)
+        row = _run_pair(_FABRIC_PERF_WORKER, "FABRICPERF", 2)
+        if "p50_small_rtt_us" not in row:
+            return row  # ob1 baseline failed: report that, skip cm
+        cm = _run_pair(_FABRIC_PERF_WORKER, "FABRICPERF", 2, "cm")
+        if "p50_small_rtt_us" in cm:
+            row["p50_small_rtt_us_cm"] = cm["p50_small_rtt_us"]
+            row["gbps_8MiB_mpi_cm"] = cm.get("gbps_8MiB_mpi")
+        else:
+            # a missing cm row must be distinguishable from a bench
+            # that never measured cm (it is round-5 evidence)
+            row["cm_error"] = cm.get("error", "no FABRICPERF line")
+        return row
     except Exception as exc:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
