@@ -182,14 +182,27 @@ def _rndv_meta(value):
 
 
 
-def encode_fast(cid: int, src: int, dst: int, tag: int, seq: int,
-                arr: np.ndarray) -> bytes:
+def encode_fast_parts(cid: int, src: int, dst: int, tag: int, seq: int,
+                      arr: np.ndarray):
+    """(header bytes, payload view) — the frame WITHOUT materializing
+    it: gather-capable transports send the pair as two iovecs, so bulk
+    frames never pay a tobytes+concat copy on the sender."""
     shape = list(arr.shape) + [0] * (_FAST_MAX_DIMS - arr.ndim)
     hdr = _FAST_HDR.pack(
         _FAST_MAGIC, cid, src, dst, tag, seq, arr.ndim,
         arr.dtype.str.encode().ljust(8, b"\0"), *shape,
     )
-    return hdr + arr.tobytes()
+    if arr.ndim and arr.flags["C_CONTIGUOUS"]:
+        view = memoryview(arr).cast("B")
+    else:  # 0-d, Fortran-order or strided: materialize (tobytes copies)
+        view = memoryview(arr.tobytes())
+    return hdr, view
+
+
+def encode_fast(cid: int, src: int, dst: int, tag: int, seq: int,
+                arr: np.ndarray) -> bytes:
+    hdr, view = encode_fast_parts(cid, src, dst, tag, seq, arr)
+    return hdr + bytes(view)
 
 
 def decode_fast(raw: bytes) -> dict:

@@ -201,15 +201,19 @@ class FabricMtl(MtlComponent):
         arr = fmod._fast_eligible(value, 1 << 62)
         if arr is None or (arr.dtype == np.uint8 and arr.ndim == 1):
             arr = np.frombuffer(fmod.pack_value(value), np.uint8)
-        frame = fmod.encode_fast(comm.cid, src, dst, tag, seq, arr)
+        hdr, view = fmod.encode_fast_parts(
+            comm.cid, src, dst, tag, seq, arr)
         if self._shm_owns(eng, dst_idx):
-            eng.shm.send_bytes(dst_idx, MTL_MATCH_TAG, frame)
+            # gather send: header + payload as two iovecs — bulk
+            # frames never materialize (the CMA descriptor carries
+            # both source segments)
+            eng.shm.send_bytes2(dst_idx, MTL_MATCH_TAG, hdr, view)
         else:
             pid = eng.peer_ids.get(dst_idx)
             if pid is None:
                 raise CommError(f"no fabric wiring to process {dst_idx}")
             eng.ep.check_peer(pid, what=f"process {dst_idx}")
-            eng.ep.send_bytes(pid, MTL_MATCH_TAG, frame)
+            eng.ep.send_bytes(pid, MTL_MATCH_TAG, hdr + bytes(view))
         SPC.record("mtl_remote_sends")
         # cm semantics: the matching transport owns buffering; local
         # completion on hand-off (the engine copies the frame).
