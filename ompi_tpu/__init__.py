@@ -29,13 +29,15 @@ def __getattr__(name):
     lazy = {
         "init", "finalize", "initialized", "COMM_WORLD", "COMM_SELF",
         "world", "abort",
+        "Psend_init", "Precv_init", "Pready", "Pready_range",
+        "Pready_list", "Parrived",
     }
     try:
         if name in lazy:
             api = importlib.import_module(".api", __name__)
             return getattr(api, name)
         if name in ("coll", "datatype", "pml", "runtime", "osc", "topo",
-                    "parallel", "pgas", "io", "monitoring", "ft"):
+                    "parallel", "pgas", "io", "monitoring", "ft", "part"):
             return importlib.import_module(f".{name}", __name__)
     except ImportError as exc:
         raise AttributeError(

@@ -149,6 +149,41 @@ def abort(error_code: int = 1) -> None:
     os._exit(error_code)
 
 
+# -- MPI-4 partitioned communication (reference: ompi/mca/part) -------------
+
+def Psend_init(comm, value, partitions: int, dest: int, tag: int = 0, *,
+               source=None):
+    """MPI_Psend_init: a persistent partitioned send of `value` split
+    into `partitions` contiguous partitions."""
+    return comm.psend_init(value, partitions, dest, tag, source=source)
+
+
+def Precv_init(comm, partitions: int, source: int, tag: int = 0, *,
+               dest: int, like):
+    """MPI_Precv_init: `like` supplies the receive shape/dtype."""
+    return comm.precv_init(partitions, source, tag, dest=dest, like=like)
+
+
+def Pready(request, partition: int) -> None:
+    """MPI_Pready: mark one send partition filled (eager drain)."""
+    request.pready(partition)
+
+
+def Pready_range(request, lo: int, hi: int) -> None:
+    """MPI_Pready_range (inclusive bounds, matching the MPI binding)."""
+    request.pready_range(lo, hi)
+
+
+def Pready_list(request, partitions) -> None:
+    """MPI_Pready_list."""
+    request.pready_list(partitions)
+
+
+def Parrived(request, partition: int) -> bool:
+    """MPI_Parrived: poll one receive partition's completion."""
+    return request.parrived(partition)
+
+
 class _CommProxy:
     """Module-level COMM_WORLD / COMM_SELF handles that resolve lazily
     (usable before init; raise cleanly if the runtime is down)."""

@@ -66,6 +66,19 @@ class CollComponent(mca.Component):
     def provided(self) -> list[str]:
         return [op for op in OPERATIONS if hasattr(self, op)]
 
+    def persistent_program(self, comm, opname: str, x, args):
+        """Pre-bound dispatch for persistent collectives: return
+        ``prog(buffer) -> pending`` with every per-call decision
+        (validation, algorithm choice, cache-key build, plan lookup)
+        already resolved against (comm, args) — or None when the
+        operation has no clean single-plan form (e.g. root-sliced
+        reduce, ragged variants). PersistentColl binds the program on
+        first start(); every subsequent start() is then one plan
+        launch, skipping the vtable/_coll_call path entirely (the
+        pcollreq promise: MPI_Start must be cheaper than a fresh
+        call)."""
+        return None
+
 
 def select_for_comm(comm) -> dict[str, tuple[Any, Callable]]:
     """Merge per-operation tables: for each op, the highest-priority
@@ -104,6 +117,10 @@ def compile_plan(
 
     import jax
     from jax.sharding import PartitionSpec as P
+
+    from ..core import jax_compat
+
+    jax_compat.ensure()
 
     mesh = comm.mesh
 
@@ -193,18 +210,63 @@ class PersistentColl(Request):
         self._args = args
         self.buffer = x
         self._pending = None
+        self._dispatch = None  # resolved once, on first start()
 
     def bind(self, x: Any) -> None:
         """Rebind the input buffer (same shape/dtype reuses the plan)."""
         self.buffer = x
 
-    def _start(self) -> None:
-        if self._opname == "barrier":  # the one bufferless operation
-            self._pending = self._comm._coll_call("barrier")
-        else:
-            self._pending = self._comm._coll_call(
-                self._opname, self.buffer, *self._args
+    def _resolve(self) -> None:
+        """First-start binding: ask the providing component for a
+        pre-bound program; fall back to a direct (vtable-resolved once)
+        component call for operations without a plan form. Either way,
+        later starts never re-enter _coll_call — no vtable lookup, no
+        SPC/memchecker/monitor interposition, no per-call decision."""
+        comm = self._comm
+        comm._check_alive()
+        entry = comm._coll.get(self._opname)
+        if entry is None:
+            raise CommError(
+                f"{comm.name}: no coll component provides {self._opname}"
             )
+        component, fn = entry
+        prog = component.persistent_program(
+            comm, self._opname, self.buffer, self._args
+        )
+        if prog is not None:
+            self._dispatch = prog
+        elif self._opname == "barrier":  # the one bufferless operation
+            self._dispatch = lambda _x, f=fn, c=comm: f(c)
+        else:
+            self._dispatch = (
+                lambda x, f=fn, c=comm, a=self._args: f(c, x, *a)
+            )
+        # Monitoring/memchecker interposition happens once, at bind
+        # time — started iterations are pure dispatch (the documented
+        # pcollreq trade; DESIGN.md §11).
+        from ..core import memchecker
+
+        if memchecker.enabled() and self.buffer is not None:
+            memchecker.check_defined(self.buffer,
+                                     f"{self._opname} buffer")
+        from ..monitoring import MONITOR
+
+        if MONITOR.enabled and self.buffer is not None:
+            import jax
+
+            nbytes = sum(
+                leaf.nbytes for leaf in jax.tree.leaves(self.buffer)
+                if hasattr(leaf, "nbytes")
+            )
+            MONITOR.record_coll(comm.cid, self._opname, nbytes)
+
+    def _start(self) -> None:
+        if self._dispatch is None:
+            self._resolve()
+        from ..core.counters import SPC
+
+        SPC.record(f"coll_persistent_{self._opname}_starts")
+        self._pending = self._dispatch(self.buffer)
 
     def _poll(self) -> bool:
         if self.done:

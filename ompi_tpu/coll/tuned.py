@@ -443,11 +443,10 @@ class TunedColl(XlaColl):
     PRIORITY = 80
     DESCRIPTION = "algorithm decision layer (reference: coll/tuned)"
 
-    def allreduce(self, comm, x, op):
-        op = op_lookup(op)
-        x = _leaf_check(comm, x)
-        if comm.size == 1:
-            return x
+    def _allreduce_plan(self, comm, x, op):
+        """Decision + compiled plan for allreduce; x is leaf-checked
+        and comm.size > 1. The whole per-call decision pipeline lives
+        here so persistent_program can resolve it once."""
         algo = decide_allreduce(op, _nbytes(x), comm.size)
         if is_pallas_algo(algo):
             _pallas_algos()
@@ -477,9 +476,15 @@ class TunedColl(XlaColl):
         from ..core.counters import SPC
 
         SPC.record(f"coll_allreduce_algo_{algo}")
-        plan = compile_plan(comm, key, per_rank,
+        return compile_plan(comm, key, per_rank,
                             check_vma=not is_pallas_algo(algo))
-        return plan(x)
+
+    def allreduce(self, comm, x, op):
+        op = op_lookup(op)
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return x
+        return self._allreduce_plan(comm, x, op)(x)
 
     def alltoall(self, comm, x):
         x = rank_major_check(comm, x, min_ndim=2)

@@ -46,16 +46,30 @@ class XlaColl(CollComponent):
     PRIORITY = 40
     DESCRIPTION = "XLA-native fabric collectives (psum/all_gather/...)"
 
+    def _allreduce_plan(self, comm, x, op):
+        """The compiled program behind allreduce; x is leaf-checked and
+        comm.size > 1. Split out so persistent_program can hand the
+        bound plan to PersistentColl."""
+        key = ("allreduce", "native", op.cache_key, _dtype_key(x))
+        return compile_plan(
+            comm, key, lambda b: spmd.allreduce_native(b, "ranks", op)
+        )
+
     def allreduce(self, comm, x, op):
         op = op_lookup(op)
         x = _leaf_check(comm, x)
         if comm.size == 1:
             return x
-        key = ("allreduce", "native", op.cache_key, _dtype_key(x))
-        plan = compile_plan(
-            comm, key, lambda b: spmd.allreduce_native(b, "ranks", op)
-        )
-        return plan(x)
+        return self._allreduce_plan(comm, x, op)(x)
+
+    def persistent_program(self, comm, opname, x, args):
+        if opname != "allreduce":
+            return None
+        op = op_lookup(args[0])
+        x = _leaf_check(comm, x)
+        if comm.size == 1:
+            return lambda b: b
+        return self._allreduce_plan(comm, x, op)
 
     def bcast(self, comm, x, root):
         x = _leaf_check(comm, x)

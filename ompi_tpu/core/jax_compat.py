@@ -1,0 +1,72 @@
+"""jax API compatibility: one place for version-gated aliases.
+
+The codebase targets the modern `jax.shard_map` entry point
+(keyword-only mesh/in_specs/out_specs, `check_vma=`). On older jax
+builds (< 0.6) that function lives at
+`jax.experimental.shard_map.shard_map` with the replication check
+spelled `check_rep=`. `ensure()` installs a translating alias onto the
+`jax` module when the top-level name is absent, so every call site —
+library, tests, bench — can use the one modern spelling regardless of
+the installed jax.
+"""
+
+from __future__ import annotations
+
+
+def ensure() -> None:
+    """Idempotent: install `jax.shard_map` / `jax.lax.axis_size` if
+    this jax predates them."""
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _legacy
+
+        def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True,
+                      **kw):
+            return _legacy(
+                f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                check_rep=check_vma, **kw,
+            )
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        from jax._src import core as _core
+
+        def axis_size(axis_name):
+            return _core.get_axis_env().axis_size(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    # ShapeDtypeStruct grew a `vma` kwarg (varying-manual-axes metadata
+    # for shard_map's replication checks) after 0.4.x; every use here is
+    # inside check_vma=False regions, so dropping it is sound.
+    try:
+        jax.ShapeDtypeStruct((1,), "float32", vma=frozenset())
+    except TypeError:
+        _SDS = jax.ShapeDtypeStruct
+
+        class ShapeDtypeStruct(_SDS):
+            def __init__(self, shape, dtype, *a, vma=None, **kw):
+                super().__init__(shape, dtype, *a, **kw)
+
+        jax.ShapeDtypeStruct = ShapeDtypeStruct
+
+    try:
+        from jax.experimental.pallas import tpu as _pltpu
+
+        if not hasattr(_pltpu, "CompilerParams") and hasattr(
+                _pltpu, "TPUCompilerParams"):
+            import dataclasses as _dc
+
+            _fields = {f.name for f in
+                       _dc.fields(_pltpu.TPUCompilerParams)}
+
+            def CompilerParams(**kw):
+                return _pltpu.TPUCompilerParams(
+                    **{k: v for k, v in kw.items() if k in _fields}
+                )
+
+            _pltpu.CompilerParams = CompilerParams
+    except Exception:
+        pass

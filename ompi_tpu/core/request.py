@@ -20,7 +20,7 @@ from dataclasses import dataclass, field
 from typing import Any, Callable, Optional, Sequence
 
 from . import progress as _progress
-from .errors import RequestError
+from .errors import ArgumentError, RequestError
 
 ANY_SOURCE = -1
 ANY_TAG = -1
@@ -159,6 +159,95 @@ class CompletedRequest(Request):
     def __init__(self, result: Any = None, status: Status | None = None):
         super().__init__()
         self._complete(result, status)
+
+
+class PartitionedRequest(Request):
+    """MPI-4 partitioned-communication handle (MPI_Psend_init /
+    MPI_Precv_init; reference: ompi/mca/part/persist's
+    ompi_part_persist_request_t). The user declares N partitions of one
+    buffer; a part component maps them onto M internal transfers. This
+    base type owns the partition bookkeeping and the Pready / Parrived
+    argument contract; components implement the transfer machinery via
+    the `_partition_ready` / `_partition_arrived` hooks.
+
+    Semantics (MPI-4 §4.2): the request is persistent — start()
+    re-arms it and resets every partition to not-ready; Pready is legal
+    only on an active send-side request and only once per partition per
+    start cycle; Parrived polls an active (or completed) receive-side
+    request and may be called repeatedly, before or after overall
+    completion."""
+
+    def __init__(self, partitions: int, *, sending: bool) -> None:
+        if partitions < 1:
+            raise ArgumentError(
+                f"partitioned request needs >= 1 partition, got {partitions}"
+            )
+        super().__init__(persistent=True)
+        self.partitions = partitions
+        self.sending = sending
+        self._flagged = [False] * partitions
+
+    def _check_partition(self, partition: int) -> int:
+        if not 0 <= partition < self.partitions:
+            raise ArgumentError(
+                f"partition {partition} out of range "
+                f"[0, {self.partitions})"
+            )
+        return partition
+
+    def pready(self, partition: int) -> None:
+        """MPI_Pready: mark one send partition filled; the component may
+        drain it (and any transfer it completes) immediately."""
+        if not self.sending:
+            raise RequestError("Pready on a receive-side partitioned request")
+        if self.state is not RequestState.ACTIVE:
+            raise RequestError("Pready on a partitioned request that is "
+                               "not active (call start() first)")
+        p = self._check_partition(partition)
+        if self._flagged[p]:
+            raise RequestError(
+                f"Pready: partition {p} already marked ready this cycle"
+            )
+        self._flagged[p] = True
+        self._partition_ready(p)
+
+    def pready_range(self, lo: int, hi: int) -> None:
+        """MPI_Pready_range: inclusive bounds, matching the MPI binding."""
+        self._check_partition(lo)
+        self._check_partition(hi)
+        if hi < lo:
+            raise ArgumentError(f"Pready_range: hi {hi} < lo {lo}")
+        for p in range(lo, hi + 1):
+            self.pready(p)
+
+    def pready_list(self, partitions: Sequence[int]) -> None:
+        """MPI_Pready_list."""
+        for p in partitions:
+            self.pready(p)
+
+    def parrived(self, partition: int) -> bool:
+        """MPI_Parrived: has this receive partition fully arrived?"""
+        if self.sending:
+            raise RequestError("Parrived on a send-side partitioned request")
+        self._check_partition(partition)
+        if self.state is RequestState.INACTIVE:
+            raise RequestError("Parrived on a partitioned request that is "
+                               "not active (call start() first)")
+        return self._partition_arrived(partition)
+
+    def start(self) -> "Request":
+        if self.state is RequestState.ACTIVE:
+            raise RequestError("start() on already-active request")
+        self._flagged = [False] * self.partitions
+        return super().start()
+
+    # -- component hooks --------------------------------------------------
+
+    def _partition_ready(self, partition: int) -> None:
+        raise NotImplementedError
+
+    def _partition_arrived(self, partition: int) -> bool:
+        raise NotImplementedError
 
 
 class GeneralizedRequest(Request):

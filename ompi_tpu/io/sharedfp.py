@@ -16,12 +16,16 @@ sharedfp_lockedfile_request_position.c). Components:
 from __future__ import annotations
 
 import fcntl
+import hashlib
+import mmap
 import os
 import struct
 import threading
+import time
 from typing import Any
 
 from ..core import component as mca
+from ..core import config
 from ..core.errors import IOError_
 
 SHAREDFP = mca.framework("sharedfp", "shared file pointer")
@@ -134,6 +138,192 @@ class LockedFileSharedfp(SharedfpComponent):
             state,
             lambda fd: struct.unpack("<q", os.pread(fd, 8, 0))[0],
         )
+
+
+def _winseg_usable() -> bool:
+    try:
+        from ..native import build
+
+        lib = build.get_lib()
+        return lib is not None and hasattr(lib, "winseg_open")
+    except Exception:
+        return False
+
+
+class _WinsegPointer:
+    """64-bit offset in a native winseg int32 word array: word 0 is a
+    CAS spinlock, words 1/2 hold the offset split into two 31-bit
+    halves (the array is signed int32; 31-bit halves keep both words
+    non-negative)."""
+
+    def __init__(self, name: str) -> None:
+        from ..btl.sm import WinSyncSeg
+
+        try:
+            self.seg = WinSyncSeg(name, 4, create=True)
+        except Exception:
+            self.seg = WinSyncSeg(name, 4, create=False)
+
+    def _locked(self, fn):
+        spins = 0
+        while self.seg.cas(0, 0, 1) != 0:
+            spins += 1
+            if spins % 256 == 0:
+                time.sleep(0.0001)
+        try:
+            return fn()
+        finally:
+            self.seg.store(0, 0)
+
+    def _read(self) -> int:
+        return self.seg.load(2) * (1 << 31) + self.seg.load(1)
+
+    def _write(self, v: int) -> None:
+        self.seg.store(1, v & 0x7FFFFFFF)
+        self.seg.store(2, v >> 31)
+
+    def fetch_add(self, n: int) -> int:
+        def go():
+            old = self._read()
+            self._write(old + n)
+            return old
+
+        return self._locked(go)
+
+    def seek(self, pos: int) -> None:
+        self._locked(lambda: self._write(pos))
+
+    def position(self) -> int:
+        return self._locked(self._read)
+
+    def close(self) -> None:
+        self.seg.close()
+
+
+class _MmapPointer:
+    """Fallback segment when the native library is absent: the offset
+    word lives in an mmap'd file under /dev/shm (plain tmpdir when the
+    host has no POSIX-shm mount); updates are serialized by flock on
+    the segment fd. Same shm-resident pointer, kernel-lock arbitration
+    instead of CPU CAS."""
+
+    def __init__(self, name: str) -> None:
+        base = "/dev/shm" if os.path.isdir("/dev/shm") else None
+        if base is None:
+            import tempfile
+
+            base = tempfile.gettempdir()
+        self.path = os.path.join(base, name)
+        try:
+            self.fd = os.open(self.path,
+                              os.O_RDWR | os.O_CREAT | os.O_EXCL, 0o644)
+            self.creator = True
+        except FileExistsError:
+            self.fd = os.open(self.path, os.O_RDWR)
+            self.creator = False
+        if os.fstat(self.fd).st_size < 8:
+            os.ftruncate(self.fd, 8)
+        self.mm = mmap.mmap(self.fd, 8)
+
+    def _locked(self, fn):
+        fcntl.flock(self.fd, fcntl.LOCK_EX)
+        try:
+            return fn()
+        finally:
+            fcntl.flock(self.fd, fcntl.LOCK_UN)
+
+    def fetch_add(self, n: int) -> int:
+        def go():
+            (old,) = struct.unpack("<q", self.mm[:8])
+            self.mm[:8] = struct.pack("<q", old + n)
+            return old
+
+        return self._locked(go)
+
+    def seek(self, pos: int) -> None:
+        self._locked(
+            lambda: self.mm.__setitem__(slice(0, 8), struct.pack("<q", pos))
+        )
+
+    def position(self) -> int:
+        return self._locked(lambda: struct.unpack("<q", self.mm[:8])[0])
+
+    def close(self) -> None:
+        self.mm.close()
+        os.close(self.fd)
+        if self.creator:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+
+
+@SHAREDFP.register
+class SmSharedfp(SharedfpComponent):
+    """Shared pointer as an atomically-updated offset word in a shm
+    segment (reference: ompi/mca/sharedfp/sm — sharedfp_sm.h keeps a
+    `struct mca_sharedfp_sm_offset` in an mmap'd segment guarded by a
+    process-shared mutex). Both sides derive the segment name from the
+    file path, so any same-host controller process attaching the same
+    file lands on the same pointer word."""
+
+    NAME = "sm"
+    PRIORITY = 25
+    DESCRIPTION = "shm-segment shared pointer (reference: sharedfp/sm)"
+
+    def available(self, **ctx: Any) -> bool:
+        if (config.get("sharedfp_select", "") or "").strip() == "sm":
+            return True  # forced: the filter cvar already excluded others
+        fh = ctx.get("fh")
+        if fh is None or fh.path.startswith(("gs://", "s3://")):
+            return False
+        # Natural selection: only when the comm is same-host-complete
+        # across controller processes (every remote process is a wired
+        # shm peer — the btl/sm reachability test). Single-controller
+        # comms stay with the driver component's zero-IO mutex.
+        from ..runtime.proc import spans_processes
+
+        if not spans_processes(fh.comm):
+            return False
+        try:
+            from ..pml.framework import PML
+
+            eng = getattr(PML.component("ob1"), "_fabric", None)
+        except Exception:
+            return False
+        if eng is None:
+            return False
+        shm_peers = getattr(eng, "shm_peers", set())
+        import jax
+
+        me = jax.process_index()
+        return all(
+            p.process_index == me or p.process_index in shm_peers
+            for p in fh.comm.procs
+        )
+
+    @staticmethod
+    def _seg_name(path: str) -> str:
+        digest = hashlib.sha1(os.path.abspath(path).encode()).hexdigest()
+        return f"ompi_tpu_sfp_{digest[:16]}"
+
+    def attach(self, fh) -> Any:
+        name = self._seg_name(fh.path)
+        if _winseg_usable():
+            return _WinsegPointer(name)
+        return _MmapPointer(name)
+
+    def detach(self, state: Any) -> None:
+        state.close()
+
+    def fetch_add(self, state: Any, n_etypes: int) -> int:
+        return state.fetch_add(n_etypes)
+
+    def seek(self, state: Any, pos_etypes: int) -> None:
+        state.seek(pos_etypes)
+
+    def position(self, state: Any) -> int:
+        return state.position()
 
 
 def select(fh=None) -> SharedfpComponent:

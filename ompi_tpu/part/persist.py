@@ -1,0 +1,411 @@
+"""part/persist — default partitioned-communication component.
+
+TPU-native equivalent of ompi/mca/part/persist (reference:
+part_persist.h / part_persist_sendreq.h — partitioned requests layered
+on persistent point-to-point: the user's N partitions are re-blocked
+onto M internal transfers, each an ordinary pml send/recv; Pready flags
+partitions and transfers drain EAGERLY, out of order, the moment every
+partition overlapping a transfer's range is flagged — no waiting for
+the full buffer).
+
+Driver-model mapping:
+
+- Both sides independently derive the SAME internal-transfer count T
+  from the total payload (element count x itemsize) and the shared
+  ``part_persist_transfer_bytes`` / ``part_persist_max_transfers``
+  cvars, so no sender/receiver handshake is needed. Partitions on
+  either side are views over one common flattened element space and
+  transfers are block ranges of it (framework.block_range), which keeps
+  the mismatched case (N sender partitions vs M receiver partitions)
+  well-defined — MPI-4 only requires the two sides' TOTAL element
+  counts to agree.
+- Transfer k moves its element range as an ordinary pml isend tagged in
+  a derived namespace: (user_tag + 1) * part_persist_tag_stride + k.
+  Partitioned traffic therefore rides the same shm/DCN fabric as every
+  other message. MPI-4 semantics delta (documented in DESIGN.md §11):
+  user traffic on the same (src, dst) must stay below the stride or
+  use tags outside the derived band, and wildcard source/tag matching
+  is not available for partitioned receives.
+- The receive side cannot pre-post: pml/cm matches local traffic in
+  strict program order (a recv with no in-flight send raises), so
+  draining is probe-then-recv — legal under both pmls because after a
+  successful iprobe the matching irecv completes immediately (ob1 pops
+  its unexpected queue, including parked rendezvous sends; cm pops its
+  program-order queue).
+- Draining is pumped from the progress engine: the component registers
+  one callback sweeping every active partitioned receive, so a sender
+  blocked in wait() drives its peer's arrivals (the single-controller
+  analog of part/persist's ompi_part_persist_progress).
+"""
+
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Optional
+
+from ..core import config
+from ..core import progress as _progress
+from ..core.counters import SPC
+from ..core.errors import ArgumentError, CommError, RequestError, TagError
+from ..core.request import PartitionedRequest, RequestState, Status
+from .framework import PART, PartComponent, block_range
+
+_V = partial(config.register, "part", "persist")
+_transfer_bytes = _V(
+    "transfer_bytes", type=int, default=256 << 10,
+    description="target bytes per internal transfer; a partitioned "
+                "buffer drains as ceil(total_bytes / transfer_bytes) "
+                "pml sends (clamped by part_persist_max_transfers)",
+)
+_max_transfers = _V(
+    "max_transfers", type=int, default=64,
+    description="upper bound on internal transfers per partitioned "
+                "request",
+)
+_tag_stride = _V(
+    "tag_stride", type=int, default=4096,
+    description="derived-tag namespace width: transfer k of a "
+                "partitioned pair with user tag t travels as pml tag "
+                "(t + 1) * tag_stride + k",
+)
+
+# mpit pvars (pre-registered so MPI_T listings show them before use)
+SPC.counter("part_partitions_flagged", "send partitions marked by Pready")
+SPC.counter("part_partitions_arrived", "receive partitions completed")
+SPC.counter("part_transfers_sent", "internal partitioned transfers sent")
+SPC.counter("part_transfers_received",
+            "internal partitioned transfers drained")
+
+
+def _transfer_count(total_elems: int, itemsize: int) -> int:
+    nbytes = max(1, total_elems * itemsize)
+    t = max(1, math.ceil(nbytes / max(1, _transfer_bytes.value)))
+    return max(1, min(t, _max_transfers.value, total_elems))
+
+
+def _base_tag(tag: int) -> int:
+    if tag < 0:
+        raise TagError(
+            f"partitioned requests need a concrete tag >= 0, got {tag} "
+            "(no wildcard matching in the derived-tag namespace)"
+        )
+    return (tag + 1) * _tag_stride.value
+
+
+def _shape_dtype(like) -> tuple[tuple, Any]:
+    """Shape/dtype of the receive template (array, jax.ShapeDtypeStruct,
+    or anything np.asarray accepts)."""
+    import numpy as np
+
+    if hasattr(like, "shape") and hasattr(like, "dtype"):
+        return tuple(like.shape), np.dtype(str(like.dtype))
+    arr = np.asarray(like)
+    return tuple(arr.shape), arr.dtype
+
+
+class PersistPartSend(PartitionedRequest):
+    """Send side: Pready flags partitions; a transfer fires the moment
+    every partition overlapping its range is flagged (eager,
+    out-of-order drain — reference part_persist_pready's
+    part_persist_sendreq trigger loop)."""
+
+    def __init__(self, comp, comm, value, partitions, dest, tag,
+                 source) -> None:
+        import jax.numpy as jnp
+
+        super().__init__(partitions, sending=True)
+        self._comp = comp
+        self._comm = comm
+        self._dest = dest
+        self._tag = tag
+        self._source = source
+        self.buffer = value
+        arr = jnp.asarray(value)
+        self._elems = int(arr.size)
+        self._itemsize = int(arr.dtype.itemsize)
+        if self._elems < 1:
+            raise ArgumentError("empty partitioned send buffer")
+        if partitions > self._elems:
+            raise ArgumentError(
+                f"{partitions} partitions over {self._elems} elements"
+            )
+        _base_tag(tag)  # validate the tag up front
+        self._ntransfers = _transfer_count(self._elems, self._itemsize)
+        if self._ntransfers >= _tag_stride.value:
+            raise ArgumentError(
+                f"{self._ntransfers} transfers >= part_persist_tag_stride "
+                f"{_tag_stride.value}; raise the stride or transfer_bytes"
+            )
+        self._flat = None
+        self._fired = [False] * self._ntransfers
+        self._inner: list = []
+
+    def bind(self, value) -> None:
+        """Rebind the send buffer for the next start() (same total size
+        and dtype, so both sides' transfer mapping stays valid)."""
+        import jax.numpy as jnp
+
+        arr = jnp.asarray(value)
+        if (int(arr.size) != self._elems
+                or int(arr.dtype.itemsize) != self._itemsize):
+            raise ArgumentError(
+                "bind() must preserve the partitioned buffer's element "
+                "count and itemsize"
+            )
+        self.buffer = value
+
+    def _start(self) -> None:
+        import jax.numpy as jnp
+
+        self._flat = jnp.reshape(jnp.asarray(self.buffer), (-1,))
+        self._fired = [False] * self._ntransfers
+        self._inner = []
+
+    def _partition_ready(self, partition: int) -> None:
+        SPC.record("part_partitions_flagged")
+        for k in range(self._ntransfers):
+            if not self._fired[k] and self._covered(k):
+                self._fire(k)
+
+    def _covered(self, k: int) -> bool:
+        """Is every partition overlapping transfer k's range flagged?"""
+        lo, hi = block_range(k, self._ntransfers, self._elems)
+        for p in range(self.partitions):
+            plo, phi = block_range(p, self.partitions, self._elems)
+            if phi <= lo:
+                continue
+            if plo >= hi:
+                break
+            if not self._flagged[p]:
+                return False
+        return True
+
+    def _fire(self, k: int) -> None:
+        lo, hi = block_range(k, self._ntransfers, self._elems)
+        req = self._comm.isend(
+            self._flat[lo:hi], self._dest, _base_tag(self._tag) + k,
+            source=self._source,
+        )
+        self._fired[k] = True
+        self._inner.append(req)
+        SPC.record("part_transfers_sent")
+
+    def _poll(self) -> bool:
+        if self.done:
+            return True
+        if all(self._fired) and all(r._poll() or r.done
+                                    for r in self._inner):
+            self._complete(self.buffer, Status(
+                source=self._source if self._source is not None else -1,
+                tag=self._tag,
+                count=self._elems * self._itemsize,
+            ))
+        return self.done
+
+
+class PersistPartRecv(PartitionedRequest):
+    """Receive side: transfers drain probe-then-recv out of the pml as
+    they land; Parrived(j) is true once every transfer overlapping
+    partition j's range has drained. Draining runs from the component's
+    progress callback and from Parrived/wait polling."""
+
+    def __init__(self, comp, comm, partitions, source, tag, dest,
+                 like) -> None:
+        super().__init__(partitions, sending=False)
+        if source is None or source < 0:
+            raise ArgumentError(
+                "partitioned recv needs a concrete source rank (no "
+                "wildcard matching in the derived-tag namespace)"
+            )
+        shape, dtype = _shape_dtype(like)
+        self._comp = comp
+        self._comm = comm
+        self._source = source
+        self._tag = tag
+        self._dest = dest
+        self._shape = shape
+        self._dtype = dtype
+        self._elems = 1
+        for d in shape:
+            self._elems *= int(d)
+        self._itemsize = int(dtype.itemsize)
+        if self._elems < 1:
+            raise ArgumentError("empty partitioned recv template")
+        if partitions > self._elems:
+            raise ArgumentError(
+                f"{partitions} partitions over {self._elems} elements"
+            )
+        _base_tag(tag)  # validate the tag up front
+        self._ntransfers = _transfer_count(self._elems, self._itemsize)
+        self._got: dict[int, Any] = {}
+        self._inflight: dict[int, Any] = {}
+        self._arrived_parts = [False] * partitions
+
+    def _start(self) -> None:
+        self._got = {}
+        self._inflight = {}
+        self._arrived_parts = [False] * self.partitions
+        self._comp._activate(self)
+
+    def _drain(self) -> int:
+        """One probe-then-recv sweep over the still-missing transfers;
+        returns the number drained (progress-engine event count)."""
+        if self.state is not RequestState.ACTIVE:
+            return 0
+        n = 0
+        for k in range(self._ntransfers):
+            if k in self._got:
+                continue
+            req = self._inflight.get(k)
+            if req is None:
+                tag = _base_tag(self._tag) + k
+                st = self._comm.iprobe(self._source, tag, dest=self._dest)
+                if st is None:
+                    continue
+                req = self._comm.irecv(self._source, tag, dest=self._dest)
+                self._inflight[k] = req
+            if req._poll() or req.done:
+                del self._inflight[k]
+                self._got[k] = req._result
+                n += 1
+                SPC.record("part_transfers_received")
+        if n:
+            self._account_partitions()
+            if len(self._got) == self._ntransfers:
+                self._assemble()
+        return n
+
+    def _account_partitions(self) -> None:
+        for j in range(self.partitions):
+            if not self._arrived_parts[j] and self._part_done(j):
+                self._arrived_parts[j] = True
+                SPC.record("part_partitions_arrived")
+
+    def _part_done(self, j: int) -> bool:
+        lo, hi = block_range(j, self.partitions, self._elems)
+        for k in range(self._ntransfers):
+            klo, khi = block_range(k, self._ntransfers, self._elems)
+            if khi <= lo:
+                continue
+            if klo >= hi:
+                break
+            if k not in self._got:
+                return False
+        return True
+
+    def _assemble(self) -> None:
+        import jax.numpy as jnp
+
+        self._comp._deactivate(self)
+        pieces = [jnp.reshape(jnp.asarray(self._got[k]), (-1,))
+                  for k in range(self._ntransfers)]
+        flat = pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+        if int(flat.size) != self._elems:
+            self._complete(None, Status(
+                source=self._source, tag=self._tag,
+                error=CommError(
+                    f"partitioned payload mismatch: received "
+                    f"{int(flat.size)} elements, template expects "
+                    f"{self._elems} (sender and receiver must agree on "
+                    f"total count and dtype)"
+                ),
+            ))
+            return
+        self._complete(jnp.reshape(flat, self._shape), Status(
+            source=self._source, tag=self._tag,
+            count=self._elems * self._itemsize,
+        ))
+
+    def _partition_arrived(self, partition: int) -> bool:
+        self._drain()
+        return self._part_done(partition)
+
+    def partition_view(self, partition: int):
+        """The arrived partition's elements as a flat array — the MPI-4
+        guarantee that the receive-buffer region of partition p is
+        usable once Parrived(p) is true, expressed functionally (the
+        driver model returns buffers rather than mutating them). Raises
+        RequestError before arrival."""
+        if not 0 <= partition < self.partitions:
+            raise ArgumentError(
+                f"partition {partition} out of range [0, "
+                f"{self.partitions})"
+            )
+        if not self.parrived(partition):
+            raise RequestError(
+                f"partition_view({partition}) before arrival"
+            )
+        import jax.numpy as jnp
+
+        lo, hi = block_range(partition, self.partitions, self._elems)
+        pieces = []
+        for k in range(self._ntransfers):
+            klo, khi = block_range(k, self._ntransfers, self._elems)
+            if khi <= lo or klo >= hi:
+                continue
+            piece = jnp.reshape(jnp.asarray(self._got[k]), (-1,))
+            pieces.append(piece[max(lo - klo, 0):hi - klo])
+        return pieces[0] if len(pieces) == 1 else jnp.concatenate(pieces)
+
+    def _poll(self) -> bool:
+        if self.done:
+            return True
+        self._drain()
+        return self.done
+
+    def wait(self, timeout: float | None = None) -> Status:
+        st = super().wait(timeout)
+        if self._result is not None:
+            import jax
+
+            jax.block_until_ready(self._result)
+        return st
+
+
+@PART.register
+class PersistPart(PartComponent):
+    NAME = "persist"
+    PRIORITY = 50
+    DESCRIPTION = ("partitioned requests over pml sends (reference: "
+                   "part/persist)")
+
+    def __init__(self, framework) -> None:
+        super().__init__(framework)
+        self._active: list[PersistPartRecv] = []
+
+    def open(self) -> None:
+        super().open()
+        _progress.register(self._progress)
+
+    def close(self) -> None:
+        _progress.unregister(self._progress)
+        self._active.clear()
+        super().close()
+
+    def _activate(self, req: PersistPartRecv) -> None:
+        if req not in self._active:
+            self._active.append(req)
+
+    def _deactivate(self, req: PersistPartRecv) -> None:
+        try:
+            self._active.remove(req)
+        except ValueError:
+            pass
+
+    def _progress(self) -> int:
+        n = 0
+        for req in list(self._active):
+            n += req._drain()
+        return n
+
+    def psend_init(self, comm, value, partitions, dest, tag=0, *,
+                   source=None):
+        SPC.record("part_psend_init_calls")
+        return PersistPartSend(self, comm, value, partitions, dest, tag,
+                               source)
+
+    def precv_init(self, comm, partitions, source, tag=0, *, dest, like):
+        SPC.record("part_precv_init_calls")
+        return PersistPartRecv(self, comm, partitions, source, tag, dest,
+                               like)

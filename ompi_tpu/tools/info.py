@@ -24,12 +24,14 @@ def collect(include_internal: bool = False) -> dict:
     from ..ft import crs  # noqa: F401
     from ..hook import framework as hook_fw  # noqa: F401
     from ..pml import mtl  # noqa: F401
+    from ..part import framework as part_fw
     from ..core import config
     from ..core.component import MCA
     from ..core.counters import SPC
 
     coll_fw.ensure_components()
     pml_fw.ensure_components()
+    part_fw.ensure_components()
 
     frameworks = {}
     for name in MCA.names():

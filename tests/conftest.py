@@ -20,6 +20,10 @@ import jax
 
 jax.config.update("jax_platforms", "cpu")
 
+from ompi_tpu.core import jax_compat  # noqa: E402
+
+jax_compat.ensure()
+
 import pytest  # noqa: E402
 
 

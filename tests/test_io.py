@@ -296,10 +296,23 @@ def test_nonblocking_collective(tmp_path, comm):
 
 
 # -- shared pointer --------------------------------------------------------
+# Parametrized over the driver component (single-controller mutex) and
+# the shm-segment component (sharedfp/sm analog): the whole
+# shared-pointer suite must hold over both arbitration substrates.
 
-def test_shared_pointer_appends(tmp_path, comm):
+@pytest.fixture(params=["driver", "sm"])
+def sfp(request):
+    config.set("sharedfp_select", request.param)
+    try:
+        yield request.param
+    finally:
+        config.set("sharedfp_select", "")
+
+
+def test_shared_pointer_appends(tmp_path, comm, sfp):
     p = str(tmp_path / "shared.bin")
     with io_mod.open(comm, p, "w+") as fh:
+        assert fh.sharedfp.NAME == sfp
         fh.set_view(0, dt.INT32)
         for r in range(comm.size):
             fh.write_shared(np.full(2, r, np.int32), rank=r)
@@ -314,7 +327,7 @@ def test_shared_pointer_appends(tmp_path, comm):
     )
 
 
-def test_write_ordered_is_rank_ordered(tmp_path, comm):
+def test_write_ordered_is_rank_ordered(tmp_path, comm, sfp):
     n = comm.size
     p = str(tmp_path / "ordered.bin")
     with io_mod.open(comm, p, "w+") as fh:
@@ -325,6 +338,29 @@ def test_write_ordered_is_rank_ordered(tmp_path, comm):
     raw = np.fromfile(p, np.int32)
     expect = np.repeat(np.arange(n, dtype=np.int32), 3)
     np.testing.assert_array_equal(raw, expect)
+
+
+def test_sm_sharedfp_segment_shared_across_handles(tmp_path, comm):
+    """Two File handles on the same path meet the same shm-resident
+    pointer word (the cross-controller property the sm component
+    exists for), and the creator removes the segment at detach."""
+    config.set("sharedfp_select", "sm")
+    try:
+        p = str(tmp_path / "sm.bin")
+        fh1 = io_mod.open(comm, p, "w+")
+        fh2 = io_mod.open(comm, p, "r+")
+        assert fh1.sharedfp.NAME == "sm"
+        fh1.set_view(0, dt.INT32)
+        fh2.set_view(0, dt.INT32)
+        assert fh1.sharedfp.fetch_add(fh1._sfp_state, 5) == 0
+        # fh2's pointer is the SAME segment word, not a private copy
+        assert fh2.get_position_shared() == 5
+        fh2.seek_shared(11)
+        assert fh1.get_position_shared() == 11
+        fh2.close()
+        fh1.close()
+    finally:
+        config.set("sharedfp_select", "")
 
 
 def test_lockedfile_sharedfp(tmp_path, comm):

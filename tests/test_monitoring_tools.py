@@ -94,3 +94,40 @@ def test_info_tool_cli_json():
     assert out.returncode == 0, out.stderr
     data = json.loads(out.stdout)
     assert "frameworks" in data and "config_vars" in data
+
+
+def test_monitoring_overhead_under_10pct(world):
+    """Regression bar from the reference's test/monitoring/test_overhead:
+    the interposition layer must cost < 10% on the p2p fast path.
+    Off/on blocks are interleaved and the best block per mode is kept,
+    so process-wide drift (allocator, frequency scaling) cancels out."""
+    import time
+
+    msg = np.arange(64, dtype=np.float32)
+
+    def p2p_p50(iters=150):
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            world.isend(msg, 1, 7, source=0)
+            world.recv(0, 7, dest=1)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    p2p_p50(30)  # warm the path
+    offs, ons = [], []
+    MONITOR.reset()
+    try:
+        for _ in range(4):
+            MONITOR.enable(False)
+            offs.append(p2p_p50())
+            MONITOR.enable(True)
+            ons.append(p2p_p50())
+    finally:
+        MONITOR.enable(False)
+    off, on = min(offs), min(ons)
+    overhead = on / off - 1
+    assert overhead < 0.10, (
+        f"monitoring overhead {overhead:.1%} (off {off * 1e6:.1f}us, "
+        f"on {on * 1e6:.1f}us) exceeds the 10% budget"
+    )

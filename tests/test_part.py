@@ -1,0 +1,321 @@
+"""Partitioned communication (MPI-4 Psend_init/Precv_init/Pready/
+Parrived) over the part/persist component, exercised under BOTH pmls —
+ob1 (btl matching with unexpected queue) and cm (mtl, strict
+program-order matching). The persist component's probe-then-recv drain
+is what makes one code path legal under both.
+
+Reference semantics: MPI-4 §4.2 + ompi/mca/part/persist.
+"""
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.core import config
+from ompi_tpu.core.counters import SPC
+from ompi_tpu.core.errors import ArgumentError, RequestError, TagError
+from ompi_tpu.core.request import RequestState
+from ompi_tpu.part import framework as part_fw
+from ompi_tpu.pml import framework as pml_fw
+
+part_fw.ensure_components()
+
+_TRANSFER_BYTES_DEFAULT = 256 << 10
+
+
+@pytest.fixture(scope="module")
+def base():
+    return ompi_tpu.init()
+
+
+@pytest.fixture(params=["ob1", "cm"])
+def comm(base, request):
+    """A fresh communicator bound to each pml in turn — the partitioned
+    suite must be green under both."""
+    config.set("pml_select", request.param)
+    pml_fw.reset_selection()
+    c = base.dup()
+    assert c.pml.NAME == request.param
+    yield c
+    config.set("pml_select", "")
+    pml_fw.reset_selection()
+
+
+@pytest.fixture
+def small_transfers():
+    """Shrink the transfer target so small test buffers still split
+    into multiple internal transfers (N partitions -> M transfers)."""
+    config.set("part_persist_transfer_bytes", 16)
+    yield
+    config.set("part_persist_transfer_bytes", _TRANSFER_BYTES_DEFAULT)
+
+
+def _pair(comm, data, nparts, tag, *, rparts=None):
+    sreq = comm.psend_init(data, nparts, 1, tag, source=0)
+    rreq = comm.precv_init(rparts or nparts, 0, tag, dest=1, like=data)
+    sreq.start()
+    rreq.start()
+    return sreq, rreq
+
+
+def test_roundtrip_in_order(comm, small_transfers):
+    data = np.arange(24, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 6, 11)
+    assert sreq._ntransfers == 6  # 96 B / 16 B
+    for p in range(6):
+        sreq.pready(p)
+    st = rreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), data)
+    assert st.count == 24 * 4
+    sreq.wait()
+    assert sreq.state is RequestState.COMPLETE
+
+
+def test_out_of_order_pready(comm, small_transfers):
+    data = np.arange(24, dtype=np.float32) * 2
+    before = SPC.snapshot().get("part_transfers_sent", 0)
+    sreq, rreq = _pair(comm, data, 6, 12)
+    for p in (5, 0, 3, 1, 4, 2):
+        sreq.pready(p)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), data)
+    assert SPC.snapshot()["part_transfers_sent"] - before == 6
+
+
+def test_pready_range_and_list(comm, small_transfers):
+    data = np.arange(24, dtype=np.float32) + 7
+    sreq, rreq = _pair(comm, data, 6, 13)
+    sreq.pready_range(1, 3)  # MPI binding: inclusive bounds
+    sreq.pready_list([5, 0, 4])
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), data)
+
+
+def test_parrived_before_and_after(comm, small_transfers):
+    # 6 partitions over 24 f32 with 16 B transfers: partitions and
+    # transfers align 1:1, so each Pready eagerly lands one partition.
+    data = np.arange(24, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 6, 14)
+    assert not rreq.parrived(0)  # nothing flagged yet
+    sreq.pready(0)
+    assert rreq.parrived(0)      # eager drain: first block already over
+    assert not rreq.parrived(5)
+    for p in (1, 2, 3, 4, 5):
+        sreq.pready(p)
+    rreq.wait()
+    sreq.wait()
+    # Parrived stays legal (and true) after overall completion.
+    assert all(rreq.parrived(p) for p in range(6))
+
+
+def test_parrived_straddling_transfers(comm, small_transfers):
+    # 4 partitions (6 elems) over 6 transfers (4 elems): transfer 1
+    # spans partitions 0 and 1, so neither partition can land until
+    # BOTH are flagged — the N!=M coverage rule, observable end to end.
+    data = np.arange(24, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 4, 21)
+    sreq.pready(0)
+    assert not rreq.parrived(0)  # transfer [4,8) still waiting on p1
+    sreq.pready(1)
+    assert rreq.parrived(0)
+    assert rreq.parrived(1)      # transfers [4,8) and [8,12) both fired
+    sreq.pready_range(2, 3)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), data)
+
+
+def test_partition_view(comm, small_transfers):
+    data = np.arange(24, dtype=np.float32) * 3
+    sreq, rreq = _pair(comm, data, 6, 15)  # aligned 1:1 with transfers
+    with pytest.raises(RequestError):
+        rreq.partition_view(1)   # before arrival
+    sreq.pready(1)
+    np.testing.assert_array_equal(
+        np.asarray(rreq.partition_view(1)), data[4:8])
+    for p in (0, 2, 3, 4, 5):
+        sreq.pready(p)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(
+        np.asarray(rreq.partition_view(5)), data[20:24])
+    with pytest.raises(ArgumentError):
+        rreq.partition_view(6)
+
+
+def test_restart_completed_request(comm, small_transfers):
+    """Persistent semantics: start() re-arms a completed pair; bind()
+    swaps the send payload between cycles."""
+    a = np.arange(24, dtype=np.float32)
+    b = a + 100
+    sreq, rreq = _pair(comm, a, 4, 16)
+    sreq.pready_range(0, 3)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), a)
+
+    sreq.bind(b)
+    sreq.start()
+    rreq.start()
+    assert not rreq.parrived(0)  # re-armed: prior cycle's state cleared
+    for p in (3, 2, 1, 0):
+        sreq.pready(p)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), b)
+
+
+def test_sender_receiver_partition_mismatch(comm, small_transfers):
+    """MPI-4 only requires the two sides' TOTAL element counts to
+    agree: N sender partitions vs M receiver partitions, both mapped
+    onto the same internal transfers."""
+    data = np.arange(30, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 5, 17, rparts=3)
+    assert sreq._ntransfers == rreq._ntransfers
+    for p in (4, 2, 0, 1, 3):
+        sreq.pready(p)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), data)
+    assert all(rreq.parrived(p) for p in range(3))
+
+
+def test_single_transfer_many_partitions(comm):
+    """Default transfer size: a small buffer collapses to ONE internal
+    transfer that fires only when the last partition is flagged."""
+    data = np.arange(12, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 3, 18)
+    assert sreq._ntransfers == 1
+    sreq.pready(0)
+    sreq.pready(2)
+    assert not rreq.parrived(0)  # transfer can't fire until all flagged
+    sreq.pready(1)
+    rreq.wait()
+    sreq.wait()
+    np.testing.assert_array_equal(np.asarray(rreq._result), data)
+
+
+def test_argument_errors(comm):
+    data = np.arange(8, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 2, 19)
+    with pytest.raises(RequestError):
+        rreq.pready(0)           # Pready on the receive side
+    with pytest.raises(RequestError):
+        sreq.parrived(0)         # Parrived on the send side
+    with pytest.raises(ArgumentError):
+        sreq.pready(2)           # out of range
+    with pytest.raises(ArgumentError):
+        sreq.pready_range(1, 0)  # hi < lo
+    sreq.pready(0)
+    with pytest.raises(RequestError):
+        sreq.pready(0)           # double Pready in one cycle
+    with pytest.raises(RequestError):
+        sreq.start()             # start() while active
+    sreq.pready(1)
+    rreq.wait()
+    sreq.wait()
+    with pytest.raises(RequestError):
+        sreq.pready(1)           # Pready after completion (not active)
+
+
+def test_init_validation(comm):
+    data = np.arange(8, dtype=np.float32)
+    with pytest.raises(ArgumentError):
+        comm.psend_init(data, 0, 1, 1, source=0)     # partitions < 1
+    with pytest.raises(ArgumentError):
+        comm.psend_init(data, 9, 1, 1, source=0)     # partitions > elems
+    with pytest.raises(TagError):
+        comm.psend_init(data, 2, 1, -1, source=0)    # wildcard tag
+    with pytest.raises(TagError):
+        comm.precv_init(2, 0, -1, dest=1, like=data)
+    from ompi_tpu.core.errors import RankError
+
+    with pytest.raises((ArgumentError, RankError)):
+        comm.precv_init(2, -1, 1, dest=1, like=data)  # wildcard source
+    sreq = comm.psend_init(data, 2, 1, 1, source=0)
+    with pytest.raises(RequestError):
+        sreq.pready(0)           # before start(): INACTIVE
+    rreq = comm.precv_init(2, 0, 1, dest=1, like=data)
+    with pytest.raises(RequestError):
+        rreq.parrived(0)
+    with pytest.raises(ArgumentError):
+        sreq.bind(np.arange(4, dtype=np.float32))    # size change
+
+
+def test_pvars_count_partitions(comm, small_transfers):
+    before = SPC.snapshot()
+    data = np.arange(24, dtype=np.float32)
+    sreq, rreq = _pair(comm, data, 6, 20)
+    sreq.pready_range(0, 5)
+    rreq.wait()
+    sreq.wait()
+    after = SPC.snapshot()
+
+    def delta(name):
+        return after.get(name, 0) - before.get(name, 0)
+
+    assert delta("part_partitions_flagged") == 6
+    assert delta("part_partitions_arrived") == 6
+    assert delta("part_transfers_sent") == 6
+    assert delta("part_transfers_received") == 6
+
+
+def test_info_lists_part_framework():
+    from ompi_tpu.tools import info
+
+    report = info.collect()
+    frameworks = report["frameworks"]
+    assert "part" in frameworks
+    assert "persist" in frameworks["part"]
+    cvars = [v["name"] for v in report["config_vars"]]
+    assert "part_persist_transfer_bytes" in cvars
+    assert "part_persist_max_transfers" in cvars
+    assert "part_persist_tag_stride" in cvars
+
+
+# -- coll hook: bucketed allreduce ----------------------------------------
+
+def test_bucketed_allreduce_matches_monolithic(base):
+    from ompi_tpu.coll.partitioned import BucketedAllreduce
+
+    rng = np.random.default_rng(3)
+    x = base.put_rank_major(
+        rng.random((base.size, 32)).astype(np.float32))
+    oracle = np.asarray(base.allreduce(x))
+    br = BucketedAllreduce(base, x, "sum", 4)
+    for b in (2, 0, 3, 1):       # readiness order is the producer's
+        br.ready(b)
+    np.testing.assert_allclose(np.asarray(br.wait()), oracle, rtol=1e-6)
+
+
+def test_bucketed_allreduce_produce_hook(base):
+    from ompi_tpu.coll.partitioned import bucketed_allreduce
+
+    x = base.put_rank_major(
+        np.ones((base.size, 16), np.float32))
+    out = bucketed_allreduce(
+        base, x, "sum", 4, produce=lambda b, slab: slab * (b + 1))
+    expect = np.concatenate(
+        [np.full((base.size, 4), base.size * (b + 1), np.float32)
+         for b in range(4)], axis=1)
+    np.testing.assert_allclose(np.asarray(out), expect)
+
+
+def test_bucketed_allreduce_errors(base):
+    from ompi_tpu.coll.partitioned import BucketedAllreduce
+
+    x = base.put_rank_major(np.ones((base.size, 8), np.float32))
+    with pytest.raises(ArgumentError):
+        BucketedAllreduce(base, np.ones(8, np.float32))  # not rank-major
+    br = BucketedAllreduce(base, x, "sum", 2)
+    with pytest.raises(ArgumentError):
+        br.ready(2)                                      # bucket range
+    br.ready(0)
+    with pytest.raises(RequestError):
+        br.ready(0)                                      # double ready
+    with pytest.raises(RequestError):
+        br.wait()                                        # bucket 1 missing
+    br.ready(1)
+    br.wait()

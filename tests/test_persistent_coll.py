@@ -144,3 +144,28 @@ def test_persistent_plan_cache_reused(world):
     preq.start()
     preq.wait(timeout=60)
     assert len(world._plan_cache) == n_plans
+
+
+def test_persistent_start_skips_interposition(world):
+    """Started iterations are pure dispatch: monitoring interposition
+    fires once at first-start bind, never per start() (the pcollreq
+    trade documented in DESIGN.md)."""
+    from ompi_tpu.core.counters import SPC
+    from ompi_tpu.monitoring import MONITOR
+
+    x = _rank_major(world, 9)
+    preq = world.allreduce_init(x)
+    MONITOR.reset()
+    MONITOR.enable(True)
+    try:
+        before = SPC.snapshot().get("coll_persistent_allreduce_starts", 0)
+        for _ in range(3):
+            preq.start()
+            preq.wait(timeout=60)
+        flushed = MONITOR.flush()
+        key = f"{world.cid}:allreduce"
+        assert flushed["coll"][key][0] == 1  # recorded at bind only
+        assert SPC.snapshot()["coll_persistent_allreduce_starts"] \
+            - before == 3
+    finally:
+        MONITOR.enable(False)
