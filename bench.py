@@ -951,6 +951,189 @@ def _cpu_mesh_dispatch() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_QUANT_SWEEP_WORKER = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.core import config
+from ompi_tpu.coll import quant
+
+world = ompi_tpu.init()
+assert world.size == 8
+rng = np.random.default_rng(0)
+out = {}
+
+def p50(comm, x, iters):
+    comm.allreduce(x)  # warm the plan cache + compile
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = comm.allreduce(x)
+        jax.block_until_ready(r)
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), r
+
+# Sweep sizes overridable for the emission tests (schema check must
+# not pay the full 8 MiB sweep).
+sizes = [int(s) for s in os.environ.get(
+    "OMPI_TPU_BENCH_QUANT_SIZES", "65536,1048576,8388608").split(",")]
+for nbytes in sizes:
+    elems = nbytes // 4
+    iters = 5 if nbytes >= (8 << 20) else 15
+    data = rng.standard_normal((8, elems)).astype(np.float32)
+    x = world.put_rank_major(data)
+    exact_ref = data.sum(0)
+    row = {}
+    t_exact, _ = p50(world.dup(), x, iters)
+    row["exact_p50_ms"] = round(t_exact * 1e3, 3)
+    row["exact_gbps"] = round(nbytes / t_exact / 1e9, 3)
+    config.set("coll_quant_enable", True)
+    config.set("coll_quant_min_bytes", 1 << 10)
+    try:
+        for wire in ("int8", "bf16"):
+            config.set("coll_quant_wire", wire)
+            t_q, r = p50(world.dup(), x, iters)
+            err = float(np.max(np.abs(np.asarray(r)[0] - exact_ref)))
+            bound = float(np.min(np.asarray(
+                quant.analytic_error_bound(data, wire=wire))))
+            row[wire] = {
+                "p50_ms": round(t_q * 1e3, 3),
+                "effective_gbps": round(nbytes / t_q / 1e9, 3),
+                "wire_ratio": round(
+                    nbytes / quant.wire_bytes(nbytes, 4, wire=wire), 3),
+                "max_abs_err": err,
+                "bound_min": bound,
+                "within_bound": err <= bound,
+            }
+    finally:
+        config.set("coll_quant_enable", False)
+    out[f"{nbytes >> 10}KiB"] = row
+print("QUANTSWEEP " + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def _quant_sweep_row() -> dict:
+    """Quantized-tier allreduce sweep on the 8-rank virtual mesh: exact
+    vs int8/bf16 wire, per size. On CPU the wall-clock is interpret-mode
+    noise; the acceptance proxy is the analytic bytes-on-wire ratio
+    (>= 1.9x) with error inside the analytic block-scale bound."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, "-c", _QUANT_SWEEP_WORKER],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=420,
+        )
+        if p.returncode != 0:
+            return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+        for line in p.stdout.splitlines():
+            if line.startswith("QUANTSWEEP "):
+                return json.loads(line[len("QUANTSWEEP "):])
+        return {"error": "no QUANTSWEEP line"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+_BUCKET_FUSION_WORKER = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.parallel import bucketer
+
+world = ompi_tpu.init()
+assert world.size == 8
+rng = np.random.default_rng(1)
+# The ISSUE workload: 256 gradient leaves of 32 KiB f32 each (leaf
+# count overridable for the emission tests' quick schema check).
+leaves = int(os.environ.get("OMPI_TPU_BENCH_FUSE_LEAVES", "256"))
+elems = (32 << 10) // 4
+tree = {
+    f"g{i:03d}": np.asarray(
+        rng.standard_normal((8, elems)).astype(np.float32))
+    for i in range(leaves)
+}
+per_rank = {k: v[0] for k, v in tree.items()}
+fused_plan = bucketer.plan_buckets(per_rank)
+perleaf_plan = bucketer.plan_buckets(per_rank, 0)
+ref = {k: v.sum(0) for k, v in tree.items()}
+
+def run(bucket_bytes, iters=5):
+    r = bucketer.allreduce_pytree(world, tree,
+                                  bucket_bytes=bucket_bytes)  # warm
+    ts = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        r = bucketer.allreduce_pytree(world, tree,
+                                      bucket_bytes=bucket_bytes)
+        jax.block_until_ready(jax.tree.leaves(r))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)), r
+
+t_leaf, r_leaf = run(0)
+t_fused, r_fused = run(None)
+max_diff = max(
+    float(np.max(np.abs(np.asarray(r_fused[k])[0] - ref[k])))
+    for k in tree
+)
+out = {
+    "leaves": leaves,
+    "leaf_bytes": elems * 4,
+    "dispatches_per_leaf": len(perleaf_plan),
+    "dispatches_fused": len(fused_plan),
+    "dispatch_reduction": round(len(perleaf_plan) / len(fused_plan), 1),
+    "per_leaf_ms": round(t_leaf * 1e3, 3),
+    "fused_ms": round(t_fused * 1e3, 3),
+    "speedup": round(t_leaf / t_fused, 3),
+    "max_abs_diff_vs_exact": max_diff,
+}
+print("BUCKETFUSE " + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def _bucket_fusion_row() -> dict:
+    """Gradient bucket coalescing on the 8-rank virtual mesh: 256
+    x 32 KiB leaves reduced per-leaf (256 dispatches) vs fused into
+    4 MiB buckets (2 dispatches). Acceptance: >= 2x fewer dispatches
+    with no value change (exact tier is bitwise order-preserving)."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, "-c", _BUCKET_FUSION_WORKER],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=420,
+        )
+        if p.returncode != 0:
+            return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+        for line in p.stdout.splitlines():
+            if line.startswith("BUCKETFUSE "):
+                return json.loads(line[len("BUCKETFUSE "):])
+        return {"error": "no BUCKETFUSE line"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _HOST_ROWS_CACHE: dict = {}
 
 
@@ -991,6 +1174,10 @@ def _host_rows() -> dict:
     rows["monitoring_overhead"] = cpu.pop(
         "monitoring_overhead", {"error": "missing"})
     rows["cpu_mesh_dispatch"] = cpu
+    _set_phase("quantized allreduce sweep (8-rank mesh)")
+    rows["quant_allreduce_sweep"] = _quant_sweep_row()
+    _set_phase("dp gradient bucket fusion (8-rank mesh)")
+    rows["dp_bucket_fusion"] = _bucket_fusion_row()
     return rows
 
 

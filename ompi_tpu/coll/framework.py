@@ -307,6 +307,7 @@ def register_components() -> None:
         demo,
         hier,
         pallas_ring,
+        quant,
         selfcoll,
         smcoll,
         sync,

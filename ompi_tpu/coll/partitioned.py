@@ -43,6 +43,12 @@ class BucketedAllreduce:
     ``ready(b)`` enqueues that bucket's compiled collective and returns
     immediately, so bucket b's wire time runs under the caller's
     compute for bucket b+1.
+
+    Each bucket goes through ``comm.allreduce`` — the normal vtable —
+    so coll/tuned decides per bucket at bucket size, including the
+    quantized wire tier (coll/quant) when enabled: there is no second
+    quantization implementation here, and tuned's refusal rules
+    (op/dtype/min-bytes/user-rules veto) apply unchanged.
     """
 
     def __init__(self, comm, x, op: Any = "sum", nbuckets: int = 8) -> None:

@@ -91,6 +91,10 @@ _alltoall_large = _V("alltoall_large_msg", type=int, default=32 << 10,
                      description="Alltoall: bytes/dest above which "
                                  "pairwise exchange is used")
 
+# Quantized-wire cvars live in coll/quant (coll_quant_enable / _wire /
+# _block / _min_bytes); decide_allreduce reads them through the quant
+# module so the gate and the codec cannot disagree.
+
 ALLREDUCE_ALGOS: dict[str, Callable] = {
     "native": spmd.allreduce_native,
     "recursive_doubling": spmd.allreduce_recursive_doubling,
@@ -133,8 +137,42 @@ def _pallas_algos() -> None:
     SCATTER_ALGOS["pallas_linear"] = pr.scatter_block
 
 
+def _quant_algos() -> None:
+    """Extend the allreduce space with the quantized-wire tier (lazy,
+    like _pallas_algos: the names are selectable from rules files and
+    forced vars before the module is imported)."""
+    if "quant_ring" in ALLREDUCE_ALGOS:
+        return
+    from . import quant
+
+    ALLREDUCE_ALGOS["quant_ring"] = quant.allreduce_quant_ring
+    ALLREDUCE_ALGOS["quant_pallas"] = quant.allreduce_block_quant
+
+
 def is_pallas_algo(name: str) -> bool:
-    return name.startswith("pallas")
+    # quant_pallas is a Mosaic kernel too: same check_vma exemption.
+    return name.startswith("pallas") or name == "quant_pallas"
+
+
+def is_quant_algo(name: str) -> bool:
+    return name.startswith("quant")
+
+
+#: Algorithm names that exist but are registered lazily (importing
+#: pallas pulls in Mosaic; importing quant is cheap but kept symmetric).
+#: Rules-file validation must know them without forcing the import.
+_LAZY_ALGOS: dict[str, frozenset] = {
+    "allreduce": frozenset({
+        "pallas_ring", "pallas_bidir", "pallas_rd", "pallas_ring_chunked",
+        "pallas_rsag", "quant_ring", "quant_pallas",
+    }),
+    "bcast": frozenset({"pallas_binomial"}),
+    "allgather": frozenset({"pallas_ring"}),
+    "reduce": frozenset({"pallas_tree"}),
+    "reduce_scatter": frozenset({"pallas_ring"}),
+    "gather": frozenset({"pallas_linear"}),
+    "scatter": frozenset({"pallas_linear"}),
+}
 
 ALLGATHER_ALGOS: dict[str, Callable] = {
     "native": spmd.allgather_native,
@@ -191,27 +229,118 @@ SCATTER_ALGOS: dict[str, Callable] = {
 }
 
 
+def _algo_space(opname: str) -> set:
+    """Every selectable algorithm name for ``opname``, including the
+    lazily registered tiers (without importing them)."""
+    spaces = {
+        "allreduce": ALLREDUCE_ALGOS,
+        "alltoall": ALLTOALL_ALGOS,
+        "allgather": ALLGATHER_ALGOS,
+        "bcast": BCAST_ALGOS,
+        "reduce": REDUCE_ALGOS,
+        "scan": SCAN_ALGOS,
+        "exscan": EXSCAN_ALGOS,
+        "reduce_scatter": REDUCE_SCATTER_ALGOS,
+        "gather": GATHER_ALGOS,
+        "scatter": SCATTER_ALGOS,
+    }
+    space = spaces.get(opname)
+    if space is None:
+        return set()
+    return set(space) | set(_LAZY_ALGOS.get(opname, ()))
+
+
+_KNOWN_OPNAMES = frozenset({
+    "allreduce", "alltoall", "allgather", "bcast", "reduce", "scan",
+    "exscan", "reduce_scatter", "gather", "scatter",
+})
+
+
 class Rules:
     """Dynamic decision rules loaded from a JSON file:
     {"allreduce": [{"max_bytes": N, "min_ranks": M, "algorithm": "ring"},
-     ...], ...} — first matching entry wins."""
+     ...], ...} — first matching entry wins.
+
+    Band keys: min_bytes/max_bytes/min_ranks/max_ranks, plus the
+    precision dimension: ``"dtype": "float32"`` restricts a rule to one
+    payload dtype, and ``"allow_quant": false`` vetoes the automatic
+    quantized-wire tier inside the rule's band (a rule carrying only
+    the veto needs no "algorithm").
+
+    Unknown opname keys and unknown algorithm names are NOT silent
+    (reference regression: coll_tuned_dynamic_file.c ignores junk and
+    users debug it for days) — each unknown key is logged ONCE through
+    the monitoring layer, counted on the coll_tuned_rules_unknown pvar,
+    and the rule is skipped, so a bogus rules file can never select a
+    nonexistent algorithm."""
 
     def __init__(self, path: str) -> None:
         with open(path, "r", encoding="utf-8") as f:
             self._rules = json.load(f)
+        self._warned: set = set()
+        for opname in self._rules:
+            if opname not in _KNOWN_OPNAMES:
+                self._warn_once(
+                    ("opname", opname),
+                    "rules file names unknown operation %r "
+                    "(known: %s)", opname, sorted(_KNOWN_OPNAMES),
+                )
 
-    def decide(self, opname: str, nbytes: int, nranks: int) -> Optional[str]:
+    def _warn_once(self, key: tuple, msg: str, *args) -> None:
+        if key in self._warned:
+            return
+        self._warned.add(key)
+        from ..core.counters import SPC
+
+        SPC.record("coll_tuned_rules_unknown")
+        logger.warning(msg, *args)
+
+    def _matches(self, rule: dict, nbytes: int, nranks: int,
+                 dtype) -> bool:
+        if nbytes > rule.get("max_bytes", float("inf")):
+            return False
+        if nbytes < rule.get("min_bytes", 0):
+            return False
+        if nranks < rule.get("min_ranks", 0):
+            return False
+        if nranks > rule.get("max_ranks", float("inf")):
+            return False
+        want = rule.get("dtype")
+        if want is not None and (dtype is None or str(dtype) != want):
+            return False
+        return True
+
+    def decide(self, opname: str, nbytes: int, nranks: int,
+               dtype=None) -> Optional[str]:
+        known = _algo_space(opname)
         for rule in self._rules.get(opname, ()):
-            if nbytes > rule.get("max_bytes", float("inf")):
+            if not self._matches(rule, nbytes, nranks, dtype):
                 continue
-            if nbytes < rule.get("min_bytes", 0):
+            algo = rule.get("algorithm")
+            if algo is None:
+                continue  # veto-only rule (allow_quant band)
+            if algo not in known:
+                self._warn_once(
+                    ("algo", opname, algo),
+                    "rules file names unknown %s algorithm %r "
+                    "(known: %s); rule skipped", opname, algo,
+                    sorted(known),
+                )
                 continue
-            if nranks < rule.get("min_ranks", 0):
-                continue
-            if nranks > rule.get("max_ranks", float("inf")):
-                continue
-            return rule["algorithm"]
+            return algo
         return None
+
+    def allows_quant(self, opname: str, nbytes: int, nranks: int,
+                     dtype=None) -> bool:
+        """False when the first matching rule carries
+        ``"allow_quant": false`` — the user-rules veto on the
+        automatic quantized-wire tier."""
+        for rule in self._rules.get(opname, ()):
+            if not self._matches(rule, nbytes, nranks, dtype):
+                continue
+            if "allow_quant" in rule:
+                return bool(rule["allow_quant"])
+        return True
 
 
 _rules_cache: dict[str, Rules] = {}
@@ -242,17 +371,37 @@ def _nbytes(x) -> int:
     return total
 
 
-def decide_allreduce(op: Op, nbytes: int, nranks: int) -> str:
+def decide_allreduce(op: Op, nbytes: int, nranks: int, dtype=None,
+                     allow_quant: Optional[bool] = None) -> str:
+    """Pick the allreduce algorithm; precision-aware since the quant
+    tier exists.  ``dtype`` is the payload element type (None = unknown
+    → quant refused).  ``allow_quant`` overrides the coll_quant_enable
+    cvar (True forces consideration, False vetoes); user rules can veto
+    per band via ``"allow_quant": false``."""
     forced = _force_allreduce.value
     if forced:
         return forced
     rules = _rules()
     if rules is not None:
-        got = rules.decide("allreduce", nbytes, nranks)
+        got = rules.decide("allreduce", nbytes, nranks, dtype)
         if got:
             return got
     if not op.commutative or _is_joint(op):
         return "gather_reduce"
+    # Quantized wire: before native — trading representable values for
+    # wire bytes only pays on the wire-bound (large, floating, SUM)
+    # band, and only when the user (cvar/caller) and rules all agree.
+    from . import quant
+
+    if allow_quant is None:
+        allow_quant = quant._enable_var.value
+    if (allow_quant
+            and nbytes >= quant._min_bytes_var.value
+            and quant.supports(op, dtype)
+            and (rules is None
+                 or rules.allows_quant("allreduce", nbytes, nranks,
+                                       dtype))):
+        return "quant_ring"
     if _prefer_native.value and op.xla_reduce is not None:
         return "native"
     if nbytes < _small.value:
@@ -434,6 +583,44 @@ def decide_scatter(nbytes: int, nranks: int) -> str:
     return "native"
 
 
+def allreduce_by_decision(x: jax.Array, axis_name: str, op,
+                          allow_quant: Optional[bool] = None
+                          ) -> jax.Array:
+    """Traced (inside shard_map/jit) allreduce of a plain array over
+    ``axis_name``, routed through the same decision pipeline the comm
+    vtable uses — this is how per-bucket dispatch (parallel/bucketer)
+    gets tuned scheduling and the quant tier without a communicator
+    object.  The decision runs at trace time (axis sizes are static)."""
+    op = op_lookup(op)
+    nranks = jax.lax.axis_size(axis_name)
+    if nranks == 1:
+        return x
+    nbytes = x.size * x.dtype.itemsize
+    algo = decide_allreduce(op, nbytes, nranks, dtype=x.dtype,
+                            allow_quant=allow_quant)
+    if is_pallas_algo(algo):
+        _pallas_algos()
+    if is_quant_algo(algo):
+        _quant_algos()
+    fn = ALLREDUCE_ALGOS.get(algo)
+    if fn is None:
+        raise ArgumentError(
+            f"unknown allreduce algorithm {algo!r}; known: "
+            f"{sorted(ALLREDUCE_ALGOS)}"
+        )
+    from ..core.counters import SPC
+
+    SPC.record(f"coll_allreduce_algo_{algo}")
+    if is_quant_algo(algo):
+        from . import quant
+
+        quant.record_wire_stats(nbytes, x.dtype.itemsize)
+    if algo == "ring_segmented":
+        seg_elems = max(1, _seg_bytes.value // x.dtype.itemsize)
+        return fn(x, axis_name, op, segment_elems=seg_elems)
+    return fn(x, axis_name, op)
+
+
 @COLL.register
 class TunedColl(XlaColl):
     """Decision layer over the full algorithm space. Inherits the
@@ -447,9 +634,16 @@ class TunedColl(XlaColl):
         """Decision + compiled plan for allreduce; x is leaf-checked
         and comm.size > 1. The whole per-call decision pipeline lives
         here so persistent_program can resolve it once."""
-        algo = decide_allreduce(op, _nbytes(x), comm.size)
+        is_plain_array = hasattr(x, "dtype") and hasattr(x, "shape")
+        nbytes = _nbytes(x)
+        algo = decide_allreduce(
+            op, nbytes, comm.size,
+            dtype=x.dtype if is_plain_array else None,
+        )
         if is_pallas_algo(algo):
             _pallas_algos()
+        if is_quant_algo(algo):
+            _quant_algos()
         fn = ALLREDUCE_ALGOS.get(algo)
         if fn is None:
             raise ArgumentError(
@@ -460,11 +654,17 @@ class TunedColl(XlaColl):
         # The explicit single-buffer algorithms (ring, rd, ...) operate
         # on one plain array; any pytree container (even single-leaf)
         # routes through the pytree-aware ordered gather+reduce.
-        is_plain_array = hasattr(x, "dtype") and hasattr(x, "shape")
         if algo not in ("native", "gather_reduce") and not is_plain_array:
             fn = ALLREDUCE_ALGOS["gather_reduce"]
             algo = "gather_reduce"
         key = ("allreduce", algo, op.cache_key, _dtype_key(x))
+        if is_quant_algo(algo):
+            from . import quant
+
+            wire = quant._wire_var.value
+            blk = quant._block_var.value
+            key = key + (wire, blk)
+            quant.record_wire_stats(nbytes, x.dtype.itemsize, wire, blk)
         if algo == "ring_segmented":
             seg_elems = max(
                 1, _seg_bytes.value // jnp.asarray(leaves[0]).dtype.itemsize

@@ -273,22 +273,28 @@ def _sync_grads(grads, cfg: ModelConfig):
     (each dp rank saw a batch shard), over tp for tp-replicated params
     (each tp rank saw a sequence shard), over pp for the stage-shared
     top-level params (only one stage's copy received gradient).
+
+    The tp/pp sums are few and stay per-leaf; the dp sum — every
+    parameter, the DDP-style gradient reduction — goes through the
+    bucket coalescer (parallel/dp.allreduce_gradients): leaves fuse
+    into size-capped flat buckets with one collective per bucket, so
+    tuned scheduling and the quantized wire tier apply at bucket
+    granularity.  Values match the per-leaf psums exactly — an
+    elementwise sum of a concatenation is the concatenation of the
+    sums.
     """
-    out = {}
+    from ..parallel import dp as _dp
+
+    pre = {}
     for name in ("embed", "pos", "head", "ln_f"):
         g = grads[name]
         g = lax.psum(g, "tp")
-        g = lax.psum(g, "pp")
-        g = lax.psum(g, "dp")
-        out[name] = g
-    blocks = {}
-    for name, g in grads["blocks"].items():
-        if name in _TP_REPLICATED:
-            g = lax.psum(g, "tp")
-        g = lax.psum(g, "dp")
-        blocks[name] = g
-    out["blocks"] = blocks
-    return out
+        pre[name] = lax.psum(g, "pp")
+    pre["blocks"] = {
+        name: lax.psum(g, "tp") if name in _TP_REPLICATED else g
+        for name, g in grads["blocks"].items()
+    }
+    return _dp.allreduce_gradients(pre, "dp")
 
 
 def build_train_step(cfg: ModelConfig, mesh):

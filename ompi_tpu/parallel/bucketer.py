@@ -1,0 +1,239 @@
+"""parallel/bucketer — gradient bucket coalescing for data parallelism.
+
+A transformer step produces hundreds of gradient leaves, and BENCH host
+rows show per-call dispatch overhead dominating collectives below
+~64 KiB — so reducing each leaf separately pays that overhead hundreds
+of times per step.  The coalescer flattens the gradient pytree into a
+few size-capped flat buckets (cvar ``parallel_dp_bucket_bytes``) and
+issues ONE allreduce per bucket, so the whole decision stack — tuned's
+algorithm table, hier's same-host split, the pallas kernels and the
+quantized wire tier (coll/quant) — schedules per *bucket*, at bucket
+size, instead of per leaf (the fusion T3/arxiv 2401.16677 motivates;
+torch's DDP gradient buckets are the mainstream analog).
+
+Determinism and ordering guarantees (DESIGN.md §12):
+  * Bucket composition is a pure function of (pytree structure, leaf
+    shapes/dtypes, bucket_bytes): leaves are taken in ``jax.tree``
+    flatten order, grouped by dtype (preserving order inside each
+    group), concatenated, and cut at element boundaries — never
+    mid-element, never reordered.  Repeated calls with the same inputs
+    bucket identically, so error-feedback residuals stay aligned.
+  * Values are bit-identical to per-leaf dispatch for the exact tiers:
+    an elementwise reduction of a concatenation is the concatenation of
+    the reductions — per-element operation order is unchanged.
+
+Two entry points mirror the two calling contexts:
+  * :func:`allreduce_tree` — traced, inside shard_map/jit (the
+    transformer train step); dispatches each bucket through
+    ``coll.tuned.allreduce_by_decision``.
+  * :func:`allreduce_pytree` — host-side, rank-major buffers through
+    the comm vtable (``comm.allreduce`` per bucket), with optional
+    error feedback.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..core import config
+from ..core.counters import SPC
+
+_bucket_bytes_var = config.register(
+    "parallel", "dp", "bucket_bytes",
+    type=int, default=4 << 20,
+    description="Max bytes per fused gradient-allreduce bucket "
+                "(0 disables fusion: one dispatch per leaf)",
+)
+
+SPC.counter(
+    "parallel_dp_bucket_dispatches",
+    "fused gradient buckets dispatched (one collective each)",
+)
+SPC.counter(
+    "parallel_dp_bucket_leaves",
+    "gradient leaves coalesced into buckets",
+)
+
+
+class Bucket(NamedTuple):
+    """One planned bucket: ``leaf_ids`` index the flattened pytree;
+    ``elems`` is the flat element count of the bucket's payload."""
+    dtype: Any
+    elems: int
+    #: (leaf_id, lo, hi): leaf's flat slice [lo, hi) lives in this
+    #: bucket at the running offset (a leaf larger than the cap spans
+    #: consecutive buckets).
+    pieces: tuple
+
+
+def plan_buckets(tree: Any, bucket_bytes: Optional[int] = None
+                 ) -> list[Bucket]:
+    """Deterministic bucket plan for a pytree (shapes only, no data).
+    The plan length IS the collective-dispatch count of a fused
+    allreduce of ``tree``."""
+    if bucket_bytes is None:
+        bucket_bytes = _bucket_bytes_var.value
+    leaves = jax.tree.leaves(tree)
+    groups: dict = {}
+    for i, leaf in enumerate(leaves):
+        dt = jnp.asarray(leaf).dtype
+        groups.setdefault(str(dt), (dt, []))[1].append(i)
+    plans: list[Bucket] = []
+    for _, (dt, ids) in sorted(groups.items()):
+        fused = bucket_bytes > 0
+        cap = max(1, bucket_bytes // dt.itemsize) if fused else 0
+        pieces: list = []
+        elems = 0
+        for i in ids:
+            size = jnp.asarray(leaves[i]).size
+            lo = 0
+            while lo < size or size == 0:
+                take = min(size - lo, cap - elems) if fused else size
+                pieces.append((i, lo, lo + take))
+                elems += take
+                lo += take
+                if fused and elems >= cap:
+                    plans.append(Bucket(dt, elems, tuple(pieces)))
+                    pieces, elems = [], 0
+                if size == 0:
+                    break
+            if not fused and pieces:
+                # Fusion disabled: one bucket (dispatch) per leaf.
+                plans.append(Bucket(dt, elems, tuple(pieces)))
+                pieces, elems = [], 0
+        if pieces:
+            plans.append(Bucket(dt, elems, tuple(pieces)))
+    return plans
+
+
+def _gather_bucket(leaves: list, bucket: Bucket, flat_axis: int):
+    parts = [
+        jnp.asarray(leaves[i]).reshape(
+            leaves[i].shape[:flat_axis] + (-1,))[..., lo:hi]
+        for i, lo, hi in bucket.pieces
+    ]
+    return jnp.concatenate(parts, axis=-1)
+
+
+def _scatter_bucket(out_flat: dict, reduced, bucket: Bucket) -> None:
+    off = 0
+    for i, lo, hi in bucket.pieces:
+        out_flat.setdefault(i, []).append(reduced[..., off:off + (hi - lo)])
+        off += hi - lo
+
+
+def _reassemble(leaves: list, out_flat: dict, flat_axis: int) -> list:
+    out = []
+    for i, leaf in enumerate(leaves):
+        arr = jnp.asarray(leaf)
+        if i not in out_flat:          # zero-size leaf: nothing moved
+            out.append(arr)
+            continue
+        flat = jnp.concatenate(out_flat[i], axis=-1)
+        out.append(flat.reshape(arr.shape))
+    return out
+
+
+#: Jitted gather/reassemble programs keyed by bucket plan: the host-side
+#: path re-uses the same plan every step (bucketing is deterministic), so
+#: the per-call cost of slicing N leaves into buckets and back is one
+#: executable launch each instead of ~2N separate jnp dispatches.
+_PLAN_JIT_CACHE: dict = {}
+
+
+def _plan_jit(plan: list, flat_axis: int, tag: str, make):
+    key = (tag, flat_axis,
+           tuple((str(b.dtype), b.elems, b.pieces) for b in plan))
+    fn = _PLAN_JIT_CACHE.get(key)
+    if fn is None:
+        fn = _PLAN_JIT_CACHE[key] = jax.jit(make())
+    return fn
+
+
+def _gather_fn(plan: list, flat_axis: int):
+    def make():
+        def gather(leaves):
+            return [_gather_bucket(leaves, b, flat_axis) for b in plan]
+        return gather
+    return _plan_jit(plan, flat_axis, "gather", make)
+
+
+def _reassemble_fn(plan: list, flat_axis: int):
+    def make():
+        def reassemble(leaves, reduced):
+            out_flat: dict = {}
+            for b, r in zip(plan, reduced):
+                _scatter_bucket(out_flat, r, b)
+            return _reassemble(leaves, out_flat, flat_axis)
+        return reassemble
+    return _plan_jit(plan, flat_axis, "reassemble", make)
+
+
+def allreduce_tree(tree: Any, axis_name: str, op: Any = "sum",
+                   bucket_bytes: Optional[int] = None,
+                   allow_quant: Optional[bool] = None) -> Any:
+    """Traced fused allreduce of a gradient pytree over ``axis_name``
+    (inside shard_map/jit): one collective per planned bucket, each
+    routed through coll/tuned's decision (so the quant tier and the
+    explicit algorithms apply per bucket).  SPC bucket counters are
+    recorded at trace time — they count collectives in the compiled
+    program, not executions."""
+    from ..coll import tuned
+
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    plan = plan_buckets(leaves, bucket_bytes)
+    SPC.record("parallel_dp_bucket_leaves", len(leaves))
+    out_flat: dict = {}
+    for bucket in plan:
+        payload = _gather_bucket(leaves, bucket, 0)
+        reduced = tuned.allreduce_by_decision(
+            payload, axis_name, op, allow_quant=allow_quant)
+        SPC.record("parallel_dp_bucket_dispatches")
+        _scatter_bucket(out_flat, reduced, bucket)
+    return jax.tree.unflatten(
+        treedef, _reassemble(leaves, out_flat, 0))
+
+
+def allreduce_pytree(comm, tree: Any, op: Any = "sum",
+                     bucket_bytes: Optional[int] = None,
+                     error_feedback=None) -> Any:
+    """Host-side fused allreduce of a pytree of rank-major ``(size,
+    ...)`` buffers through the comm VTABLE: one ``comm.allreduce`` per
+    bucket, so component selection (tuned/hier/pallas) and the quant
+    tier run per bucket.  ``error_feedback`` is an optional dict used
+    as a residual bank: one :class:`ompi_tpu.coll.quant.ErrorFeedback`
+    per bucket index, created on first use and carried across calls
+    (aligned because bucketing is deterministic — pass the same dict
+    every step)."""
+    leaves, treedef = jax.tree.flatten(tree)
+    if not leaves:
+        return tree
+    size = comm.size
+    for leaf in leaves:
+        arr = jnp.asarray(leaf)
+        if arr.ndim < 1 or arr.shape[0] != size:
+            raise ValueError(
+                f"allreduce_pytree needs rank-major (size, ...) leaves,"
+                f" got shape {arr.shape}"
+            )
+    # Plan over the per-rank payload (axis 0 is the rank axis).
+    per_rank = [jnp.asarray(l)[0] for l in leaves]
+    plan = plan_buckets(per_rank, bucket_bytes)
+    SPC.record("parallel_dp_bucket_leaves", len(leaves))
+    payloads = _gather_fn(plan, 1)(leaves)      # (size, elems) each
+    reduced = []
+    for bi, payload in enumerate(payloads):
+        if error_feedback is not None:
+            from ..coll.quant import ErrorFeedback
+
+            ef = error_feedback.setdefault(bi, ErrorFeedback())
+            payload = ef.compensate(payload)
+        reduced.append(comm.allreduce(payload, op))
+        SPC.record("parallel_dp_bucket_dispatches")
+    return jax.tree.unflatten(
+        treedef, _reassemble_fn(plan, 1)(leaves, reduced))

@@ -2,8 +2,12 @@
 
 SURVEY §2.6 DP row — the allreduce family (reference:
 coll_base_allreduce.c ring/recursive-doubling/Rabenseifner) applied to
-gradient pytrees. The fabric-native psum is the default; the explicit
-algorithms are selectable for benchmarking (via coll/tuned's config).
+gradient pytrees.  Since the bucket coalescer landed, the pytree is
+flattened into size-capped flat buckets (parallel/bucketer, cvar
+``parallel_dp_bucket_bytes``) with ONE collective per bucket routed
+through coll/tuned's decision — so algorithm choice, and the quantized
+wire tier (coll/quant) when enabled, apply per bucket instead of per
+leaf.
 """
 
 from __future__ import annotations
@@ -12,26 +16,23 @@ from typing import Any
 
 import jax
 
-from ..coll import spmd
 from ..ops import SUM
+from . import bucketer
 
 
 def allreduce_gradients(grads: Any, axis_name: str = "dp") -> Any:
-    """Mean-free allreduce (sum) of a gradient pytree over the dp axis."""
-    return jax.tree.map(
-        lambda g: spmd.allreduce_native(g, axis_name, SUM), grads
-    )
+    """Mean-free allreduce (sum) of a gradient pytree over the dp axis,
+    fused into size-capped buckets (one collective per bucket)."""
+    return bucketer.allreduce_tree(grads, axis_name, SUM)
 
 
 def mean_gradients(grads: Any, axis_name: str = "dp") -> Any:
     """Allreduce-mean of gradients (the usual DP update input)."""
-    import jax.numpy as jnp
     from jax import lax
 
     n = lax.axis_size(axis_name)
-    return jax.tree.map(
-        lambda g: spmd.allreduce_native(g, axis_name, SUM) / n, grads
-    )
+    summed = bucketer.allreduce_tree(grads, axis_name, SUM)
+    return jax.tree.map(lambda g: g / n, summed)
 
 
 def shard_batch(batch: Any, axis_name: str = "dp"):

@@ -142,3 +142,56 @@ def test_revival_sequencing_probe_fail_then_succeed():
     assert detail["pallas"]["compiled"] is True
     assert detail["persistent_start_us"] == 55.5
     assert out["value"] > 0
+
+
+def test_new_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR3 satellite 5: the quant_allreduce_sweep and
+    dp_bucket_fusion rows run END-TO-END (real 8-rank subprocess
+    workers, shrunk workload via env) inside the probe-failed host-only
+    path, and the abort emission carries schema-complete JSON for
+    both."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the workers so the schema check stays fast
+        os.environ["OMPI_TPU_BENCH_QUANT_SIZES"] = "65536"
+        os.environ["OMPI_TPU_BENCH_FUSE_LEAVES"] = "8"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    sweep = rows["quant_allreduce_sweep"]
+    assert "error" not in sweep, sweep
+    band = sweep["64KiB"]
+    assert band["exact_p50_ms"] > 0 and band["exact_gbps"] > 0
+    for wire, floor in (("int8", 3.8), ("bf16", 2.0)):
+        w = band[wire]
+        for key in ("p50_ms", "effective_gbps", "wire_ratio",
+                    "max_abs_err", "bound_min", "within_bound"):
+            assert key in w, (wire, key)
+        assert w["wire_ratio"] >= 1.9 and w["wire_ratio"] >= floor - 0.1
+        assert w["within_bound"] is True
+
+    fuse = rows["dp_bucket_fusion"]
+    assert "error" not in fuse, fuse
+    for key in ("leaves", "leaf_bytes", "dispatches_per_leaf",
+                "dispatches_fused", "dispatch_reduction", "per_leaf_ms",
+                "fused_ms", "speedup", "max_abs_diff_vs_exact"):
+        assert key in fuse, key
+    assert fuse["dispatches_per_leaf"] == fuse["leaves"] == 8
+    assert fuse["dispatch_reduction"] >= 2.0
+    assert fuse["max_abs_diff_vs_exact"] == 0.0

@@ -603,6 +603,7 @@ os._exit(0)
 """
 
 
+@pytest.mark.slow
 def test_elastic_respawn_rewires_live_fabric(tmp_path):
     import os
     import socket

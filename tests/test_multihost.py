@@ -296,6 +296,7 @@ _ELASTIC_WORKER = textwrap.dedent(r"""
 """)
 
 
+@pytest.mark.slow
 def test_elastic_drill_kill_one_controller(tmp_path):
     """End-to-end elastic recovery (VERDICT r1 item 10): one of two
     controller processes dies mid-allreduce; the survivor detects it

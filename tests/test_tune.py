@@ -263,3 +263,67 @@ def test_tune_sweeps_scan_spaces(tmp_path):
     for rules in (doc["scan"], doc["exscan"]):
         for rule in rules:
             assert rule["algorithm"] in known
+
+
+def test_bogus_rules_file_cannot_select_nonexistent_algorithm(tmp_path):
+    """ISSUE PR3 satellite 1: a user rules file naming an unknown
+    algorithm or opname must not break dispatch — the bad entries are
+    skipped (logged once via the monitoring layer, pvar
+    coll_tuned_rules_unknown) and the default decision produces a
+    correct result."""
+    from ompi_tpu.core.counters import SPC
+
+    p = str(tmp_path / "bogus.json")
+    with open(p, "w") as f:
+        json.dump({
+            "allreduce": [{"algorithm": "warp_drive"}],
+            "frobnicate": [{"algorithm": "ring"}],
+        }, f)
+    config.set("coll_tuned_rules_file", p)
+    try:
+        before = SPC.snapshot().get("coll_tuned_rules_unknown", 0)
+        comm = mt.world().dup()
+        x = comm.put_rank_major(np.ones((comm.size, 64), np.float32))
+        out = np.asarray(comm.allreduce(x))
+        np.testing.assert_allclose(
+            out[0], np.full(64, comm.size, np.float32))
+        after = SPC.snapshot().get("coll_tuned_rules_unknown", 0)
+        # one warning for the unknown opname, one for the unknown algo
+        assert after >= before + 2
+        # warn-once: a second dispatch must not re-count
+        mid = after
+        np.asarray(comm.allreduce(x))
+        assert SPC.snapshot().get("coll_tuned_rules_unknown", 0) == mid
+    finally:
+        config.set("coll_tuned_rules_file", "")
+
+
+def test_rules_file_dtype_band_matches_only_that_dtype(tmp_path):
+    """Precision-aware rules: a band with a "dtype" key steers only
+    payloads of that dtype; others fall through to the defaults."""
+    from ompi_tpu.core.counters import SPC
+
+    p = str(tmp_path / "f32only.json")
+    with open(p, "w") as f:
+        json.dump({"allreduce": [
+            {"dtype": "float32", "algorithm": "recursive_doubling"},
+        ]}, f)
+    config.set("coll_tuned_rules_file", p)
+    try:
+        comm = mt.world().dup()
+        before = SPC.snapshot().get(
+            "coll_allreduce_algo_recursive_doubling", 0)
+        xf = comm.put_rank_major(np.ones((comm.size, 64), np.float32))
+        np.asarray(comm.allreduce(xf))
+        after = SPC.snapshot().get(
+            "coll_allreduce_algo_recursive_doubling", 0)
+        assert after > before, "f32 band must match f32 payload"
+        xi = comm.put_rank_major(np.ones((comm.size, 64), np.int32))
+        out = np.asarray(comm.allreduce(xi))
+        np.testing.assert_array_equal(
+            out[0], np.full(64, comm.size, np.int32))
+        # int32 payload fell through: counter unchanged
+        assert SPC.snapshot().get(
+            "coll_allreduce_algo_recursive_doubling", 0) == after
+    finally:
+        config.set("coll_tuned_rules_file", "")
