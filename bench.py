@@ -1178,7 +1178,30 @@ def _host_rows() -> dict:
     rows["quant_allreduce_sweep"] = _quant_sweep_row()
     _set_phase("dp gradient bucket fusion (8-rank mesh)")
     rows["dp_bucket_fusion"] = _bucket_fusion_row()
+    _set_phase("commlint self-analysis")
+    rows["commlint"] = _commlint_row()
     return rows
+
+
+def _commlint_row() -> dict:
+    """Static analyzer over the package itself: rule count, findings,
+    wall time. Pure host work — no mesh, no subprocess."""
+    try:
+        from ompi_tpu.analysis.lint import Linter
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        pkg = os.path.join(here, "ompi_tpu")
+        linter = Linter(base=pkg)
+        rep = linter.lint_paths([pkg])
+        return {
+            "rules": len(linter.rules),
+            "files": linter.files_checked,
+            "findings": len(rep),
+            "errors": len(linter.errors),
+            "runtime_ms": round(linter.elapsed_ms, 1),
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
 
 
 def bench_single_chip() -> dict:

@@ -1,0 +1,25 @@
+"""commlint — communication-correctness analysis (static + sanitizer).
+
+Two cooperating halves, in the spirit of MPI correctness tooling
+(MUST-style runtime match checking, MPI-Checker-style static
+request-lifecycle analysis; see PAPERS.md — GC3 treats collective
+schedules as analyzable programs, EQuARX motivates checking quant-tier
+eligibility before dispatch):
+
+- ``analysis.lint``: an AST- and schedule-level linter whose rules are
+  MCA components (framework ``commlint``, selectable via the
+  ``commlint_select`` cvar) walking user programs AND this framework
+  itself. Findings ratchet against a checked-in baseline
+  (``selfcheck_baseline.json``) so existing debt can only shrink.
+- ``analysis.sanitizer``: an opt-in runtime that interposes on the
+  pml/coll/part vtables and the request lifecycle, matching per-rank
+  call sequences at barriers and Finalize — leaked requests, unmatched
+  sends, derived-tag collisions, cross-rank collective-order
+  divergence — reported through SPC pvars and a structured report.
+
+CLI: ``python -m ompi_tpu.tools.lint <path>``.
+"""
+
+from .report import Baseline, Finding, Report, Severity
+
+__all__ = ["Baseline", "Finding", "Report", "Severity"]

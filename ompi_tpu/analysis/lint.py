@@ -1,0 +1,178 @@
+"""commlint driver — static communication-correctness analysis.
+
+The linter walks Python sources, parses them once, and hands each file
+to every selected rule component (``analysis/rules/``, an MCA framework
+— rules are selectable/disableable via the ``commlint_select`` and
+``commlint_<rule>_priority`` cvars like any other component stack).
+
+Suppressions are source-level: a ``# commlint: allow(<rule>)`` comment
+on the flagged line or the line above silences that rule there. The
+self-lint ratchet (``analysis/report.Baseline``) handles the historical
+remainder: per-``rule:file`` finding counts are checked in, only count
+*increases* fail.
+
+Typical use::
+
+    from ompi_tpu.analysis.lint import Linter
+    rep = Linter().lint_paths(["ompi_tpu"])
+    print(rep.render())
+
+or ``python -m ompi_tpu.tools.lint <path>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import time
+from typing import Iterable, Sequence
+
+from ..core import config
+from .report import Finding, Report, Severity
+from .rules import COMMLINT, ensure_rules
+
+_ALLOW_RE = re.compile(r"#\s*commlint:\s*allow\(\s*([\w\-, ]+?)\s*\)")
+
+config.register(
+    "commlint", "base", "exclude",
+    type=str, default="__pycache__,.git,build,dist",
+    description="comma-separated directory names the linter skips",
+)
+
+
+class FileContext:
+    """One parsed source file, shared by every rule.
+
+    Attributes
+    ----------
+    path:     the path as given to the linter (for error messages)
+    relpath:  path relative to the lint root, '/'-normalised — this is
+              what appears in findings and baseline keys, so baselines
+              are stable across checkouts.
+    tree:     the parsed ``ast`` module
+    lines:    source split into lines (1-indexed via ``lines[i-1]``)
+    """
+
+    def __init__(self, path: str, source: str, relpath: str | None = None):
+        self.path = path
+        self.relpath = (relpath or path).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self._allow: dict[int, frozenset[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                names = frozenset(
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                )
+                self._allow[i] = names
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """True if ``# commlint: allow(rule)`` covers ``line``
+        (same line or the line immediately above)."""
+        for ln in (line, line - 1):
+            names = self._allow.get(ln)
+            if names and (rule in names or "all" in names):
+                return True
+        return False
+
+
+class Linter:
+    """Runs the selected rule components over files/trees."""
+
+    def __init__(self, select: str | None = None,
+                 base: str | None = None):
+        ensure_rules()
+        self.base = os.path.abspath(base) if base else None
+        if select is not None:
+            # scope the filter cvar to this selection so one Linter's
+            # --select doesn't leak into later instances
+            prev = config.get("commlint_select", "") or ""
+            config.set("commlint_select", select)
+            try:
+                self.rules = COMMLINT.select_all()
+            finally:
+                config.set("commlint_select", prev)
+        else:
+            self.rules = COMMLINT.select_all()
+        self.errors: list[str] = []  # unparseable files, I/O failures
+        self.files_checked = 0
+        self.elapsed_ms = 0.0
+
+    # -- discovery ----------------------------------------------------
+
+    def _excluded(self) -> frozenset[str]:
+        raw = config.get("commlint_base_exclude",
+                         "__pycache__,.git,build,dist") or ""
+        return frozenset(p.strip() for p in raw.split(",") if p.strip())
+
+    def iter_sources(self, paths: Sequence[str]) -> Iterable[str]:
+        skip = self._excluded()
+        for path in paths:
+            if os.path.isfile(path):
+                yield path
+                continue
+            for root, dirs, files in os.walk(path):
+                dirs[:] = sorted(
+                    d for d in dirs
+                    if d not in skip and not d.startswith(".")
+                )
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        yield os.path.join(root, f)
+
+    def _relpath(self, path: str) -> str:
+        ap = os.path.abspath(path)
+        base = self.base
+        if base and (ap == base or ap.startswith(base + os.sep)):
+            return os.path.relpath(ap, base)
+        return path
+
+    # -- linting ------------------------------------------------------
+
+    def lint_source(self, source: str, path: str = "<string>",
+                    relpath: str | None = None) -> list[Finding]:
+        try:
+            ctx = FileContext(path, source, relpath=relpath)
+        except SyntaxError as exc:
+            self.errors.append(f"{path}: syntax error: {exc}")
+            return []
+        findings: list[Finding] = []
+        for rule in self.rules:
+            try:
+                findings.extend(rule.check(ctx))
+            except Exception as exc:  # commlint: allow(broadexcept)
+                # A crashing rule must not take the whole run down;
+                # surface it as a run error instead.
+                self.errors.append(
+                    f"{path}: rule {rule.NAME!r} crashed: {exc!r}"
+                )
+        return findings
+
+    def lint_file(self, path: str) -> list[Finding]:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as exc:
+            self.errors.append(f"{path}: {exc}")
+            return []
+        self.files_checked += 1
+        return self.lint_source(source, path=path,
+                                relpath=self._relpath(path))
+
+    def lint_paths(self, paths: Sequence[str]) -> Report:
+        t0 = time.perf_counter()
+        findings: list[Finding] = []
+        for src in self.iter_sources(paths):
+            findings.extend(self.lint_file(src))
+        self.elapsed_ms = (time.perf_counter() - t0) * 1e3
+        return Report(findings)
+
+
+def lint_tree(root: str, select: str | None = None) -> Report:
+    """Convenience: lint every .py under ``root``, findings keyed
+    relative to it (the form the self-lint baseline uses)."""
+    linter = Linter(select=select, base=root)
+    return linter.lint_paths([root])

@@ -1,0 +1,153 @@
+"""Findings, reports, and the ratchet baseline.
+
+The baseline model follows the "ratchet" discipline: a checked-in JSON
+file records per-(rule, file) finding counts; a lint run FAILS only on
+counts above the baseline (new debt) and the baseline is re-written when
+debt is paid down. Keys are (rule, repo-relative path) rather than line
+numbers so unrelated edits don't churn the file.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import json
+import os
+from collections import Counter
+from typing import Iterable, Optional
+
+
+class Severity(enum.IntEnum):
+    """Ordered so max() over findings yields the report severity."""
+
+    NOTE = 0
+    WARNING = 1
+    ERROR = 2
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One lint/sanitizer finding, anchored to a source location."""
+
+    rule: str  # rule component name, e.g. "reqlife"
+    severity: Severity
+    path: str  # repo-relative when possible
+    line: int
+    message: str
+
+    @property
+    def key(self) -> str:
+        """Baseline bucket: counts are ratcheted per (rule, file)."""
+        return f"{self.rule}:{self.path}"
+
+    def render(self) -> str:
+        return (
+            f"{self.path}:{self.line}: {self.severity.name.lower()} "
+            f"[{self.rule}] {self.message}"
+        )
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule,
+            "severity": self.severity.name.lower(),
+            "path": self.path,
+            "line": self.line,
+            "message": self.message,
+        }
+
+
+class Report:
+    """An ordered collection of findings with baseline comparison."""
+
+    def __init__(self, findings: Iterable[Finding] = ()) -> None:
+        self.findings: list[Finding] = sorted(
+            findings, key=lambda f: (f.path, f.line, f.rule)
+        )
+
+    def __len__(self) -> int:
+        return len(self.findings)
+
+    def __iter__(self):
+        return iter(self.findings)
+
+    def by_rule(self, rule: str) -> list[Finding]:
+        return [f for f in self.findings if f.rule == rule]
+
+    def counts(self) -> dict[str, int]:
+        return dict(Counter(f.key for f in self.findings))
+
+    def max_severity(self) -> Severity:
+        return max(
+            (f.severity for f in self.findings), default=Severity.NOTE
+        )
+
+    def render(self) -> str:
+        lines = [f.render() for f in self.findings]
+        lines.append(
+            f"commlint: {len(self.findings)} finding(s)"
+            if self.findings else "commlint: clean"
+        )
+        return "\n".join(lines)
+
+    def to_dict(self) -> dict:
+        return {
+            "findings": [f.to_dict() for f in self.findings],
+            "counts": self.counts(),
+        }
+
+
+class Baseline:
+    """The checked-in ratchet: per-(rule, file) allowed finding counts."""
+
+    VERSION = 1
+
+    def __init__(self, counts: Optional[dict[str, int]] = None) -> None:
+        self.counts: dict[str, int] = dict(counts or {})
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        return cls(data.get("counts", {}))
+
+    def save(self, path: str) -> None:
+        payload = {
+            "version": self.VERSION,
+            "comment": (
+                "commlint ratchet: counts may only decrease. Regenerate "
+                "with python -m ompi_tpu.tools.lint ompi_tpu "
+                "--write-baseline after paying down debt."
+            ),
+            "counts": dict(sorted(self.counts.items())),
+        }
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(payload, f, indent=2, sort_keys=False)
+            f.write("\n")
+        os.replace(tmp, path)
+
+    @classmethod
+    def from_report(cls, report: Report) -> "Baseline":
+        return cls(report.counts())
+
+    def regressions(self, report: Report) -> list[str]:
+        """Human-readable regressions: buckets whose current count
+        exceeds the baseline (new keys count against a baseline of 0)."""
+        out = []
+        for key, n in sorted(report.counts().items()):
+            allowed = self.counts.get(key, 0)
+            if n > allowed:
+                out.append(
+                    f"{key}: {n} finding(s), baseline allows {allowed}"
+                )
+        return out
+
+    def improvements(self, report: Report) -> list[str]:
+        """Buckets where debt was paid down (baseline can be tightened)."""
+        current = report.counts()
+        out = []
+        for key, allowed in sorted(self.counts.items()):
+            n = current.get(key, 0)
+            if n < allowed:
+                out.append(f"{key}: {n} finding(s), baseline allows {allowed}")
+        return out

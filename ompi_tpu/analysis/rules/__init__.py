@@ -1,0 +1,267 @@
+"""commlint rule registry — each rule is an MCA component.
+
+Rules register with the ``commlint`` framework and are selected through
+the standard component machinery, so the usual cvar surface applies:
+``commlint_select`` filters rules by name (``^broadexcept`` disables
+one), and each rule carries a ``commlint_<name>_priority`` var. The
+linter driver (analysis/lint.py) runs every selected rule over every
+file's AST and merges findings.
+
+Shared AST vocabulary for the comm surface lives here so rules agree on
+what a "request maker" or a "collective" is.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Any, Iterable, Iterator, Optional
+
+from ...core import component as mca
+from ..report import Finding, Severity
+
+COMMLINT = mca.framework(
+    "commlint", "static communication-correctness rules"
+)
+
+#: Calls returning a Request the caller must complete (wait/test/free).
+REQ_MAKERS = frozenset({
+    "isend", "irecv", "send_init", "recv_init",
+    "psend_init", "precv_init", "Psend_init", "Precv_init",
+    "iallreduce", "ibcast", "ireduce", "iallgather", "ialltoall",
+    "igather", "iscatter", "iscan", "ibarrier", "iallgatherv",
+    "ialltoallv", "ireduce_scatter", "ireduce_scatter_block",
+    "ineighbor_allgather", "ineighbor_alltoall",
+})
+
+#: Attribute calls that complete/consume a request handle.
+REQ_CONSUMERS = frozenset({
+    "wait", "test", "result", "free", "cancel", "start", "bind",
+    "on_complete", "pready", "pready_range", "pready_list", "parrived",
+})
+
+#: Free functions that consume request handles passed as arguments.
+REQ_CONSUMER_FNS = frozenset({
+    "wait_all", "wait_any", "wait_some", "test_all", "test_any",
+    "test_some", "start_all", "Pready", "Pready_range", "Pready_list",
+    "Parrived",
+})
+
+#: Blocking collective entry points (the per-comm coll vtable names).
+COLL_BASE_OPS = frozenset({
+    "allreduce", "bcast", "reduce", "allgather", "alltoall", "gather",
+    "scatter", "scan", "exscan", "barrier", "reduce_scatter",
+    "reduce_scatter_block", "allgatherv", "gatherv", "scatterv",
+    "alltoallv", "alltoallw", "neighbor_allgather", "neighbor_alltoall",
+})
+
+#: All collective spellings: blocking + nonblocking + persistent-init.
+COLL_OPS = frozenset(
+    set(COLL_BASE_OPS)
+    | {f"i{op}" for op in COLL_BASE_OPS}
+    | {f"{op}_init" for op in COLL_BASE_OPS}
+)
+
+#: Plain p2p calls whose user tag shares the pml tag space.
+P2P_TAGGED = frozenset({
+    "send", "isend", "recv", "irecv", "send_init", "recv_init",
+    "sendrecv", "probe", "iprobe", "improbe",
+})
+
+INT_DTYPES = frozenset({
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "bool_",
+})
+FLOAT_DTYPES = frozenset({
+    "float16", "float32", "float64", "bfloat16",
+})
+_ITEMSIZE = {
+    "int8": 1, "uint8": 1, "bool_": 1, "int16": 2, "uint16": 2,
+    "float16": 2, "bfloat16": 2, "int32": 4, "uint32": 4, "float32": 4,
+    "int64": 8, "uint64": 8, "float64": 8,
+}
+
+
+class LintRule(mca.Component):
+    """Base class: one correctness rule over a parsed file.
+
+    Subclasses set NAME (the rule id used in findings, baselines, and
+    suppression comments) and implement ``check(ctx)`` yielding
+    Findings. ``ctx`` is an analysis.lint.FileContext.
+    """
+
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable[Finding]:
+        raise NotImplementedError
+
+    def finding(self, ctx, node: ast.AST, message: str,
+                severity: Optional[Severity] = None) -> Finding:
+        return Finding(
+            rule=self.NAME,
+            severity=self.SEVERITY if severity is None else severity,
+            path=ctx.relpath,
+            line=getattr(node, "lineno", 0),
+            message=message,
+        )
+
+
+# -- shared AST helpers -----------------------------------------------------
+
+def call_name(node: ast.AST) -> Optional[str]:
+    """The unqualified callee name of a Call ('comm.isend(..)' -> 'isend')."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    if isinstance(fn, ast.Name):
+        return fn.id
+    return None
+
+
+def call_arg(call: ast.Call, pos: int, kw: str) -> Optional[ast.AST]:
+    """Argument by keyword name, falling back to position."""
+    for k in call.keywords:
+        if k.arg == kw:
+            return k.value
+    if pos < len(call.args):
+        return call.args[pos]
+    return None
+
+
+def const_int(node: Optional[ast.AST]) -> Optional[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    return None
+
+
+def const_str(node: Optional[ast.AST]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def dtype_name(node: Optional[ast.AST]) -> Optional[str]:
+    """'np.int8' / 'jnp.float32' / 'int8' / "int8" -> the dtype word."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def itemsize_of(dtype: Optional[str]) -> int:
+    return _ITEMSIZE.get(dtype or "", 4)
+
+
+def scopes(tree: ast.Module) -> Iterator[tuple[ast.AST, bool]]:
+    """Yield (scope_node, is_module): the module plus every function.
+
+    A scope's statements are analyzed together; nested functions form
+    their own scopes (their bodies are excluded from the enclosing
+    scope's walk by ``scope_walk``).
+    """
+    yield tree, True
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node, False
+
+
+def scope_walk(scope: ast.AST) -> Iterator[ast.AST]:
+    """ast.walk restricted to this scope: does not descend into nested
+    function definitions (they are separate scopes), but does descend
+    into class bodies, loops, withs, and tries."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(scope))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def name_uses(scope: ast.AST, name: str) -> list[ast.Name]:
+    """Every Name node for `name` inside the scope, document order."""
+    out = [
+        n for n in scope_walk(scope)
+        if isinstance(n, ast.Name) and n.id == name
+    ]
+    out.sort(key=lambda n: (n.lineno, n.col_offset))
+    return out
+
+
+def literal_elems(node: Optional[ast.AST]) -> Optional[int]:
+    """Element count of a literal shape: 1024 or (8, 128) -> 1024."""
+    n = const_int(node)
+    if n is not None:
+        return n
+    if isinstance(node, (ast.Tuple, ast.List)):
+        total = 1
+        for elt in node.elts:
+            v = const_int(elt)
+            if v is None:
+                return None
+            total *= v
+        return total
+    return None
+
+
+def infer_buffers(scope: ast.AST) -> dict[str, dict[str, Any]]:
+    """Best-effort env: var name -> {'dtype': str|None, 'elems': int|None}
+    from literal array constructors and .astype() calls in the scope."""
+    env: dict[str, dict[str, Any]] = {}
+    ctors = {"zeros", "ones", "full", "empty", "arange", "array",
+             "asarray", "normal", "uniform"}
+    for node in scope_walk(scope):
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        tgt = node.targets[0]
+        if not isinstance(tgt, ast.Name):
+            continue
+        val = node.value
+        cn = call_name(val)
+        if cn in ctors:
+            dt = dtype_name(call_arg(val, -1, "dtype"))
+            if dt is None and len(val.args) >= 2:
+                # positional dtype: np.zeros((n,), np.int8)
+                dt = dtype_name(val.args[-1])
+            if dt is not None and dt not in INT_DTYPES \
+                    and dt not in FLOAT_DTYPES:
+                dt = None
+            elems = None
+            if cn == "arange":
+                elems = const_int(call_arg(val, 0, "stop"))
+            elif val.args:
+                elems = literal_elems(val.args[0])
+            env[tgt.id] = {"dtype": dt, "elems": elems}
+        elif cn == "astype" and isinstance(val, ast.Call) \
+                and isinstance(val.func, ast.Attribute):
+            dt = dtype_name(call_arg(val, 0, "dtype"))
+            base = val.func.value
+            prev = env.get(base.id) if isinstance(base, ast.Name) else None
+            env[tgt.id] = {
+                "dtype": dt if dt in INT_DTYPES | FLOAT_DTYPES else None,
+                "elems": (prev or {}).get("elems"),
+            }
+    return env
+
+
+_registered = False
+
+
+def ensure_rules() -> None:
+    """Import every rule module for its registration side effect."""
+    global _registered
+    if not _registered:
+        from . import collectives  # noqa: F401
+        from . import excepts  # noqa: F401
+        from . import lifecycle  # noqa: F401
+        from . import quantuse  # noqa: F401
+        from . import requests  # noqa: F401
+        from . import tags  # noqa: F401
+
+        _registered = True

@@ -1,0 +1,93 @@
+"""Collective-schedule rules (the GC3 idea: schedules are programs).
+
+``colldiv``: collective call sequences that diverge across
+rank-dependent branches. MPI requires every rank of a communicator to
+issue the same collective sequence; an ``if rank == 0:`` branch whose
+body calls a different collective sequence than its else-branch (or
+calls collectives with no else at all) deadlocks the job. Only the
+operation sequence is compared — differing root/op ARGUMENTS across
+ranks are legal.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, COLL_OPS, LintRule, call_name
+
+_RANK_WORDS = ("rank", "process_index", "pid", "proc_id")
+
+
+def _mentions_rank(test: ast.AST) -> bool:
+    for node in ast.walk(test):
+        ident = None
+        if isinstance(node, ast.Name):
+            ident = node.id
+        elif isinstance(node, ast.Attribute):
+            ident = node.attr
+        if ident is None:
+            continue
+        low = ident.lower()
+        if any(w in low for w in _RANK_WORDS):
+            return True
+    return False
+
+
+def _coll_sequence(stmts: list[ast.stmt]) -> list[str]:
+    """Collective op names in program order across the statement list,
+    descending into nested control flow but not nested functions."""
+    out: list[str] = []
+
+    class V(ast.NodeVisitor):
+        def visit_Call(self, node: ast.Call) -> None:
+            fn = call_name(node)
+            if fn in COLL_OPS:
+                out.append(fn)
+            self.generic_visit(node)
+
+        def visit_FunctionDef(self, node) -> None:
+            pass  # separate schedule
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Lambda(self, node) -> None:
+            pass
+
+    v = V()
+    for s in stmts:
+        v.visit(s)
+    return out
+
+
+@COMMLINT.register
+class CollectiveDivergenceRule(LintRule):
+    NAME = "colldiv"
+    PRIORITY = 75
+    DESCRIPTION = ("collective call sequences must not diverge across "
+                   "rank-dependent branches")
+    SEVERITY = Severity.ERROR
+
+    def check(self, ctx) -> Iterable:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.If):
+                continue
+            if not _mentions_rank(node.test):
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            body = _coll_sequence(node.body)
+            orelse = _coll_sequence(node.orelse)
+            if body == orelse:
+                continue
+            # An early return/raise/abort branch is a legitimate exit —
+            # collectives after it are unreachable for those ranks only
+            # if the job is ending anyway.
+            yield self.finding(
+                ctx, node,
+                "collective sequence diverges across a rank-dependent "
+                f"branch: if-side {body or ['<none>']} vs else-side "
+                f"{orelse or ['<none>']} — ranks will block in "
+                "different collectives (deadlock)",
+            )

@@ -1,0 +1,77 @@
+"""Exception-hygiene rule.
+
+``broadexcept``: ``except Exception:`` (or bare ``except:``) in comm
+paths hides deadlocks, wire corruption, and component failures behind
+a green run. Silent handlers (body is only pass/.../continue) are
+errors; handlers that at least log or transform the exception are
+warnings, ratcheted by the self-lint baseline. Justified broad catches
+(``__del__``, user-callback dispatch, availability probes) carry a
+``# commlint: allow(broadexcept)`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule
+
+_BROAD = ("Exception", "BaseException")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except
+    names = t.elts if isinstance(t, ast.Tuple) else [t]
+    for n in names:
+        if isinstance(n, ast.Name) and n.id in _BROAD:
+            return True
+        if isinstance(n, ast.Attribute) and n.attr in _BROAD:
+            return True
+    return False
+
+
+def _is_silent(handler: ast.ExceptHandler) -> bool:
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass) or isinstance(stmt, ast.Continue):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(
+                stmt.value, ast.Constant):
+            continue  # docstring / Ellipsis
+        return False
+    return True
+
+
+@COMMLINT.register
+class BroadExceptRule(LintRule):
+    NAME = "broadexcept"
+    PRIORITY = 60
+    DESCRIPTION = ("broad except handlers hide comm failures; silent "
+                   "ones are errors")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node):
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            if _is_silent(node):
+                yield self.finding(
+                    ctx, node,
+                    "silent broad except (body is pass) — swallows "
+                    "comm-path failures; narrow the exception and log "
+                    "via core.logging.warn_once",
+                    severity=Severity.ERROR,
+                )
+            else:
+                yield self.finding(
+                    ctx, node,
+                    "broad `except Exception` in a comm path — narrow "
+                    "it or justify with `# commlint: "
+                    "allow(broadexcept)`",
+                )

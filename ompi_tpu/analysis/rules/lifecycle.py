@@ -1,0 +1,85 @@
+"""Object-lifecycle rule.
+
+``useafterfree``: a communicator/window/file handle used after its
+``free()`` (the reference's MPI_Comm_free sets the handle to
+MPI_COMM_NULL; here the object raises on next use — at runtime. This
+surfaces it statically). The analysis is flow-lite: within one scope,
+any Load of the name on a line after the ``free()`` call, with no
+intervening rebinding, is flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule, name_uses, scope_walk, scopes
+from .requests import _parent_map
+
+_FREE_METHODS = frozenset({"free", "Free", "close", "Close"})
+#: Only .free()/.close() receivers that look like comm-path handles are
+#: tracked; generic file objects etc. have their own idioms (with ...).
+_HANDLE_HINTS = ("comm", "win", "window", "dup", "inter", "sub", "fh",
+                 "req", "request")
+
+
+def _freed_names(scope: ast.AST):
+    """(name, line) for `name.free()` expression statements."""
+    for node in scope_walk(scope):
+        if not isinstance(node, ast.Expr):
+            continue
+        call = node.value
+        if not isinstance(call, ast.Call) or call.args or call.keywords:
+            continue
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _FREE_METHODS \
+                and isinstance(fn.value, ast.Name):
+            name = fn.value.id
+            if any(h in name.lower() for h in _HANDLE_HINTS) \
+                    or fn.attr in ("free", "Free"):
+                yield name, node.lineno
+
+
+@COMMLINT.register
+class UseAfterFreeRule(LintRule):
+    NAME = "useafterfree"
+    PRIORITY = 65
+    DESCRIPTION = "communicator/window handles must not be used after free()"
+    SEVERITY = Severity.ERROR
+
+    def check(self, ctx) -> Iterable:
+        for scope, _is_mod in scopes(ctx.tree):
+            freed = list(_freed_names(scope))
+            if not freed:
+                continue
+            parents = _parent_map(scope)
+            for name, free_line in freed:
+                if ctx.suppressed(free_line, self.NAME):
+                    continue
+                for use in name_uses(scope, name):
+                    if use.lineno <= free_line:
+                        continue
+                    if isinstance(use.ctx, ast.Store):
+                        break  # rebound: later uses are a fresh object
+                    # Only an operation on the handle is a defect;
+                    # inspecting attributes post-free is legitimate.
+                    parent = parents.get(use)
+                    gp = parents.get(parent) if parent is not None else None
+                    is_method_call = (
+                        isinstance(parent, ast.Attribute)
+                        and isinstance(gp, ast.Call) and gp.func is parent
+                        and parent.attr not in ("name", "cid")
+                        and not parent.attr.startswith("_")
+                    )
+                    if not is_method_call:
+                        continue
+                    if parent.attr in _FREE_METHODS:
+                        continue  # double-free is tolerated (idempotent)
+                    yield self.finding(
+                        ctx, use,
+                        f"{name!r}.{parent.attr}() called after free() "
+                        f"on line {free_line} — freed handles raise on "
+                        "use",
+                    )
+                    break  # one finding per freed handle

@@ -1,0 +1,138 @@
+"""Quantized-wire eligibility rule (the EQuARX gate, statically).
+
+``quantuse``: coll/tuned refuses the quantized tier at dispatch time
+for integer dtypes, order-statistic / non-psum ops, and payloads under
+``coll_quant_min_bytes`` (coll/quant.supports + the tuned decision
+layer). Violations of those gates in user code are either silent
+no-ops (the exact tier is silently substituted) or — when the quant
+entry points are called directly — numerically wrong. This rule
+mirrors the runtime gate so the misuse surfaces at lint time.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ...core import config
+from ..report import Severity
+from . import (
+    COMMLINT,
+    INT_DTYPES,
+    LintRule,
+    call_arg,
+    call_name,
+    const_str,
+    dtype_name,
+    infer_buffers,
+    itemsize_of,
+    scopes,
+    scope_walk,
+)
+
+#: Direct quantized-wire entry points (payload is the first argument).
+_QUANT_FNS = frozenset({
+    "allreduce_quant_ring", "allreduce_block_quant", "quant_roundtrip",
+    "allreduce_error_feedback",
+})
+
+#: Ops the quant tier refuses: order statistics (any representable-value
+#: change alters the result) and every non-psum accumulation.
+_REFUSED_OPS = frozenset({
+    "max", "min", "maxloc", "minloc", "land", "lor", "lxor", "band",
+    "bor", "bxor", "prod",
+})
+
+_OP_POS = {
+    "allreduce_quant_ring": 2,
+    "allreduce_block_quant": 2,
+    "allreduce_error_feedback": 3,
+}
+#: Payload argument position (allreduce_error_feedback takes comm first).
+_PAYLOAD_POS = {"allreduce_error_feedback": 1}
+
+
+def _min_bytes() -> int:
+    return int(config.get("coll_quant_min_bytes", 64 << 10) or 64 << 10)
+
+
+@COMMLINT.register
+class QuantMisuseRule(LintRule):
+    NAME = "quantuse"
+    PRIORITY = 70
+    DESCRIPTION = ("quantized-wire calls must satisfy the tuned "
+                   "dtype/op/size gates")
+    SEVERITY = Severity.ERROR
+
+    def check(self, ctx) -> Iterable:
+        min_bytes = _min_bytes()
+        for scope, _is_mod in scopes(ctx.tree):
+            env = infer_buffers(scope)
+            for node in scope_walk(scope):
+                fn = call_name(node)
+                if fn in _QUANT_FNS:
+                    yield from self._check_direct(
+                        ctx, node, fn, env, min_bytes
+                    )
+                elif fn == "decide_allreduce":
+                    yield from self._check_decide(ctx, node)
+
+    def _check_direct(self, ctx, node: ast.Call, fn: str, env: dict,
+                      min_bytes: int) -> Iterable:
+        if ctx.suppressed(node.lineno, self.NAME):
+            return
+        pos = _PAYLOAD_POS.get(fn, 0)
+        payload = node.args[pos] if len(node.args) > pos else None
+        info = env.get(payload.id) if isinstance(payload, ast.Name) \
+            else None
+        dt = (info or {}).get("dtype")
+        if dt in INT_DTYPES:
+            yield self.finding(
+                ctx, node,
+                f"{fn}() on an integer payload ({dt}) — the quantized "
+                "wire is float-only; tuned's runtime gate would refuse "
+                "this (coll/quant.supports)",
+            )
+        op = const_str(call_arg(node, _OP_POS.get(fn, 2), "op"))
+        if op is not None and op.lower() in _REFUSED_OPS:
+            yield self.finding(
+                ctx, node,
+                f"{fn}() with op={op!r} — order-statistic/non-psum "
+                "ops must stay exact (quantization changes "
+                "representable values)",
+            )
+        elems = (info or {}).get("elems")
+        if elems is not None and dt is not None:
+            nbytes = elems * itemsize_of(dt)
+            if nbytes < min_bytes:
+                yield self.finding(
+                    ctx, node,
+                    f"{fn}() on a {nbytes}-byte payload, below "
+                    f"coll_quant_min_bytes ({min_bytes}) — small "
+                    "messages are dispatch-bound; quant only trades "
+                    "FLOPs for wire bytes",
+                    severity=Severity.WARNING,
+                )
+
+    def _check_decide(self, ctx, node: ast.Call) -> Iterable:
+        allow = call_arg(node, 99, "allow_quant")
+        if not (isinstance(allow, ast.Constant) and allow.value is True):
+            return
+        if ctx.suppressed(node.lineno, self.NAME):
+            return
+        dt = dtype_name(call_arg(node, 99, "dtype"))
+        if dt in INT_DTYPES:
+            yield self.finding(
+                ctx, node,
+                f"decide_allreduce(allow_quant=True) with dtype={dt} — "
+                "integer payloads never take the quantized wire; the "
+                "override is a silent no-op",
+            )
+        op = const_str(call_arg(node, 99, "op"))
+        if op is not None and op.lower() in _REFUSED_OPS:
+            yield self.finding(
+                ctx, node,
+                f"decide_allreduce(allow_quant=True) with op={op!r} — "
+                "non-psum ops are always exact; the override is a "
+                "silent no-op",
+            )

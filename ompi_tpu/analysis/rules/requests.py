@@ -1,0 +1,189 @@
+"""Request-lifecycle rules (MPI-Checker's request-usage class).
+
+- ``reqlife``: a nonblocking/persistent/partitioned request that is
+  discarded at the call site, or bound to a name that is never
+  completed (wait/test/result), freed, started, or escaped — the
+  classic missing-wait defect.
+- ``partready``: a Psend_init request that is started/waited but never
+  has MPI_Pready issued for any declared partition — the send can
+  never complete (MPI-4 §4.2).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import (
+    COMMLINT,
+    LintRule,
+    REQ_CONSUMER_FNS,
+    REQ_CONSUMERS,
+    REQ_MAKERS,
+    call_name,
+    name_uses,
+    scope_walk,
+    scopes,
+)
+
+#: Attribute reads that neither complete nor leak the handle.
+_PASSIVE_ATTRS = frozenset({
+    "status", "done", "state", "partitions", "sending", "buffer",
+    "persistent",
+})
+
+
+def _classify_uses(scope: ast.AST, name: str, assign: ast.Assign):
+    """(consumed, escaped, used): how the request handle is treated."""
+    consumed = escaped = used = False
+    parents = _parent_map(scope)
+    for use in name_uses(scope, name):
+        if use is assign.targets[0]:
+            continue
+        if isinstance(use.ctx, ast.Store):
+            # rebinding: lifetime analysis past this point is unsound
+            escaped = True
+            continue
+        used = True
+        parent = parents.get(use)
+        if isinstance(parent, ast.Attribute):
+            gp = parents.get(parent)
+            if parent.attr in REQ_CONSUMERS and isinstance(gp, ast.Call) \
+                    and gp.func is parent:
+                consumed = True
+            elif parent.attr not in _PASSIVE_ATTRS:
+                escaped = True  # unknown method/attr: assume it matters
+        elif isinstance(parent, ast.Call):
+            # handle passed to a call: wait_all(...) consumes, anything
+            # else escapes our analysis
+            if call_name(parent) in REQ_CONSUMER_FNS:
+                consumed = True
+            else:
+                escaped = True
+        elif isinstance(parent, (ast.Return, ast.Yield, ast.YieldFrom,
+                                 ast.List, ast.Tuple, ast.Set, ast.Dict,
+                                 ast.Starred, ast.Await, ast.Compare,
+                                 ast.BoolOp, ast.IfExp, ast.Subscript)):
+            escaped = True
+        elif isinstance(parent, (ast.Assign, ast.AugAssign, ast.AnnAssign,
+                                 ast.NamedExpr, ast.keyword)):
+            escaped = True
+    return consumed, escaped, used
+
+
+def _parent_map(scope: ast.AST) -> dict[ast.AST, ast.AST]:
+    parents: dict[ast.AST, ast.AST] = {}
+    for node in scope_walk(scope):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    for child in ast.iter_child_nodes(scope):
+        parents.setdefault(child, scope)
+    return parents
+
+
+def _request_bindings(scope: ast.AST):
+    """(assign, name, maker) for `r = comm.isend(...)`-shaped statements."""
+    for node in scope_walk(scope):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            maker = call_name(node.value)
+            if maker in REQ_MAKERS:
+                yield node, node.targets[0].id, maker
+
+
+@COMMLINT.register
+class RequestLifetimeRule(LintRule):
+    NAME = "reqlife"
+    PRIORITY = 90
+    DESCRIPTION = ("nonblocking/persistent requests must be waited, "
+                   "tested, freed, or escape the scope")
+    SEVERITY = Severity.ERROR
+
+    def check(self, ctx) -> Iterable:
+        for scope, _is_mod in scopes(ctx.tree):
+            for node in scope_walk(scope):
+                # discarded at the call site: `comm.isend(x, 1)` as a
+                # bare expression statement
+                if isinstance(node, ast.Expr):
+                    maker = call_name(node.value)
+                    if maker in REQ_MAKERS and not ctx.suppressed(
+                            node.lineno, self.NAME):
+                        yield self.finding(
+                            ctx, node,
+                            f"request from {maker}() is discarded — "
+                            "never waited, tested, or freed",
+                        )
+            for assign, name, maker in _request_bindings(scope):
+                if ctx.suppressed(assign.lineno, self.NAME):
+                    continue
+                consumed, escaped, used = _classify_uses(
+                    scope, name, assign
+                )
+                if consumed or escaped:
+                    continue
+                if not used:
+                    yield self.finding(
+                        ctx, assign,
+                        f"request {name!r} from {maker}() is never "
+                        "used — missing wait/test/free",
+                    )
+                else:
+                    yield self.finding(
+                        ctx, assign,
+                        f"request {name!r} from {maker}() is inspected "
+                        "but never completed (wait/test/result) or "
+                        "freed",
+                    )
+
+
+@COMMLINT.register
+class PreadyMissingRule(LintRule):
+    NAME = "partready"
+    PRIORITY = 85
+    DESCRIPTION = ("a started Psend_init request needs Pready for its "
+                   "declared partitions")
+    SEVERITY = Severity.ERROR
+
+    _READY = frozenset({"pready", "pready_range", "pready_list"})
+    _READY_FNS = frozenset({"Pready", "Pready_range", "Pready_list"})
+
+    def check(self, ctx) -> Iterable:
+        for scope, _is_mod in scopes(ctx.tree):
+            parents = _parent_map(scope)
+            for assign, name, maker in _request_bindings(scope):
+                if maker not in ("psend_init", "Psend_init"):
+                    continue
+                if ctx.suppressed(assign.lineno, self.NAME):
+                    continue
+                started = readied = escaped = False
+                for use in name_uses(scope, name):
+                    if use is assign.targets[0]:
+                        continue
+                    parent = parents.get(use)
+                    if isinstance(parent, ast.Attribute):
+                        if parent.attr in self._READY:
+                            readied = True
+                        elif parent.attr in ("start", "wait", "result"):
+                            started = True
+                        elif parent.attr not in _PASSIVE_ATTRS \
+                                and parent.attr not in REQ_CONSUMERS:
+                            escaped = True
+                    elif isinstance(parent, ast.Call):
+                        fn = call_name(parent)
+                        if fn in self._READY_FNS:
+                            readied = True
+                        elif fn == "start_all":
+                            started = True
+                        else:
+                            escaped = True
+                    elif parent is not None and not isinstance(
+                            parent, ast.Expr):
+                        escaped = True
+                if started and not readied and not escaped:
+                    yield self.finding(
+                        ctx, assign,
+                        f"partitioned send {name!r} is started but "
+                        "Pready is never issued for any declared "
+                        "partition — the transfer cannot complete",
+                    )

@@ -1,0 +1,108 @@
+"""Tag-space rules.
+
+``parttags``: part/persist re-blocks a partitioned pair's traffic into
+a derived pml tag namespace — transfer k of user tag t travels as
+``(t + 1) * part_persist_tag_stride + k`` (DESIGN.md §11). Plain p2p
+traffic on the same communicator whose literal tag lands inside an
+active derived band is matched against partitioned transfers and
+silently corrupts both streams. The rule mirrors that arithmetic
+statically: it collects the derived bands implied by every
+Psend_init/Precv_init literal tag in the module and flags plain
+send/recv-family tags that fall inside any band (and, more weakly, any
+plain tag at or above the stride once partitioned communication is in
+use at all).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ...core import config
+from ..report import Severity
+from . import (
+    COMMLINT,
+    LintRule,
+    P2P_TAGGED,
+    call_arg,
+    call_name,
+    const_int,
+    scope_walk,
+)
+
+_PART_INITS = {
+    # callee -> (positional index of tag, kw name)
+    "psend_init": 3,
+    "precv_init": 2,
+    "Psend_init": 4,
+    "Precv_init": 3,
+}
+_P2P_TAG_POS = {
+    "send": 1, "isend": 1, "send_init": 1,
+    "recv": 1, "irecv": 1, "recv_init": 1,
+    "probe": 1, "iprobe": 1, "improbe": 1,
+    "sendrecv": 3,
+}
+
+
+def _tag_stride() -> int:
+    try:
+        from ...part import persist  # noqa: F401 - registers the cvar
+    except ImportError:
+        pass
+    return int(config.get("part_persist_tag_stride", 4096) or 4096)
+
+
+@COMMLINT.register
+class PartTagCollisionRule(LintRule):
+    NAME = "parttags"
+    PRIORITY = 80
+    DESCRIPTION = ("plain p2p tags must stay clear of part/persist's "
+                   "derived tag namespace")
+    SEVERITY = Severity.ERROR
+
+    def check(self, ctx) -> Iterable:
+        stride = _tag_stride()
+        part_tags: list[int] = []
+        plain: list[tuple[ast.Call, str, int]] = []
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = call_name(node)
+            if fn in _PART_INITS:
+                t = const_int(call_arg(node, _PART_INITS[fn], "tag"))
+                part_tags.append(0 if t is None else t)
+            elif fn in P2P_TAGGED:
+                t = const_int(
+                    call_arg(node, _P2P_TAG_POS.get(fn, 1), "tag")
+                )
+                if t is not None and t >= 0:
+                    plain.append((node, fn, t))
+        if not part_tags:
+            return
+        bands = sorted(
+            ((t + 1) * stride, (t + 2) * stride) for t in part_tags
+        )
+        for node, fn, t in plain:
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            hit = next(
+                ((lo, hi) for lo, hi in bands if lo <= t < hi), None
+            )
+            if hit is not None:
+                yield self.finding(
+                    ctx, node,
+                    f"{fn}() tag {t} collides with part/persist's "
+                    f"derived band [{hit[0]}, {hit[1]}) for partitioned "
+                    f"user tag {hit[0] // stride - 1} — plain and "
+                    "partitioned traffic will cross-match",
+                )
+            elif t >= stride:
+                yield self.finding(
+                    ctx, node,
+                    f"{fn}() tag {t} is inside the derived tag "
+                    f"namespace (>= part_persist_tag_stride {stride}) "
+                    "while partitioned communication is in use — keep "
+                    f"user tags below {stride}",
+                    severity=Severity.WARNING,
+                )

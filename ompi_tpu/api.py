@@ -82,6 +82,9 @@ def init(
             len(procs),
             {p.platform for p in procs},
         )
+        from .analysis import sanitizer as _sanitizer
+
+        _sanitizer.maybe_enable()
         from .hook import run_hooks
 
         run_hooks("at_init_bottom", comm_world)
@@ -93,8 +96,14 @@ def initialized() -> bool:
 
 
 def finalize() -> None:
-    """Tear down communicators (MPI_Finalize). Safe to call twice."""
+    """Tear down communicators (MPI_Finalize). Safe to call twice.
+
+    When the sanitizer is active its finalize matching runs first (leaked
+    requests, unmatched sends, cross-rank collective order); teardown
+    always completes, and the sanitizer's verdict is raised at the very
+    end so a second finalize is a clean no-op."""
     global _state
+    san_err = None
     with _lock:
         if _state is None:
             return
@@ -102,6 +111,9 @@ def finalize() -> None:
         from .hook import run_hooks
 
         run_hooks("at_finalize_top", _state.comm_world)
+        from .analysis import sanitizer as _sanitizer
+
+        san_err = _sanitizer.finalize_check()
         try:
             from .monitoring.monitoring import maybe_dump_at_finalize
 
@@ -124,6 +136,8 @@ def finalize() -> None:
             if not comm._freed:
                 comm.free()
         _state = None
+    if san_err is not None:
+        raise san_err
 
 
 def _world() -> _World:

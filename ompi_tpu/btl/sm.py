@@ -620,8 +620,12 @@ class ShmEndpoint:
         # unmapping (same discipline as DcnEndpoint.close).
         try:
             self._lib.shm_notify(self._ctx)
-        except Exception:
-            pass
+        except (OSError, AttributeError) as exc:
+            # the segment may already be torn down on the other side;
+            # waiters fall back to their poll timeout
+            from ..core.logging import warn_once
+
+            warn_once("btl.sm", "shm close: wake notify failed: %s", exc)
         deadline = time.monotonic() + 5.0
         remaining = 1
         while time.monotonic() < deadline:
@@ -642,8 +646,8 @@ class ShmEndpoint:
     def __del__(self) -> None:
         try:
             self.close()
-        except Exception:
-            pass
+        except Exception:  # commlint: allow(broadexcept)
+            pass  # interpreter shutdown: nothing sane to do or log
 
 
 def engine_available() -> bool:
@@ -695,9 +699,11 @@ class SmBtl(BtlComponent):
             return False  # in-process: self/ici win
         from ..pml.framework import PML
 
+        from ..core.errors import ComponentError
+
         try:
             ob1 = PML.component("ob1")
-        except Exception:
+        except ComponentError:
             return False
         eng = getattr(ob1, "_fabric", None)
         if eng is None:
@@ -720,9 +726,11 @@ class SmBtl(BtlComponent):
         themselves — their mechanism is not observable from here."""
         from ..pml.framework import PML
 
+        from ..core.errors import ComponentError
+
         try:
             eng = getattr(PML.component("ob1"), "_fabric", None)
-        except Exception:
+        except ComponentError:
             return self.NAME
         if eng is None or eng.shm is None:
             return self.NAME

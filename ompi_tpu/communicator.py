@@ -199,6 +199,10 @@ class Communicator(HasAttributes, HasErrhandler):
                     if hasattr(leaf, "nbytes"):
                         nbytes += leaf.nbytes
             MONITOR.record_coll(self.cid, opname, nbytes)
+        from .analysis import sanitizer
+
+        if sanitizer.active():
+            sanitizer.record_coll(self, opname)
         return fn(self, *args, **kw)
 
     def allreduce(self, x, op="sum"):

@@ -67,6 +67,16 @@ class CounterRegistry:
                 c = self.counter(name)
             c.add(amount)
 
+    def hwm(self, name: str, value: float) -> None:
+        """High-watermark counter: keeps the max ever observed (the
+        reference's SPC watermark-class variables, ompi_spc.h)."""
+        if not self.enabled:
+            return
+        c = self.counter(name, unit="max")
+        with c._lock:
+            if value > c.value:
+                c.value = value
+
     @contextmanager
     def timer(self, name: str) -> Iterator[None]:
         """Accumulate wall seconds into `<name>_seconds` — timer-class

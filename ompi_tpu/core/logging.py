@@ -71,6 +71,21 @@ def register_verbose_var(framework: str) -> None:
     set_verbosity(framework, var.value or 0)
 
 
+_WARN_SEEN: set[tuple] = set()
+
+
+def warn_once(stream: str, message: str, *args) -> None:
+    """Log a warning once per (stream, message, args) — the
+    opal_show_help aggregation discipline for recoverable comm-path
+    conditions that would otherwise spam every message."""
+    key = (stream, message, args)
+    with _LOCK:
+        if key in _WARN_SEEN:
+            return
+        _WARN_SEEN.add(key)
+    get_logger(stream).warning(message, *args)
+
+
 def show_help(topic: str, message: str, *args, once: bool = True) -> None:
     """Emit a user-facing help/error message, deduplicated by (topic,args)
     like the reference's aggregated show_help."""

@@ -100,6 +100,15 @@ def assert_accessible(buf: Any, what: str = "buffer") -> None:
                 )
 
 
+def leak_report(what: str) -> MemcheckError:
+    """Request-leak reporting channel (analysis/sanitizer.py): a leaked
+    nonblocking request is exactly a buffer that stays undefined
+    forever, so leaks count as memchecker violations and surface
+    through the same error class."""
+    SPC.record("memchecker_violations")
+    return MemcheckError(what)
+
+
 def reset() -> None:
     with _lock:
         _undefined.clear()

@@ -25,6 +25,16 @@ from .errors import ArgumentError, RequestError
 ANY_SOURCE = -1
 ANY_TAG = -1
 
+#: Runtime-sanitizer hook (analysis/sanitizer.py installs a Tracker
+#: here). Kept as one module global so the disabled case costs a single
+#: None check per lifecycle event.
+_TRACKER = None
+
+
+def set_tracker(tracker) -> None:
+    global _TRACKER
+    _TRACKER = tracker
+
 
 @dataclass
 class Status:
@@ -62,6 +72,8 @@ class Request:
         # The handle itself stays usable (result()/status) — start()
         # clears the mark for persistent reuse.
         self._harvested = False
+        if _TRACKER is not None:
+            _TRACKER.created(self)
 
     # -- completion -------------------------------------------------------
 
@@ -77,6 +89,8 @@ class Request:
         if status is not None:
             self.status = status
         self.state = RequestState.COMPLETE
+        if _TRACKER is not None:
+            _TRACKER.completed(self)
         from . import peruse
         from . import progress as _progress
 
@@ -133,6 +147,8 @@ class Request:
         if self.state == RequestState.ACTIVE:
             self.state = RequestState.CANCELLED
             self.status.cancelled = True
+            if _TRACKER is not None:
+                _TRACKER.completed(self)
 
     def start(self) -> "Request":
         """(Re)activate a persistent request (MPI_Start)."""
@@ -143,6 +159,8 @@ class Request:
         self.state = RequestState.ACTIVE
         self.status = Status()
         self._harvested = False
+        if _TRACKER is not None:
+            _TRACKER.started(self)
         self._start()
         return self
 
@@ -151,6 +169,8 @@ class Request:
 
     def free(self) -> None:
         self._callbacks.clear()
+        if _TRACKER is not None:
+            _TRACKER.freed(self)
 
 
 class CompletedRequest(Request):

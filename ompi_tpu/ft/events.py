@@ -83,7 +83,9 @@ def raise_event(evclass: EventClass, **info: Any) -> Event:
     for h in targets:
         try:
             h(ev)
-        except Exception:
+        # user-callback dispatch: a handler may raise anything, and one
+        # bad handler must not starve the rest
+        except Exception:  # commlint: allow(broadexcept)
             logger.exception("event handler failed for %s", evclass)
     if evclass in (EventClass.PROC_FAILED, EventClass.DEVICE_ERROR):
         _route_to_errhandlers(ev)
@@ -108,7 +110,8 @@ def _route_to_errhandlers(ev: Event) -> None:
             # ERRORS_RETURN re-raises; routing must still reach the
             # remaining comms — the caller sees failures via handlers.
             pass
-        except Exception:
+        # user errhandlers are arbitrary callbacks (see above)
+        except Exception:  # commlint: allow(broadexcept)
             logger.exception("errhandler raised for %s", comm.name)
 
 
@@ -137,7 +140,9 @@ def check_devices(comm=None) -> list[int]:
             val = jax.device_put(jnp.ones((), jnp.int32), dev)
             if int(val) != 1:
                 raise RuntimeError(f"bad probe result {val}")
-        except Exception as exc:
+        # the probe's whole job is converting ANY device failure mode
+        # into a PROC_FAILED event
+        except Exception as exc:  # commlint: allow(broadexcept)
             failed.append(r)
             raise_event(
                 EventClass.PROC_FAILED,

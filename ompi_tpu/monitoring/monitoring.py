@@ -39,9 +39,18 @@ def maybe_dump_at_finalize() -> None:
     if _dump_at_finalize.value and MONITOR.enabled:
         import json
 
+        payload = MONITOR.flush()
+        from ..core.counters import SPC
+
+        sanitizer = {
+            k: v for k, v in SPC.snapshot().items()
+            if k.startswith("sanitizer_")
+        }
+        if sanitizer:
+            payload["sanitizer"] = sanitizer
         print(
             "ompi_tpu monitoring summary:\n"
-            + json.dumps(MONITOR.flush(), indent=2)
+            + json.dumps(payload, indent=2)
         )
 
 

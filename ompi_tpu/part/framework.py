@@ -58,6 +58,9 @@ def select_for_comm(comm) -> PartComponent:
     ensure_components()
     if _selected is None:
         _selected = PART.select_one(comm=comm)
+        from ..analysis import sanitizer
+
+        _selected = sanitizer.maybe_wrap_part(_selected)
     return _selected
 
 

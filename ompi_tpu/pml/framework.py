@@ -38,6 +38,11 @@ def select_for_comm(comm) -> PmlComponent:
         from ..ft import vprotocol
 
         _selected = vprotocol.maybe_wrap(selected, PML)
+        # Sanitizer interposition sits outermost so it observes the
+        # traffic exactly as the application issued it.
+        from ..analysis import sanitizer
+
+        _selected = sanitizer.maybe_wrap_pml(_selected)
     return _selected
 
 

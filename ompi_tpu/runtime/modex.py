@@ -42,7 +42,12 @@ def _kv_client():
 
         state = distributed.global_state
         return getattr(state, "client", None)
-    except Exception:
+    except (ImportError, AttributeError) as exc:
+        # private-module layout drift across jax versions: fall back to
+        # the in-process table
+        from ..core.logging import warn_once
+
+        warn_once("modex", "coordinator KV client unavailable: %s", exc)
         return None
 
 
@@ -73,7 +78,9 @@ def get(key: str, timeout_s: float = 60.0) -> Any:
                 _PREFIX + key, int(timeout_s * 1000)
             )
             return dss.unpack_one(bytes.fromhex(raw))
-        except Exception as exc:
+        # the KV client raises version-dependent opaque types; every one
+        # becomes a ModexError with the key attached
+        except Exception as exc:  # commlint: allow(broadexcept)
             raise ModexError(f"modex get({key!r}) failed: {exc}") from exc
     deadline = time.monotonic() + timeout_s
     while True:

@@ -1,0 +1,131 @@
+"""commlint CLI — static communication-correctness analysis.
+
+Usage:
+    python -m ompi_tpu.tools.lint <path> [<path> ...]
+    python -m ompi_tpu.tools.lint ompi_tpu --baseline \\
+        ompi_tpu/analysis/selfcheck_baseline.json
+    python -m ompi_tpu.tools.lint ompi_tpu --write-baseline
+    python -m ompi_tpu.tools.lint --rules
+
+Exit codes: 0 clean (or within baseline), 1 findings at error severity /
+baseline regressions, 2 the run itself failed (unreadable files,
+crashing rule).
+
+The baseline is a ratchet (analysis/report.Baseline): per-(rule, file)
+finding counts, failures only on increases. ``--write-baseline``
+regenerates it after debt is paid down; review the diff — counts must
+only go down.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from ..analysis.lint import Linter
+from ..analysis.report import Baseline, Severity
+
+DEFAULT_BASELINE = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "analysis", "selfcheck_baseline.json",
+)
+
+
+def _list_rules() -> str:
+    from ..analysis.rules import COMMLINT, ensure_rules
+
+    ensure_rules()
+    lines = ["commlint rules:"]
+    for comp in COMMLINT.select_all():
+        lines.append(
+            f"  {comp.NAME:<14} prio={comp.priority:<4} "
+            f"{comp.DESCRIPTION}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.lint",
+        description="static communication-correctness linter",
+    )
+    ap.add_argument("paths", nargs="*", help="files or directories")
+    ap.add_argument("--select", default=None,
+                    help="rule filter, e.g. 'reqlife,parttags' or "
+                         "'^broadexcept' (the commlint_select cvar)")
+    ap.add_argument("--base", default=None,
+                    help="root findings are keyed relative to "
+                         "(default: the common parent of PATHS)")
+    ap.add_argument("--baseline", default=None,
+                    help="ratchet file to enforce (counts may not grow)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the ratchet from this run "
+                         "(default target: the self-check baseline)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable findings")
+    ap.add_argument("--rules", action="store_true",
+                    help="list registered rules and exit")
+    args = ap.parse_args(argv)
+
+    if args.rules:
+        print(_list_rules())
+        return 0
+    if not args.paths:
+        ap.error("no paths given (or use --rules)")
+
+    base = args.base
+    if base is None:
+        dirs = [p if os.path.isdir(p) else os.path.dirname(p) or "."
+                for p in args.paths]
+        base = os.path.commonpath([os.path.abspath(d) for d in dirs])
+    linter = Linter(select=args.select, base=base)
+    report = linter.lint_paths(args.paths)
+
+    if args.as_json:
+        payload = report.to_dict()
+        payload["files_checked"] = linter.files_checked
+        payload["elapsed_ms"] = round(linter.elapsed_ms, 3)
+        payload["errors"] = linter.errors
+        print(json.dumps(payload, indent=2))
+    else:
+        print(report.render())
+        print(
+            f"({linter.files_checked} file(s), "
+            f"{len(linter.rules)} rule(s), "
+            f"{linter.elapsed_ms:.0f} ms)"
+        )
+    for err in linter.errors:
+        print(f"commlint: run error: {err}", file=sys.stderr)
+
+    if args.write_baseline:
+        target = args.baseline or DEFAULT_BASELINE
+        Baseline.from_report(report).save(target)
+        print(f"commlint: baseline written to {target}")
+        return 2 if linter.errors else 0
+
+    if args.baseline:
+        baseline = Baseline.load(args.baseline)
+        regressions = baseline.regressions(report)
+        for line in regressions:
+            print(f"commlint: regression: {line}", file=sys.stderr)
+        improvements = baseline.improvements(report)
+        if improvements:
+            print(
+                "commlint: %d bucket(s) improved — tighten the "
+                "baseline with --write-baseline" % len(improvements)
+            )
+        if linter.errors:
+            return 2
+        return 1 if regressions else 0
+
+    if linter.errors:
+        return 2
+    if report.max_severity() >= Severity.ERROR:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Seeded defect: collective sequence diverges across a rank branch.
+
+Expected: flagged by `colldiv` only.
+"""
+
+
+def diverge(comm, x):
+    if comm.my_rank == 0:
+        out = comm.allreduce(x, "sum")
+    else:
+        out = comm.bcast(x, root=0)
+    return out

@@ -1,0 +1,17 @@
+"""Seeded defect: plain p2p tag inside part/persist's derived band.
+
+User tag 1 re-blocks as pml tags [(1+1)*stride, (2+1)*stride) =
+[8192, 12288) at the default stride of 4096; the plain send below lands
+exactly on 8192.
+
+Expected: flagged by `parttags` only.
+"""
+
+
+def collide(comm, buf):
+    sreq = comm.psend_init(buf, 4, dest=1, tag=1)
+    sreq.start()
+    sreq.pready_range(0, 3)
+    sreq.wait()
+    sreq.free()
+    comm.send(buf, dest=0, tag=8192)

@@ -1,0 +1,13 @@
+"""Seeded defect: a started partitioned send with no Pready ever issued.
+
+Without Pready the component never sees a filled partition and the
+transfer cannot complete (MPI-4 §4.2).
+
+Expected: flagged by `partready` only.
+"""
+
+
+def forget_pready(comm, buf):
+    sreq = comm.psend_init(buf, 4, dest=1, tag=2)
+    sreq.start()
+    sreq.wait()

@@ -1,0 +1,16 @@
+"""Seeded defect: the quantized allreduce tier on an integer payload.
+
+coll/quant.supports() refuses integer dtypes at runtime (quantization
+of already-discrete values silently corrupts them); the direct entry
+point skips that gate.
+
+Expected: flagged by `quantuse` only.
+"""
+import numpy as np
+
+from ompi_tpu.coll.quant import allreduce_quant_ring
+
+
+def quantize_ints(axis_name):
+    grads = np.zeros((8, 65536), np.int8)
+    return allreduce_quant_ring(grads, axis_name, "sum")

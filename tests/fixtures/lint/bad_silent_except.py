@@ -1,0 +1,11 @@
+"""Seeded defect: silent broad except around a collective.
+
+Expected: flagged by `broadexcept` only.
+"""
+
+
+def swallow(comm, x):
+    try:
+        return comm.allreduce(x, "sum")
+    except Exception:
+        pass

@@ -1,0 +1,15 @@
+"""Seeded defect: a nonblocking send whose request is never completed.
+
+Expected: flagged by `reqlife` only.
+"""
+import numpy as np
+
+
+def leak_send(comm):
+    req = comm.isend(np.ones(4), dest=1, tag=3)
+    return None
+
+
+def discard_at_callsite(comm, x):
+    comm.irecv(source=0, tag=3, dest=1)
+    return x

@@ -1,0 +1,11 @@
+"""Seeded defect: communicator used after free().
+
+Expected: flagged by `useafterfree` only.
+"""
+
+
+def free_then_use(world, x):
+    sub = world.dup()
+    sub.barrier()
+    sub.free()
+    return sub.allreduce(x, "sum")

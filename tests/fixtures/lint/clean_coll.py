@@ -1,0 +1,22 @@
+"""Clean fixture: rank-dependent arguments (legal) with a uniform
+collective sequence, and a quant call satisfying every tuned gate.
+
+Expected: no findings.
+"""
+import numpy as np
+
+from ompi_tpu.coll.quant import allreduce_quant_ring
+
+
+def root_dependent_args(comm, x):
+    # Differing ARGUMENTS across ranks are fine; the op sequence matches.
+    if comm.my_rank == 0:
+        out = comm.bcast(x, root=0)
+    else:
+        out = comm.bcast(None, root=0)
+    return comm.allreduce(out, "sum")
+
+
+def quantized_psum(axis_name):
+    grads = np.zeros((8, 65536), np.float32)
+    return allreduce_quant_ring(grads, axis_name, "sum")

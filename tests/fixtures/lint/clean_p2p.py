@@ -1,0 +1,31 @@
+"""Clean fixture: every request is completed or escapes legitimately.
+
+Expected: no findings.
+"""
+import numpy as np
+
+from ompi_tpu.core.request import wait_all
+
+
+def pingpong(comm, x):
+    req = comm.isend(x, dest=1, tag=1)
+    out = comm.recv(source=0, tag=1, dest=1)
+    req.wait()
+    return out
+
+
+def fan_out(comm, xs):
+    reqs = [comm.isend(x, dest=i, tag=0) for i, x in enumerate(xs)]
+    wait_all(reqs)
+
+
+def tested_then_freed(comm):
+    req = comm.irecv(source=0, tag=2, dest=1)
+    done, _status = req.test()
+    if not done:
+        req.cancel()
+    req.free()
+
+
+def escapes_to_caller(comm, x):
+    return comm.isend(x, dest=1, tag=4)

@@ -1,0 +1,20 @@
+"""Clean fixture: partitioned pair honoring the Pready/Parrived
+contract, plain tags clear of the derived namespace.
+
+Expected: no findings.
+"""
+
+
+def partitioned_roundtrip(comm, buf, like):
+    sreq = comm.psend_init(buf, 4, dest=1, tag=1)
+    rreq = comm.precv_init(4, 0, tag=1, dest=1, like=like)
+    rreq.start()
+    sreq.start()
+    sreq.pready_range(0, 3)
+    while not rreq.parrived(3):
+        pass
+    sreq.wait()
+    rreq.wait()
+    sreq.free()
+    rreq.free()
+    comm.send(buf, dest=0, tag=5)

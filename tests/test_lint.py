@@ -1,0 +1,168 @@
+"""commlint static analyzer: seeded fixtures, suppressions, the
+self-lint ratchet, and the CLI."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ompi_tpu.analysis.lint import Linter, lint_tree
+from ompi_tpu.analysis.report import Baseline, Finding, Report, Severity
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "fixtures", "lint")
+REPO = os.path.dirname(HERE)
+PKG = os.path.join(REPO, "ompi_tpu")
+BASELINE = os.path.join(PKG, "analysis", "selfcheck_baseline.json")
+
+#: Each seeded-defect fixture must be flagged by exactly this rule.
+EXPECTED = {
+    "bad_unwaited_request.py": "reqlife",
+    "bad_branch_divergent.py": "colldiv",
+    "bad_part_tag_collision.py": "parttags",
+    "bad_quant_int8.py": "quantuse",
+    "bad_use_after_free.py": "useafterfree",
+    "bad_silent_except.py": "broadexcept",
+    "bad_pready_missing.py": "partready",
+}
+
+
+@pytest.mark.parametrize("fname,rule", sorted(EXPECTED.items()))
+def test_seeded_fixture_flagged_by_intended_rule(fname, rule):
+    lin = Linter(base=FIXTURES)
+    rep = lin.lint_paths([os.path.join(FIXTURES, fname)])
+    assert not lin.errors, lin.errors
+    assert {f.rule for f in rep} == {rule}, rep.render()
+    assert rep.max_severity() is Severity.ERROR
+
+
+def test_clean_fixtures_quiet():
+    clean = [
+        os.path.join(FIXTURES, f) for f in sorted(os.listdir(FIXTURES))
+        if f.startswith("clean_")
+    ]
+    assert len(clean) >= 3
+    lin = Linter(base=FIXTURES)
+    rep = lin.lint_paths(clean)
+    assert len(rep) == 0, rep.render()
+
+
+def test_every_fixture_is_covered():
+    bad = {
+        f for f in os.listdir(FIXTURES)
+        if f.startswith("bad_") and f.endswith(".py")
+    }
+    assert bad == set(EXPECTED)
+
+
+def test_suppression_comment_silences():
+    src = (
+        "def f(comm, x):\n"
+        "    comm.isend(x, 1)  # commlint: allow(reqlife)\n"
+    )
+    lin = Linter()
+    assert lin.lint_source(src) == []
+    # previous-line form
+    src2 = (
+        "def f(comm, x):\n"
+        "    # commlint: allow(reqlife)\n"
+        "    comm.isend(x, 1)\n"
+    )
+    assert lin.lint_source(src2) == []
+    # a different rule's allowance does not silence it
+    src3 = (
+        "def f(comm, x):\n"
+        "    comm.isend(x, 1)  # commlint: allow(broadexcept)\n"
+    )
+    assert [f.rule for f in lin.lint_source(src3)] == ["reqlife"]
+
+
+def test_rule_select_filter():
+    path = os.path.join(FIXTURES, "bad_silent_except.py")
+    only = Linter(select="broadexcept", base=FIXTURES)
+    assert [r.NAME for r in only.rules] == ["broadexcept"]
+    assert len(only.lint_paths([path])) == 1
+    without = Linter(select="^broadexcept", base=FIXTURES)
+    assert "broadexcept" not in {r.NAME for r in without.rules}
+    assert len(without.lint_paths([path])) == 0
+    # the scoped filter must not leak into later instances
+    assert len(Linter().rules) >= 7
+
+
+def test_syntax_error_is_run_error_not_crash():
+    lin = Linter()
+    assert lin.lint_source("def broken(:\n", path="x.py") == []
+    assert lin.errors and "syntax error" in lin.errors[0]
+
+
+def test_selflint_within_checked_in_ratchet():
+    """The repo must stay at or below its own checked-in debt."""
+    assert os.path.exists(BASELINE), (
+        "self-check baseline missing — regenerate with "
+        "python -m ompi_tpu.tools.lint ompi_tpu --write-baseline"
+    )
+    rep = lint_tree(PKG)
+    regressions = Baseline.load(BASELINE).regressions(rep)
+    assert regressions == [], "\n".join(
+        ["commlint debt grew past the ratchet:"] + regressions
+    )
+
+
+def test_selflint_counts_are_nontrivial():
+    # the analyzer actually runs over the tree (guards against an
+    # accidentally-empty walk making the ratchet vacuous)
+    lin = Linter(base=PKG)
+    lin.lint_paths([PKG])
+    assert lin.files_checked > 50
+    assert not lin.errors, lin.errors
+
+
+def test_baseline_ratchet_mechanics(tmp_path):
+    rep = Report([
+        Finding("reqlife", Severity.ERROR, "a.py", 3, "m"),
+        Finding("reqlife", Severity.ERROR, "a.py", 9, "m"),
+        Finding("colldiv", Severity.ERROR, "b.py", 1, "m"),
+    ])
+    path = str(tmp_path / "b.json")
+    Baseline.from_report(rep).save(path)
+    base = Baseline.load(path)
+    assert base.regressions(rep) == []
+    worse = Report(list(rep) + [
+        Finding("reqlife", Severity.ERROR, "a.py", 30, "m")
+    ])
+    assert any("reqlife:a.py" in r for r in base.regressions(worse))
+    better = Report([Finding("colldiv", Severity.ERROR, "b.py", 1, "m")])
+    assert base.regressions(better) == []
+    assert any("reqlife:a.py" in s for s in base.improvements(better))
+
+
+def _run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.lint", *args],
+        capture_output=True, text=True, cwd=REPO, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+
+
+def test_cli_flags_fixture_and_exits_nonzero():
+    res = _run_cli(os.path.join(FIXTURES, "bad_unwaited_request.py"),
+                   "--json")
+    assert res.returncode == 1, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert payload["findings"]
+    assert {f["rule"] for f in payload["findings"]} == {"reqlife"}
+
+
+def test_cli_baseline_enforcement_passes_on_self():
+    res = _run_cli("ompi_tpu", "--baseline", BASELINE)
+    assert res.returncode == 0, res.stdout + res.stderr
+
+
+def test_cli_lists_rules():
+    res = _run_cli("--rules")
+    assert res.returncode == 0
+    for rule in ("reqlife", "partready", "parttags", "colldiv",
+                 "quantuse", "useafterfree", "broadexcept"):
+        assert rule in res.stdout

@@ -1,0 +1,258 @@
+"""commsan runtime sanitizer: tracker matching logic (unit) and the
+2-controller divergence/leak catch (integration).
+
+The in-process finalize-path tests live in tests/test_zz_finalize.py —
+they tear down the world communicator, so they must collect last.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+from collections import Counter
+
+import pytest
+
+from ompi_tpu.analysis.sanitizer import Tracker
+from ompi_tpu.core.request import RequestState
+
+
+class _FakeComm:
+    def __init__(self, cid, name="COMM"):
+        self.cid = cid
+        self.name = name
+
+
+class _FakeReq:
+    state = RequestState.ACTIVE
+
+
+# -- unit: p2p send/recv accounting ----------------------------------------
+
+def test_unmatched_send_flagged():
+    t = Tracker()
+    c = _FakeComm(0, "WORLD")
+    t.p2p_send(c, 0, 1, tag=5)
+    rep = t.report()
+    assert [f.rule for f in rep] == ["san-unmatched"]
+    assert "0->1" in next(iter(rep)).message
+
+
+def test_matched_send_recv_clean():
+    t = Tracker()
+    c = _FakeComm(0)
+    t.p2p_send(c, 0, 1, tag=5)
+    t.p2p_recv(c, 0, tag=5, dst=1)
+    assert len(t.report()) == 0
+
+
+def test_wildcard_recv_covers_send():
+    t = Tracker()
+    c = _FakeComm(0)
+    t.p2p_send(c, 0, 1, tag=5)
+    t.p2p_recv(c, None, tag=5, dst=1)  # ANY_SOURCE post
+    assert len(t.report()) == 0
+
+
+def test_uninferred_source_matches_specific_recv():
+    # send with unknown src (-1) is covered by any specific recv at dst
+    t = Tracker()
+    c = _FakeComm(0)
+    t.p2p_send(c, None, 1, tag=5)
+    t.p2p_recv(c, 0, tag=5, dst=1)
+    assert len(t.report()) == 0
+
+
+def test_unmatched_counts_shortfall_not_total():
+    sends = Counter({"0:0:1": 3})
+    recvs = Counter({"0:0:1": 1, "0:*:1": 1})
+    out = Tracker._unmatched_findings(sends, recvs)
+    assert len(out) == 1 and "1 send(s)" in out[0].message
+
+
+# -- unit: collective-order divergence -------------------------------------
+
+def test_identical_sequences_no_divergence():
+    a, b = Tracker(), Tracker()
+    c = _FakeComm(1, "sub")
+    for t in (a, b):
+        t.record_coll(c, "allreduce")
+        t.record_coll(c, "barrier")
+        t.record_coll(c, "bcast")
+    assert a._divergence_findings(a._payload(), {1: b._payload()}, 0) == []
+
+
+def test_divergent_sequences_flagged_at_first_mismatch():
+    a, b = Tracker(), Tracker()
+    c = _FakeComm(1, "sub")
+    a.record_coll(c, "barrier")
+    b.record_coll(c, "barrier")
+    a.record_coll(c, "allreduce")
+    b.record_coll(c, "bcast")
+    out = a._divergence_findings(a._payload(), {1: b._payload()}, 0)
+    assert [f.rule for f in out] == ["san-colldiv"]
+    msg = out[0].message
+    assert "call #1" in msg and "1:allreduce" in msg and "1:bcast" in msg
+
+
+def test_missing_tail_collective_flagged():
+    a, b = Tracker(), Tracker()
+    c = _FakeComm(2)
+    a.record_coll(c, "allreduce")
+    b.record_coll(c, "allreduce")
+    a.record_coll(c, "barrier")  # rank 1 never issues this one
+    out = a._divergence_findings(a._payload(), {1: b._payload()}, 0)
+    assert len(out) == 1 and "<nothing>" in out[0].message
+
+
+def test_crc_chain_survives_seq_cap():
+    # beyond max_events the verbatim seq stops growing but the CRC chain
+    # still distinguishes orders
+    from ompi_tpu.core import config
+
+    prev = config.get("sanitizer_base_max_events", 4096)
+    config.set("sanitizer_base_max_events", 4)
+    try:
+        a, b = Tracker(), Tracker()
+        c = _FakeComm(0)
+        for _ in range(6):
+            a.record_coll(c, "allreduce")
+            b.record_coll(c, "allreduce")
+        a.record_coll(c, "bcast")
+        b.record_coll(c, "barrier")
+        assert len(a._coll.seq) == 4
+        pa, pb = a._payload(), b._payload()
+        assert pa["coll_crc"] != pb["coll_crc"]
+        assert a._divergence_findings(pa, {1: pb}, 0)
+    finally:
+        config.set("sanitizer_base_max_events", prev)
+
+
+# -- unit: request-leak detection ------------------------------------------
+
+def test_active_request_reported_as_leak():
+    t = Tracker()
+    req = _FakeReq()
+    t.created(req)
+    t.annotate(req, "irecv", "src=0 tag=9 comm=WORLD")
+    out = t._leak_findings()
+    assert [f.rule for f in out] == ["san-leak"]
+    assert "irecv" in out[0].message and "src=0 tag=9" in out[0].message
+    # this file is outside the package, so origin points here
+    assert out[0].path.endswith("test_sanitizer.py")
+
+
+def test_completed_and_freed_requests_not_leaks():
+    t = Tracker()
+    done, freed = _FakeReq(), _FakeReq()
+    t.created(done)
+    t.created(freed)
+    t.completed(done)
+    t.freed(freed)
+    assert t._leak_findings() == []
+
+
+def test_partial_pready_reported():
+    t = Tracker()
+    req = _FakeReq()
+    req.sending = True
+    req._flagged = [True, False, False, True]
+    t.created(req)
+    t.annotate(req, "psend_init", "partitions=4 dst=1 tag=0 comm=WORLD")
+    rules = [f.rule for f in t._leak_findings()]
+    assert rules == ["san-leak", "san-partready"]
+
+
+# -- integration: two controller processes ---------------------------------
+
+_SAN_WORKER = textwrap.dedent(r"""
+    import os, sys
+    pid = int(sys.argv[1])
+    coord = sys.argv[2]
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=2"
+    )
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu
+    from ompi_tpu import Group
+    from ompi_tpu.analysis import sanitizer
+
+    sanitizer.enable()  # before init: wrappers interpose at selection
+    jax.distributed.initialize(
+        coordinator_address=coord, num_processes=2, process_id=pid,
+        local_device_ids=[0, 1],
+    )
+    world = ompi_tpu.init()
+    assert world.size == 4, world.size
+
+    # Same derived-comm construction order on both controllers ->
+    # identical cids (process-local counter): each process gets the
+    # subcomm of its own two local ranks, so collectives stay local.
+    lo = 2 * pid
+    sub = world.create(Group([lo, lo + 1]))
+
+    # Seeded defect 1: rank-divergent collective order on cid(sub).
+    if pid == 0:
+        sub.allreduce(np.ones((2, 4), np.float32), "sum")
+    else:
+        sub.bcast(np.ones((2, 4), np.float32), root=0)
+
+    # Seeded defect 2: a deliberately leaked local irecv per process.
+    world.rank(lo + 1).irecv(source=lo, tag=5)
+
+    try:
+        ompi_tpu.finalize()
+    except Exception as exc:
+        msg = str(exc)
+        assert "san-leak" in msg, msg
+        assert "san-colldiv" in msg, msg
+    else:
+        raise SystemExit("sanitizer missed the seeded defects")
+    assert not ompi_tpu.initialized()
+    print(f"WORKER {pid} OK", flush=True)
+""")
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _run_worker_pair(worker, *extra_args, timeout=240):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", worker, str(pid),
+             *[str(a) for a in extra_args]],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+            env=env, cwd="/root/repo",
+        )
+        for pid in range(2)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, err = p.communicate(timeout=timeout)
+            outs.append((p.returncode, out, err))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for rc, out, err in outs:
+        assert rc == 0, f"worker failed:\n{err[-3000:]}"
+        assert "OK" in out
+
+
+def test_two_process_sanitizer_catches_leak_and_divergence():
+    """Acceptance: the sanitizer catches a leaked request AND a
+    rank-divergent collective across two controller processes, with
+    the verdicts exchanged over the modex at finalize."""
+    _run_worker_pair(_SAN_WORKER, f"127.0.0.1:{_free_port()}")
