@@ -1134,6 +1134,158 @@ def _bucket_fusion_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_FAULT_DRILL_WORKER = r"""
+import os, sys, time, json
+pid = int(sys.argv[1]); coord = sys.argv[2]; ckdir = sys.argv[3]
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=2")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu import Group
+from ompi_tpu.btl import dcn
+from ompi_tpu.coll import hier
+from ompi_tpu.ft import elastic, inject
+from ompi_tpu.ft.manager import CheckpointManager
+from ompi_tpu.runtime import modex
+
+elastic.recoverable()
+try:
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=2, process_id=pid,
+                               local_device_ids=[0, 1],
+                               heartbeat_timeout_seconds=10)
+except TypeError:
+    jax.distributed.initialize(coordinator_address=coord,
+                               num_processes=2, process_id=pid,
+                               local_device_ids=[0, 1])
+world = ompi_tpu.init()
+local_ranks = [r for r, p in enumerate(world.procs)
+               if p.process_index == pid]
+remote_ranks = [r for r in range(world.size) if r not in local_ranks]
+if pid == 1:
+    # the victim: faultline exits it cleanly at its next barrier
+    inject.arm("rank_kill@coll:op=barrier,count=1,exit=0")
+comm = world.create(Group(local_ranks))
+ep = dcn.DcnEndpoint()
+modex.publish_dcn_address(ep, pid)
+table = modex.collect_dcn_addresses(2, timeout_s=60)
+peer_ids = {i: ep.connect(ip, port, cookie=pid + 1)
+            for i, (ip, port) in table.items() if i != pid}
+h = hier.SliceHandle(comm=comm, endpoint=ep, slice_id=pid,
+                     n_slices=2, peer_ids=peer_ids)
+other = 1 - pid
+elastic.watch_dcn({peer_ids[other]: remote_ranks,
+                   -(other + 1): remote_ranks})
+mgr = CheckpointManager(ckdir)
+state = {"x": np.arange(world.size * 8, dtype=np.float32)
+         .reshape(world.size, 8)}
+if pid == 0:
+    mgr.save(1, state)
+x = comm.put_rank_major(np.full((comm.size, 4), pid + 1.0, np.float32))
+hier.allreduce(h, x)   # round 1: both controllers alive
+if pid == 1:
+    time.sleep(0.3)
+    comm.barrier()     # faultline rank_kill: os._exit(0)
+    os._exit(1)        # unreachable
+t0 = time.perf_counter()
+try:
+    hier.allreduce(h, x, timeout=30.0)
+except dcn.DcnError:
+    pass
+t_detect = time.perf_counter()
+elastic.detach()
+new_comm, restored, meta = elastic.respawn(world, mgr)
+t_respawn = time.perf_counter()
+xs = np.asarray(restored["['x']"])
+out = np.asarray(new_comm.allreduce(new_comm.put_rank_major(xs)))
+t_resume = time.perf_counter()
+assert np.allclose(out[0], xs.sum(axis=0))
+print("FAULTDRILL " + json.dumps({
+    "detect_ms": round((t_detect - t0) * 1e3, 1),
+    "shrink_respawn_ms": round((t_respawn - t_detect) * 1e3, 1),
+    "resume_step_ms": round((t_resume - t_respawn) * 1e3, 1),
+    "recovery_ms": round((t_resume - t0) * 1e3, 1),
+}), flush=True)
+os._exit(0)
+"""
+
+
+def _fault_drill_row(trials: int = 3) -> dict:
+    """End-to-end recovery time for an injected controller death:
+    faultline rank_kill on pid 1 -> survivor detects over the live DCN
+    fabric -> shrink + respawn from checkpoint -> resume one training
+    step. Full job bring-up per trial, so p50 over a few trials."""
+    import tempfile
+
+    try:
+        runs = []
+        for _ in range(trials):
+            with tempfile.TemporaryDirectory() as ck:
+                row = _run_pair(_FAULT_DRILL_WORKER, "FAULTDRILL", ck,
+                                timeout=240)
+            if "recovery_ms" not in row:
+                return row
+            runs.append(row)
+        runs.sort(key=lambda r: r["recovery_ms"])
+        med = runs[len(runs) // 2]
+        return {
+            "trials": trials,
+            "recovery_p50_ms": med["recovery_ms"],
+            "detect_ms": med["detect_ms"],
+            "shrink_respawn_ms": med["shrink_respawn_ms"],
+            "resume_step_ms": med["resume_step_ms"],
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _degraded_allreduce_row() -> dict:
+    """Wire bandwidth of the inter-slice segment exchange (the
+    wire-bound stage of hier allreduce) with one DCN link killed vs
+    healthy. The send path detects the lost link and re-stripes onto
+    survivors (SPC dcn_restripes); the row is the throughput it keeps,
+    not just that it survives."""
+    try:
+        from ompi_tpu.btl.dcn import DcnEndpoint
+        from ompi_tpu.native import build
+
+        if not build.available():
+            return {"skipped": "native library unavailable"}
+        a, b = DcnEndpoint(), DcnEndpoint()
+        try:
+            peer = a.connect(b.address[0], b.address[1], cookie=1)
+            links0 = a.peer_links(peer)
+            payload = b"x" * (32 << 20)
+
+            def gbps(iters: int = 5) -> float:
+                ts = []
+                for _ in range(iters):
+                    t0 = time.perf_counter()
+                    a.send_bytes(peer, 1, payload)
+                    b.recv_bytes(30.0)
+                    ts.append(time.perf_counter() - t0)
+                return len(payload) / float(np.median(ts)) / 1e9
+
+            gbps()  # warm
+            healthy = gbps()
+            a.kill_link(peer, 0)
+            degraded = gbps()  # heal_links re-stripes at send entry
+            return {
+                "links_healthy": links0,
+                "links_degraded": a.peer_links(peer),
+                "gbps_healthy": round(healthy, 2),
+                "gbps_one_link_down": round(degraded, 2),
+                "retained_frac": round(degraded / healthy, 2),
+            }
+        finally:
+            a.close()
+            b.close()
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _HOST_ROWS_CACHE: dict = {}
 
 
@@ -1180,6 +1332,10 @@ def _host_rows() -> dict:
     rows["dp_bucket_fusion"] = _bucket_fusion_row()
     _set_phase("commlint self-analysis")
     rows["commlint"] = _commlint_row()
+    _set_phase("degraded allreduce (one dcn link down)")
+    rows["degraded_allreduce"] = _degraded_allreduce_row()
+    _set_phase("fault drill (inject -> detect -> respawn -> resume)")
+    rows["fault_drill"] = _fault_drill_row()
     return rows
 
 

@@ -1,0 +1,116 @@
+"""Polling-hygiene rule.
+
+``polldeadline``: a ``while`` loop that parks in a fixed
+``time.sleep(<const>)`` with no deadline or backoff evidence in the
+loop body spins forever when the condition it polls never comes true —
+the classic hang mode of modex gets, name-service lookups, and
+connection retries. Comm-path polls must either consult a clock
+(``time.monotonic()`` / ``perf_counter`` against a deadline) or use
+``core.backoff.Backoff``, whose ``sleep()`` is deadline-bounded and
+backs off exponentially.
+
+``time.sleep(0)`` anywhere is a bare scheduler yield — usually a
+busy-wait in disguise; the one intentional yield (the progress
+engine's starvation guard) carries a ``# commlint:
+allow(polldeadline)`` suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule, scope_walk
+
+#: Names whose appearance inside the loop counts as deadline/backoff
+#: evidence: clock reads, deadline arithmetic, or a Backoff object.
+_EVIDENCE = frozenset({
+    "monotonic", "monotonic_ns", "perf_counter", "perf_counter_ns",
+    "process_time", "time_ns", "deadline", "remaining", "expired",
+    "Backoff", "backoff", "progress_until", "wait_event",
+})
+
+
+def _is_time_sleep(node: ast.AST) -> bool:
+    """Matches ``time.sleep(...)`` and bare ``sleep(...)`` (from-import
+    spelling); does NOT match method calls like ``bo.sleep()``."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr == "sleep" and isinstance(fn.value, ast.Name) \
+            and fn.value.id == "time"
+    return isinstance(fn, ast.Name) and fn.id == "sleep"
+
+
+def _sleep_const(call: ast.Call):
+    """The constant numeric sleep argument, or None when dynamic."""
+    if len(call.args) != 1:
+        return None
+    a = call.args[0]
+    if isinstance(a, ast.Constant) and isinstance(a.value, (int, float)) \
+            and not isinstance(a.value, bool):
+        return a.value
+    return None
+
+
+def _has_evidence(loop: ast.While) -> bool:
+    for node in scope_walk(loop):
+        if isinstance(node, ast.Name) and node.id in _EVIDENCE:
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _EVIDENCE:
+            return True
+    return False
+
+
+@COMMLINT.register
+class PollDeadlineRule(LintRule):
+    NAME = "polldeadline"
+    PRIORITY = 55
+    DESCRIPTION = ("fixed-interval poll loops must be deadline-bounded "
+                   "(core.backoff.Backoff or an explicit clock check)")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        flagged: set[int] = set()
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.While):
+                continue
+            if _has_evidence(node):
+                continue
+            for inner in scope_walk(node):
+                if not _is_time_sleep(inner):
+                    continue
+                val = _sleep_const(inner)
+                if val is None or val <= 0:
+                    continue  # dynamic delay / yield handled below
+                if ctx.suppressed(inner.lineno, self.NAME):
+                    continue
+                if inner.lineno in flagged:
+                    continue
+                flagged.add(inner.lineno)
+                yield self.finding(
+                    ctx, inner,
+                    "fixed-interval poll loop with no deadline — a "
+                    "never-published key spins forever; bound it with "
+                    "core.backoff.Backoff(timeout=...) or a "
+                    "time.monotonic() deadline",
+                    severity=Severity.ERROR,
+                )
+        for node in ast.walk(ctx.tree):
+            if not _is_time_sleep(node):
+                continue
+            val = _sleep_const(node)
+            if val != 0:
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            if node.lineno in flagged:
+                continue
+            yield self.finding(
+                ctx, node,
+                "time.sleep(0) is a bare scheduler yield — a busy-wait "
+                "in disguise; justify with `# commlint: "
+                "allow(polldeadline)` or use a bounded wait",
+            )

@@ -41,6 +41,11 @@ _connect_timeout = config.register(
     "btl", "dcn", "connect_timeout_ms", type=int, default=5000,
     description="Per-link connect timeout (reference tcp connect FSM)",
 )
+_send_retry = config.register(
+    "btl", "dcn", "send_retry_ms", type=int, default=200,
+    description="How long a failed send retries with backoff before "
+    "escalating (rides out in-flight link failover)",
+)
 
 
 class DcnError(OmpiTpuError):
@@ -87,6 +92,10 @@ class DcnEndpoint:
         # (which would leave the payload pinned until close()).
         self._send_mu = threading.Lock()
         self._closed = False
+        # Link-failover bookkeeping: last observed live-link count per
+        # peer, so heal_links can tell "lost a link, survivors remain"
+        # (re-stripe) from "endpoint dead" (escalate).
+        self._peer_links_seen: dict[int, int] = {}
 
     @contextlib.contextmanager
     def _native_call(self, *, what: str):
@@ -131,14 +140,25 @@ class DcnEndpoint:
             raise DcnError("cookie must be > 0")
         tmo = timeout_ms if timeout_ms is not None \
             else _connect_timeout.value
+        from ..core.backoff import Backoff
+
+        # One retry budget shared by the whole call (cold-start race:
+        # the peer's listeners may come up late) — refused pairs back
+        # off and retry until the budget runs out, then each remaining
+        # pair still gets its single attempt.
+        bo = Backoff(initial=0.02, maximum=0.25, timeout=tmo / 1000.0)
         peer = -1
         failed = []
         for local_ip, ip, port in pairs:
-            got = self._lib.dcn_connect_from(
-                self._ctx, peer,
-                (local_ip or "").encode(), ip.encode(), port, 1,
-                cookie, tmo,
-            )
+            while True:
+                got = self._lib.dcn_connect_from(
+                    self._ctx, peer,
+                    (local_ip or "").encode(), ip.encode(), port, 1,
+                    cookie, max(1, int(min(tmo, bo.remaining() * 1000))),
+                )
+                if got >= 0 or not bo.sleep():
+                    break
+                SPC.record("dcn_connect_retries")
             if got < 0:
                 # CQ scores are heuristics, not reachability probes: a
                 # failed pair degrades the peer to fewer links instead
@@ -152,6 +172,7 @@ class DcnEndpoint:
             logger.warning("multi-NIC peer degraded: %d/%d pairs "
                            "failed (%s)", len(failed), len(pairs),
                            failed)
+        self._peer_links_seen[int(peer)] = self.peer_links(int(peer))
         return int(peer)
 
     def link_addrs(self, peer: int) -> list[tuple[str, str]]:
@@ -173,47 +194,86 @@ class DcnEndpoint:
         return out
 
     def connect(self, ip: str, port: int, *, cookie: int,
-                nlinks: Optional[int] = None) -> int:
+                nlinks: Optional[int] = None,
+                timeout_ms: Optional[int] = None) -> int:
         """Open striped links to a peer listener; returns the local peer
         id. `cookie` must be globally unique per connecting endpoint
-        (the modex rank works) so the passive side can group links."""
+        (the modex rank works) so the passive side can group links.
+
+        Refused connections retry with exponential backoff until
+        `connect_timeout` — at job start the peer's listener may simply
+        not be up yet (the cold-start race between controllers; the
+        reference's connect FSM retries the same way)."""
         if cookie <= 0:
             raise DcnError("cookie must be > 0")
         n = nlinks if nlinks is not None else max(1, _links.value)
-        peer = self._lib.dcn_connect(
-            self._ctx, ip.encode(), port, n, cookie,
-            _connect_timeout.value,
-        )
-        if peer < 0:
-            raise DcnError(f"connect to {ip}:{port} failed")
-        return peer
+        tmo = timeout_ms if timeout_ms is not None \
+            else _connect_timeout.value
+        from ..core.backoff import Backoff
+
+        bo = Backoff(initial=0.02, maximum=0.25, timeout=tmo / 1000.0)
+        while True:
+            remaining_ms = max(1, int(bo.remaining() * 1000))
+            peer = self._lib.dcn_connect(
+                self._ctx, ip.encode(), port, n, cookie, remaining_ms,
+            )
+            if peer >= 0:
+                self._peer_links_seen[int(peer)] = \
+                    self.peer_links(int(peer))
+                return int(peer)
+            if not bo.sleep():
+                raise DcnError(
+                    f"connect to {ip}:{port} failed after "
+                    f"{bo.attempts + 1} attempt(s) over {tmo} ms"
+                )
+            SPC.record("dcn_connect_retries")
 
     # -- data --------------------------------------------------------------
 
     def send_bytes(self, peer: int, tag: int, data) -> int:
         buf = np.ascontiguousarray(np.frombuffer(data, np.uint8))
-        with self._native_call(what="send"), self._send_mu:
-            msgid = self._lib.dcn_send_ref(
-                self._ctx, peer, tag, buf.ctypes.data, buf.nbytes
-            )
-            if msgid < 0:
+        self.heal_links(peer)
+        bo = None
+        while True:
+            with self._native_call(what="send"), self._send_mu:
+                msgid = self._lib.dcn_send_ref(
+                    self._ctx, peer, tag, buf.ctypes.data, buf.nbytes
+                )
+                if msgid >= 0:
+                    # Zero-copy contract: the engine references `buf`
+                    # directly for rendezvous payloads; pin it until
+                    # the completion id pops. Registration happens
+                    # under _send_mu so a concurrent poll_send_complete
+                    # can't claim the id first. Every send also drains
+                    # finished completions so non-polling callers don't
+                    # keep flushed payloads pinned; drained ids are
+                    # preserved losslessly for explicit pollers.
+                    self._send_refs[int(msgid)] = buf
+                    while True:
+                        done = int(self._lib.dcn_poll_send(self._ctx))
+                        if not done:
+                            break
+                        self._send_refs.pop(done, None)
+                        self._pending_send_done.append(done)
+                    SPC.record("dcn_send_bytes", buf.nbytes)
+                    return int(msgid)
+            # Send refused: the peer is unknown, or every link dropped
+            # in-flight. Retry briefly with backoff — the passive side
+            # of a failover may still be re-establishing links — then
+            # escalate through check_peer (DEVICE_ERROR only when the
+            # whole endpoint is dead, keeping elastic.watch_dcn
+            # semantics).
+            if peer not in self._peer_links_seen:
                 raise DcnError(f"send to unknown peer {peer}")
-            # Zero-copy contract: the engine references `buf` directly
-            # for rendezvous payloads; pin it until the completion id
-            # pops. Registration happens under _send_mu so a concurrent
-            # poll_send_complete can't claim the id first. Every send
-            # also drains finished completions so non-polling callers
-            # don't keep flushed payloads pinned; drained ids are
-            # preserved losslessly for explicit pollers.
-            self._send_refs[int(msgid)] = buf
-            while True:
-                done = int(self._lib.dcn_poll_send(self._ctx))
-                if not done:
-                    break
-                self._send_refs.pop(done, None)
-                self._pending_send_done.append(done)
-        SPC.record("dcn_send_bytes", buf.nbytes)
-        return int(msgid)
+            if bo is None:
+                from ..core.backoff import Backoff
+
+                bo = Backoff(initial=0.005, maximum=0.05,
+                             timeout=_send_retry.value / 1000.0)
+            if not bo.sleep():
+                self.check_peer(peer, what="send to peer")
+                raise DcnError(f"send to peer {peer} failed")
+            SPC.record("dcn_send_retries")
 
     def _consume_receipt(self, msgid: int, peer, tag, length
                          ) -> tuple[int, int, bytes]:
@@ -335,6 +395,47 @@ class DcnEndpoint:
         (every link died — the btl_tcp endpoint-failed state)."""
         return int(self._lib.dcn_peer_links(self._ctx, peer))
 
+    def kill_link(self, peer: int, idx: int = 0) -> int:
+        """Deterministically sever link `idx` to `peer` (faultline's
+        injection primitive and the drill suite's link-failure lever).
+        Frames still queued on the dying link salvage onto survivors
+        inside the engine. Returns the surviving link count."""
+        left = int(self._lib.dcn_kill_link(self._ctx, int(peer),
+                                           int(idx)))
+        if left < 0:
+            raise DcnError(f"kill_link: unknown peer {peer}")
+        SPC.record("dcn_links_killed")
+        logger.warning(
+            "dcn peer %d: link %d severed, %d link(s) surviving",
+            peer, idx, left,
+        )
+        return left
+
+    def heal_links(self, peer: int) -> int:
+        """Failover: notice links lost since the last look and
+        re-stripe traffic uniformly over the survivors (any configured
+        bandwidth weights were sized for the full link set). Returns
+        the live link count (-1 = unknown peer). DEVICE_ERROR is NOT
+        raised here — partial link loss is a degraded-but-healthy
+        state; only check_peer escalates, and only when every link is
+        gone."""
+        peer = int(peer)
+        live = self.peer_links(peer)
+        seen = self._peer_links_seen.get(peer)
+        if seen is not None and 0 < live < seen:
+            try:
+                self.set_link_weights(peer, None)
+            except DcnError:
+                pass
+            SPC.record("dcn_restripes")
+            logger.warning(
+                "dcn peer %d: %d link(s) down, re-striped over %d "
+                "survivor(s)", peer, seen - live, live,
+            )
+        if live > 0:
+            self._peer_links_seen[peer] = live
+        return live
+
     # -- tag-matching offload (reference: mtl.h:418-421) -------------------
 
     def enable_matching(self, dcn_tag: int) -> None:
@@ -405,8 +506,10 @@ class DcnEndpoint:
         return self.peer_links(peer) > 0
 
     def check_peer(self, peer: int, *, what: str = "peer") -> None:
-        """Raise (and report a failure event) if the peer is dead."""
-        if not self.peer_alive(peer):
+        """Raise (and report a failure event) if the peer is dead.
+        Partial link loss re-stripes silently (heal_links); only a
+        fully dead endpoint escalates to DEVICE_ERROR."""
+        if self.heal_links(peer) <= 0:
             from ..ft import events
 
             events.raise_event(
@@ -420,7 +523,7 @@ class DcnEndpoint:
 
     def stats(self) -> dict:
         names = ("bytes_sent", "bytes_recv", "eager_sends", "rndv_sends",
-                 "frags_sent", "links")
+                 "frags_sent", "links", "restriped_frames")
         return {
             n: int(self._lib.dcn_stat(self._ctx, i))
             for i, n in enumerate(names)
@@ -493,7 +596,11 @@ class DcnBtl(BtlComponent):
     def endpoint(self) -> DcnEndpoint:
         if self._endpoint is None:
             self._endpoint = DcnEndpoint()
-        return self._endpoint
+        # faultline interposes at the endpoint boundary (sanitizer
+        # pattern): a no-op passthrough unless a fault plan is armed.
+        from ..ft import inject
+
+        return inject.maybe_wrap_dcn(self._endpoint)
 
     def wire_up(self, peer_addrs: dict[int, tuple[str, int]],
                 my_index: int,

@@ -119,5 +119,11 @@ class Bml:
                     f"no btl reaches rank {src_rank}->{dst_rank} "
                     f"({src.device} -> {dst.device})"
                 )
+            # faultline interposes at BML selection (sanitizer
+            # pattern): sm transfers consult the armed plan.
+            if btl.NAME == "sm":
+                from ..ft import inject
+
+                btl = inject.maybe_wrap_sm(btl)
             self._cache[key] = btl
         return btl

@@ -93,7 +93,11 @@ def select_for_comm(comm) -> dict[str, tuple[Any, Callable]]:
     if comm.size > 0 and len(table) < len(OPERATIONS):
         missing = [o for o in OPERATIONS if o not in table]
         logger.info("comm %s missing coll ops: %s", comm.name, missing)
-    return table
+    # faultline interposes at selection (sanitizer pattern): when a
+    # fault plan is armed, every vtable entry consults it on dispatch.
+    from ..ft import inject
+
+    return inject.maybe_wrap_coll(table)
 
 
 # ---------------------------------------------------------------------------

@@ -598,6 +598,14 @@ def allreduce_by_decision(x: jax.Array, axis_name: str, op,
     nbytes = x.size * x.dtype.itemsize
     algo = decide_allreduce(op, nbytes, nranks, dtype=x.dtype,
                             allow_quant=allow_quant)
+    # Circuit breaker: route around tiers that tripped on a previous
+    # kernel/transport fault. The decision runs at trace time, so this
+    # is the only breaker hook the traced path gets (no runtime catch
+    # is possible inside shard_map) — dispatch-time retry lives in
+    # TunedColl.allreduce.
+    from . import breaker
+
+    algo = breaker.route("allreduce", algo)
     if is_pallas_algo(algo):
         _pallas_algos()
     if is_quant_algo(algo):
@@ -630,16 +638,25 @@ class TunedColl(XlaColl):
     PRIORITY = 80
     DESCRIPTION = "algorithm decision layer (reference: coll/tuned)"
 
-    def _allreduce_plan(self, comm, x, op):
+    def _allreduce_plan(self, comm, x, op, deny: tuple = ()):
         """Decision + compiled plan for allreduce; x is leaf-checked
         and comm.size > 1. The whole per-call decision pipeline lives
         here so persistent_program can resolve it once."""
+        return self._allreduce_choice(comm, x, op, deny)[1]
+
+    def _allreduce_choice(self, comm, x, op, deny: tuple = ()):
+        """(algo, plan) so the dispatch-time breaker retry knows which
+        tier it just ran. ``deny`` excludes tiers that already failed
+        in this call."""
         is_plain_array = hasattr(x, "dtype") and hasattr(x, "shape")
         nbytes = _nbytes(x)
         algo = decide_allreduce(
             op, nbytes, comm.size,
             dtype=x.dtype if is_plain_array else None,
         )
+        from . import breaker
+
+        algo = breaker.route("allreduce", algo, deny=deny)
         if is_pallas_algo(algo):
             _pallas_algos()
         if is_quant_algo(algo):
@@ -676,15 +693,48 @@ class TunedColl(XlaColl):
         from ..core.counters import SPC
 
         SPC.record(f"coll_allreduce_algo_{algo}")
-        return compile_plan(comm, key, per_rank,
-                            check_vma=not is_pallas_algo(algo))
+        return algo, compile_plan(comm, key, per_rank,
+                                  check_vma=not is_pallas_algo(algo))
 
     def allreduce(self, comm, x, op):
         op = op_lookup(op)
         x = _leaf_check(comm, x)
         if comm.size == 1:
             return x
-        return self._allreduce_plan(comm, x, op)(x)
+        from ..ft import inject
+        from . import breaker
+
+        deny: tuple = ()
+        while True:
+            algo, plan = self._allreduce_choice(comm, x, op, deny)
+            try:
+                if inject.armed():
+                    inject.kernel_fault("allreduce", algo)
+                out = plan(x)
+            except ArgumentError:
+                raise  # caller error, not a tier fault
+            except Exception as exc:  # commlint: allow(broadexcept)
+                # Tier fault (kernel compile/launch failure, injected
+                # FaultInjected, transport death inside the plan):
+                # trip the breaker and degrade to the next-cheaper
+                # tier instead of failing the collective.
+                if not breaker.enabled() \
+                        or breaker.next_tier(algo) is None:
+                    raise
+                breaker.record_failure("allreduce", algo)
+                from ..core.counters import SPC
+
+                SPC.record("coll_tier_fallbacks")
+                logger.warning(
+                    "allreduce tier %r failed (%s: %s); degrading to "
+                    "%r", algo, type(exc).__name__, exc,
+                    breaker.next_tier(algo),
+                )
+                deny = deny + (algo,)
+                continue
+            if breaker.enabled():
+                breaker.record_success("allreduce", algo)
+            return out
 
     def alltoall(self, comm, x):
         x = rank_major_check(comm, x, min_ndim=2)

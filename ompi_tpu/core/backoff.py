@@ -1,0 +1,143 @@
+"""Deadline-bounded exponential backoff with deterministic jitter.
+
+Every poll loop in the control plane (modex rendezvous, dpm name
+lookup, crcp quiesce, DCN connect) used to spin on a fixed interval —
+cheap when the event is imminent, wasteful when it is not, and
+thundering when many controllers retry in lockstep (reference: the
+PMIx progress thread and btl/tcp's connect FSM both back off instead).
+``Backoff`` packages the standard exponential schedule:
+
+    delay_n = min(maximum, initial * factor**n) * (1 - jitter * u_n)
+
+with ``u_n`` drawn from a *seeded* ``random.Random`` so a given seed
+reproduces the exact delay sequence — the property the faultline drill
+suite (`ft/inject.py`) relies on for byte-identical schedules. The
+deadline is honored by construction: ``sleep()`` never sleeps past it
+and returns False once it has passed, so callers keep their existing
+timeout semantics (raise-after-deadline stays in the caller).
+
+Typical poll-loop shape::
+
+    bo = Backoff(timeout=timeout_s, initial=0.001, maximum=0.05)
+    while True:
+        if ready():
+            return value
+        if not bo.sleep():            # deadline passed, no sleep done
+            raise TimeoutError(...)
+
+and one-shot retry of a flaky callable::
+
+    ep = retry(lambda: connect(ip, port), on=(OSError,), timeout=5.0)
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+__all__ = ["Backoff", "retry"]
+
+
+class Backoff:
+    """Exponential backoff schedule bounded by a monotonic deadline.
+
+    Parameters
+    ----------
+    initial:  first delay in seconds (before jitter).
+    maximum:  cap on the un-jittered delay.
+    factor:   geometric growth per attempt.
+    jitter:   fraction of the delay randomized away (0 = none, 0.5 =
+              delays land in [0.5*d, d]); drawn from a seeded RNG so
+              the schedule is reproducible.
+    timeout:  seconds from *now* to the deadline (None = unbounded).
+    deadline: absolute time.monotonic() deadline; overrides timeout.
+    seed:     jitter RNG seed — fixed default keeps runs deterministic.
+    sleep_fn: injectable sleeper (tests).
+    """
+
+    def __init__(self, *, initial: float = 0.001, maximum: float = 0.25,
+                 factor: float = 2.0, jitter: float = 0.5,
+                 timeout: Optional[float] = None,
+                 deadline: Optional[float] = None,
+                 seed: int = 0,
+                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+        if initial <= 0:
+            raise ValueError(f"initial must be > 0, got {initial}")
+        if factor < 1.0:
+            raise ValueError(f"factor must be >= 1, got {factor}")
+        if not 0.0 <= jitter < 1.0:
+            raise ValueError(f"jitter must be in [0, 1), got {jitter}")
+        self._initial = initial
+        self._maximum = max(initial, maximum)
+        self._factor = factor
+        self._jitter = jitter
+        self._rng = random.Random(seed)
+        self._sleep = sleep_fn
+        self.attempts = 0
+        if deadline is not None:
+            self.deadline: Optional[float] = deadline
+        elif timeout is not None:
+            self.deadline = time.monotonic() + timeout
+        else:
+            self.deadline = None
+
+    # -- schedule ------------------------------------------------------
+
+    def remaining(self) -> float:
+        """Seconds until the deadline (inf when unbounded)."""
+        if self.deadline is None:
+            return float("inf")
+        return self.deadline - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0
+
+    def next_delay(self) -> float:
+        """The delay the next sleep() would use (advances the jitter
+        RNG but not the attempt counter when called directly — use
+        sleep() in loops)."""
+        # exponent capped (and overflow absorbed): past ~64 doublings
+        # the power exceeds float range long after min() has pinned
+        # the delay to maximum
+        try:
+            grown = self._initial * self._factor ** min(self.attempts, 64)
+        except OverflowError:
+            grown = self._maximum
+        base = min(self._maximum, grown)
+        if self._jitter:
+            base *= 1.0 - self._jitter * self._rng.random()
+        return max(0.0, min(base, self.remaining()))
+
+    def sleep(self) -> bool:
+        """Sleep for the next backoff interval, clipped to the
+        deadline. Returns False — without sleeping — once the deadline
+        has passed, so the caller's raise stays at the loop head."""
+        if self.expired:
+            return False
+        delay = self.next_delay()
+        self.attempts += 1
+        if delay > 0:
+            self._sleep(delay)
+        return True
+
+    def reset(self) -> None:
+        """Restart the schedule (the deadline is kept)."""
+        self.attempts = 0
+
+
+def retry(fn: Callable, *, on: Tuple[Type[BaseException], ...],
+          timeout: float, initial: float = 0.01, maximum: float = 0.25,
+          factor: float = 2.0, jitter: float = 0.5, seed: int = 0):
+    """Call ``fn`` until it succeeds, retrying exceptions in ``on``
+    with exponential backoff, for at most ``timeout`` seconds. The
+    last exception propagates when the deadline passes."""
+    bo = Backoff(initial=initial, maximum=maximum, factor=factor,
+                 jitter=jitter, timeout=timeout, seed=seed)
+    while True:
+        try:
+            return fn()
+        except on:
+            if not bo.sleep():
+                raise

@@ -87,7 +87,9 @@ class ProgressEngine:
                         return
                 except Exception:  # best-effort; never break a wait
                     continue
-            time.sleep(0)  # no hook blocked: yield the GIL / scheduler
+            # no hook blocked: yield the GIL/scheduler — intentional
+            # bare yield; the caller's wait loop owns the deadline
+            time.sleep(0)  # commlint: allow(polldeadline)
         finally:
             with self._lock:
                 self._parked -= 1

@@ -16,7 +16,6 @@ layer; only p2p has cross-call state to drain.
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass, field
 
 from ..core import progress as progress_mod
@@ -85,7 +84,13 @@ def quiesce(comm, timeout: float = 5.0,
     checkpoint. With require_empty=False, returns the residual bookmark
     for the caller to persist alongside the snapshot (message-logging
     restart can replay it, vprotocol analog)."""
-    deadline = time.monotonic() + timeout
+    from ..core.backoff import Backoff
+
+    # Drive progress every iteration; the sleep between polls backs
+    # off 1 ms -> 10 ms (a quiesce that isn't quiet in a few polls is
+    # waiting on a remote, not on this process's CPU). The caller's
+    # timeout still bounds the whole wait.
+    bo = Backoff(initial=0.001, maximum=0.01, timeout=timeout)
     waits = 0
     while True:
         bm = _inspect(comm)
@@ -93,7 +98,7 @@ def quiesce(comm, timeout: float = 5.0,
         if bm.quiet:
             SPC.record("ft_quiesce_ok")
             return bm
-        if time.monotonic() >= deadline:
+        if bo.expired:
             SPC.record("ft_quiesce_timeout")
             if require_empty:
                 raise QuiesceTimeout(
@@ -103,4 +108,4 @@ def quiesce(comm, timeout: float = 5.0,
             return bm
         progress_mod.progress()
         waits += 1
-        time.sleep(0.001)
+        bo.sleep()

@@ -61,7 +61,17 @@ def recoverable() -> None:
             "the running client keeps its fatal failure handler"
         )
         return
-    jax.config.update("jax_enable_recoverability", True)
+    try:
+        jax.config.update("jax_enable_recoverability", True)
+    except AttributeError:
+        # older jax: no recoverability knob. Degrade instead of dying
+        # on import — recovery still works as long as it completes
+        # inside the coordination-service heartbeat window.
+        logger.warning(
+            "jax %s lacks jax_enable_recoverability; survivors race "
+            "the coordination heartbeat fuse", jax.__version__
+        )
+        return
     SPC.record("ft_recoverable_arms")
 
 

@@ -159,17 +159,22 @@ class _WinsegPointer:
     def __init__(self, name: str) -> None:
         from ..btl.sm import WinSyncSeg
 
-        try:
-            self.seg = WinSyncSeg(name, 4, create=True)
-        except Exception:
-            self.seg = WinSyncSeg(name, 4, create=False)
+        # create-or-attach (mode 2): a plain create would unlink an
+        # existing segment and split two same-path handles onto
+        # different pointer words (winseg creation is fresh-per-window
+        # by design; the shared file pointer must be attach-stable).
+        existed = os.path.exists("/dev/shm/" + name)
+        self.seg = WinSyncSeg(name, 4, create=2)
+        self.seg.creator = not existed
 
     def _locked(self, fn):
         spins = 0
         while self.seg.cas(0, 0, 1) != 0:
             spins += 1
             if spins % 256 == 0:
-                time.sleep(0.0001)
+                # intra-host CAS spin-lock: the holder is a live local
+                # process, not a remote publication — no deadline
+                time.sleep(0.0001)  # commlint: allow(polldeadline)
         try:
             return fn()
         finally:

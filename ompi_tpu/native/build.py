@@ -66,6 +66,11 @@ def _build() -> Optional[Path]:
         cmd += [f"-fsanitize={_sanitize.value}", "-g",
                 "-fno-omit-frame-pointer"]
     cmd += [str(s) for s in sources]
+    # shm_open/shm_unlink live in librt on older glibc; link it
+    # explicitly so the .so loads regardless of what the host process
+    # already mapped (a bare interpreter has no librt until numpy/jax
+    # pull it in).
+    cmd += ["-lrt"]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, text=True, timeout=120
@@ -165,6 +170,8 @@ def _declare_dcn(lib: ctypes.CDLL) -> None:
     ]
     lib.dcn_link_frags.restype = LL
     lib.dcn_link_frags.argtypes = [P, ctypes.c_int, ctypes.c_int]
+    lib.dcn_kill_link.restype = ctypes.c_int
+    lib.dcn_kill_link.argtypes = [P, ctypes.c_int, ctypes.c_int]
     lib.dcn_enable_matching.restype = None
     lib.dcn_enable_matching.argtypes = [P, LL]
     lib.dcn_post_recv.restype = LL

@@ -209,6 +209,9 @@ struct Ctx {
   // stats
   std::atomic<int64_t> bytes_sent{0}, bytes_recv{0};
   std::atomic<int64_t> eager_sends{0}, rndv_sends{0}, frags_sent{0};
+  // Frames salvaged off a dead link and re-queued onto its peer's
+  // surviving links (the failover path in drop_link).
+  std::atomic<int64_t> restriped_frames{0};
   std::atomic<int64_t> offload_matches{0}, offload_unexpected{0};
 };
 
@@ -539,18 +542,36 @@ void handle_frame(Ctx* c, Link& l) {
 // mu held. Drop a link: close the fd and remove it from its peer's
 // live set so liveness queries see the loss (reference: btl_tcp's
 // endpoint FSM marks the endpoint failed when its connection dies).
+// Failover: frames still queued on the dead link are salvaged and
+// re-striped onto the peer's surviving links — partially-written
+// frames restart from byte 0 (the receiver discarded the partial
+// frame along with its side of the link), so an in-flight rendezvous
+// completes over the survivors instead of hanging. Stale striping
+// weights are cleared; uniform round-robin resumes until the caller
+// re-weights (dcn_set_link_weights).
 void drop_link(Ctx* c, int fd) {
   epoll_ctl(c->epfd, EPOLL_CTL_DEL, fd, nullptr);
   close(fd);
   auto it = c->links.find(fd);
   if (it != c->links.end()) {
     int peer = it->second.peer;
+    std::deque<OutFrame> salvage;
+    salvage.swap(it->second.outq);
     auto pit = c->peers.find(peer);
     if (pit != c->peers.end()) {
       auto& v = pit->second.link_fds;
       v.erase(std::remove(v.begin(), v.end(), fd), v.end());
+      pit->second.weights.clear();
+      pit->second.credit.clear();
     }
     c->links.erase(it);
+    if (pit != c->peers.end() && !pit->second.link_fds.empty()) {
+      for (auto& f : salvage) {
+        f.sent = 0;
+        c->restriped_frames++;
+        enqueue_frame(c, peer, std::move(f));
+      }
+    }
   }
 }
 
@@ -970,7 +991,11 @@ long long dcn_send(void* vc, int peer, long long tag, const void* buf,
                    long long len) {
   Ctx* c = static_cast<Ctx*>(vc);
   std::lock_guard<std::mutex> g(c->mu);
-  if (c->peers.find(peer) == c->peers.end()) return -1;
+  auto pit = c->peers.find(peer);
+  if (pit == c->peers.end()) return -1;
+  // every link died: fail fast (endpoint-failed) instead of
+  // registering a msgid that can never complete
+  if (pit->second.link_fds.empty()) return -2;
   int64_t id = c->next_msgid++;
   OutMsg m;
   m.peer = peer;
@@ -1014,7 +1039,9 @@ long long dcn_send_ref(void* vc, int peer, long long tag,
                        const void* buf, long long len) {
   Ctx* c = static_cast<Ctx*>(vc);
   std::lock_guard<std::mutex> g(c->mu);
-  if (c->peers.find(peer) == c->peers.end()) return -1;
+  auto pit = c->peers.find(peer);
+  if (pit == c->peers.end()) return -1;
+  if (pit->second.link_fds.empty()) return -2;  // endpoint-failed
   int64_t id = c->next_msgid++;
   OutMsg m;
   m.peer = peer;
@@ -1307,6 +1334,22 @@ long long dcn_link_frags(void* vc, int peer, int idx) {
   return v[idx];
 }
 
+// Deterministic fault injection (ft/inject.py): kill link `idx` of
+// `peer` exactly as a network failure would — the socket closes, the
+// remote side observes EOF and drops its mirror link, and queued
+// frames re-stripe onto the survivors via drop_link's salvage path.
+// Returns the surviving link count, or -1 for an unknown peer.
+int dcn_kill_link(void* vc, int peer, int idx) {
+  Ctx* c = static_cast<Ctx*>(vc);
+  std::lock_guard<std::mutex> g(c->mu);
+  auto it = c->peers.find(peer);
+  if (it == c->peers.end()) return -1;
+  auto& v = it->second.link_fds;
+  if (idx < 0 || (size_t)idx >= v.size()) return (int)v.size();
+  drop_link(c, v[idx]);
+  return (int)it->second.link_fds.size();
+}
+
 long long dcn_stat(void* vc, int what) {
   Ctx* c = static_cast<Ctx*>(vc);
   switch (what) {
@@ -1324,6 +1367,8 @@ long long dcn_stat(void* vc, int what) {
       std::lock_guard<std::mutex> g(c->mu);
       return (long long)c->links.size();
     }
+    case 6:
+      return c->restriped_frames.load();
     default:
       return -1;
   }

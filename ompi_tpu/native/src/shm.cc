@@ -835,7 +835,21 @@ int cma_write(long long pid, unsigned long long addr, const void* src,
 int32_t* winseg_open(const char* name, long long n_words, int create) {
   size_t bytes = sizeof(std::atomic<int32_t>) * (size_t)n_words;
   int fd = -1;
-  if (create) {
+  if (create == 2) {
+    // create-or-attach (kernel-atomic): never clobbers an existing
+    // segment — shared-file-pointer words are keyed by file path and
+    // must survive a second same-host opener (sharedfp/sm), unlike
+    // window sync segments which want fresh state per creation.
+    fd = shm_open(name, O_CREAT | O_RDWR, 0600);
+    if (fd < 0) return nullptr;
+    struct stat st;
+    if (fstat(fd, &st) != 0 ||
+        ((size_t)st.st_size < bytes &&
+         ftruncate(fd, (off_t)bytes) != 0)) {
+      close(fd);
+      return nullptr;
+    }
+  } else if (create) {
     shm_unlink(name);
     fd = shm_open(name, O_CREAT | O_EXCL | O_RDWR, 0600);
     if (fd < 0) return nullptr;

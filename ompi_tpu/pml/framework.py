@@ -38,6 +38,12 @@ def select_for_comm(comm) -> PmlComponent:
         from ..ft import vprotocol
 
         _selected = vprotocol.maybe_wrap(selected, PML)
+        # faultline sits between vprotocol and the sanitizer: faults
+        # hit the transport stack (below), while the sanitizer (above)
+        # still accounts the traffic as the application issued it.
+        from ..ft import inject
+
+        _selected = inject.maybe_wrap_pml(_selected)
         # Sanitizer interposition sits outermost so it observes the
         # traffic exactly as the application issued it.
         from ..analysis import sanitizer

@@ -58,8 +58,12 @@ def publish_name(service: str, port: str | dict) -> None:
 
 
 def lookup_name(service: str, timeout: float = 0.0):
-    """MPI_Lookup_name; with timeout > 0 polls until published."""
-    deadline = time.monotonic() + timeout
+    """MPI_Lookup_name; with timeout > 0 polls until published.
+    Polling backs off exponentially (1 ms → 50 ms) while honoring the
+    caller's deadline; timeout=0 keeps probe-once semantics."""
+    from ..core.backoff import Backoff
+
+    bo = Backoff(initial=0.001, maximum=0.05, timeout=timeout)
     while True:
         with _ns_lock:
             rec = _published.get(service)
@@ -71,9 +75,8 @@ def lookup_name(service: str, timeout: float = 0.0):
                     rec = f.read()
         if rec is not None:
             return dss.unpack_one(rec)
-        if time.monotonic() >= deadline:
+        if not bo.sleep():
             raise NameServiceError(f"service {service!r} not published")
-        time.sleep(0.01)
 
 
 def unpublish_name(service: str) -> None:

@@ -53,6 +53,10 @@ def _kv_client():
 
 def put(key: str, value: Any) -> None:
     """Publish this process's entry (PMIx_Put + Commit)."""
+    from ..ft import inject
+
+    if inject.armed():
+        inject.on_modex("put", key)
     rec = dss.pack(value)
     with _lock:
         _local[key] = rec
@@ -69,8 +73,11 @@ def get(key: str, timeout_s: float = 60.0) -> Any:
     multi-threaded loopback tests get the same rendezvous behavior as
     the coordinator KV store. Pass timeout_s=0 for an immediate probe.
     """
-    import time
+    from ..core.backoff import Backoff
+    from ..ft import inject
 
+    if inject.armed():
+        inject.on_modex("get", key)
     client = _kv_client()
     if client is not None:
         try:
@@ -82,15 +89,19 @@ def get(key: str, timeout_s: float = 60.0) -> Any:
         # becomes a ModexError with the key attached
         except Exception as exc:  # commlint: allow(broadexcept)
             raise ModexError(f"modex get({key!r}) failed: {exc}") from exc
-    deadline = time.monotonic() + timeout_s
+    # In-process table: poll with exponential backoff instead of a
+    # fixed 5 ms spin — early publications resolve in ~1 ms, late ones
+    # cost at most one 50 ms nap, and the caller's deadline still
+    # bounds the whole wait (timeout_s=0 keeps immediate-probe
+    # semantics: sleep() refuses once expired).
+    bo = Backoff(initial=0.001, maximum=0.05, timeout=timeout_s)
     while True:
         with _lock:
             rec = _local.get(key)
         if rec is not None:
             return dss.unpack_one(rec)
-        if time.monotonic() >= deadline:
+        if not bo.sleep():
             raise ModexError(f"modex key {key!r} not published")
-        time.sleep(0.005)
 
 
 def publish_dcn_address(endpoint, process_index: int) -> None:

@@ -1,0 +1,533 @@
+"""faultline (PR5): deterministic fault injection + self-healing.
+
+Tier-1 coverage: the backoff helper, the fault-plan grammar and its
+determinism contract, the per-tier circuit breaker, modex/dpm deadline
+semantics after the backoff migration, DCN connect retry, the
+fault-wrapped DCN endpoint on a loopback pair, and the in-process
+rank-kill → shrink/agree/respawn recovery path. The 2-controller
+drills live in test_drill.py (slow-marked).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.coll import breaker
+from ompi_tpu.core import config
+from ompi_tpu.core.backoff import Backoff, retry
+from ompi_tpu.core.counters import SPC
+from ompi_tpu.ft import elastic, events, inject
+from ompi_tpu.native import build
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    inject.disarm()
+    breaker.reset()
+    elastic.reset()
+    events.clear()
+
+
+# -- backoff helper --------------------------------------------------------
+
+def test_backoff_deterministic_jitter():
+    naps_a, naps_b = [], []
+    a = Backoff(seed=5, sleep_fn=naps_a.append)
+    b = Backoff(seed=5, sleep_fn=naps_b.append)
+    for _ in range(6):
+        assert a.sleep() and b.sleep()
+    assert naps_a == naps_b  # same seed => byte-identical schedule
+    c_naps = []
+    c = Backoff(seed=6, sleep_fn=c_naps.append)
+    for _ in range(6):
+        c.sleep()
+    assert c_naps != naps_a
+
+
+def test_backoff_grows_and_caps():
+    naps = []
+    bo = Backoff(initial=0.01, maximum=0.04, factor=2.0, jitter=0.0,
+                 sleep_fn=naps.append)
+    for _ in range(5):
+        bo.sleep()
+    assert naps == pytest.approx([0.01, 0.02, 0.04, 0.04, 0.04])
+
+
+def test_backoff_deadline_refuses_without_sleeping():
+    naps = []
+    bo = Backoff(timeout=0.0, sleep_fn=naps.append)
+    assert bo.expired
+    assert bo.sleep() is False
+    assert naps == []  # no sleep once the deadline has passed
+
+
+def test_backoff_never_sleeps_past_deadline():
+    naps = []
+    bo = Backoff(initial=10.0, jitter=0.0, timeout=0.05,
+                 sleep_fn=naps.append)
+    assert bo.sleep() is True
+    assert naps and naps[0] <= 0.05 + 1e-6
+
+
+def test_backoff_validation():
+    with pytest.raises(ValueError):
+        Backoff(initial=0.0)
+    with pytest.raises(ValueError):
+        Backoff(factor=0.5)
+    with pytest.raises(ValueError):
+        Backoff(jitter=1.0)
+
+
+def test_retry_recovers_then_gives_up():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("refused")
+        return "up"
+
+    assert retry(flaky, on=(OSError,), timeout=5.0,
+                 initial=0.001) == "up"
+    assert calls["n"] == 3
+
+    def always_down():
+        raise OSError("refused")
+
+    with pytest.raises(OSError):
+        retry(always_down, on=(OSError,), timeout=0.02, initial=0.001)
+
+
+# -- fault-plan grammar ----------------------------------------------------
+
+def test_parse_full_spec():
+    s = inject._parse_spec("drop@btl_dcn:peer=1,tag=100-200,count=2")
+    assert (s.action, s.layer, s.peer) == ("drop", "btl_dcn", 1)
+    assert (s.tag_lo, s.tag_hi, s.count) == (100, 200, 2)
+    assert s.describe() == "drop@btl_dcn:peer=1,tag=100-200"
+
+
+def test_parse_aliases_and_inf():
+    s = inject._parse_spec("delay@pml:op=send,ms=5,after=3,count=inf")
+    assert s.op == "send" and s.ms == 5.0 and s.skip == 3
+    assert s.count == float("inf")
+    assert inject._parse_spec("rank_kill@coll:exit=17").exit_code == 17
+
+
+@pytest.mark.parametrize("bad", [
+    "nonsense@pml",               # unknown action
+    "drop@nowhere",               # unknown layer
+    "rank_kill@btl_sm",           # action invalid at layer
+    "drop@modex:key",             # malformed k=v
+    "drop@pml:tag=9-3",           # empty tag range
+    "drop@pml:prob=1.5",          # prob out of [0,1]
+    "drop",                       # no @layer
+])
+def test_parse_rejects(bad):
+    with pytest.raises(inject.PlanError):
+        inject._parse_spec(bad)
+
+
+# -- plan semantics --------------------------------------------------------
+
+def test_count_and_after_windows():
+    plan = inject.FaultPlan("drop@btl_dcn:op=send,after=2,count=2")
+    fired = [
+        bool(plan.decide("btl_dcn", "send", peer=0, tag=1))
+        for _ in range(6)
+    ]
+    # occurrences 1-2 pass (after=2), 3-4 fire (count=2), rest pass
+    assert fired == [False, False, True, True, False, False]
+
+
+def test_scope_filters_peer_and_tag():
+    plan = inject.FaultPlan("drop@btl_dcn:peer=1,tag=10-20,count=inf")
+    assert not plan.decide("btl_dcn", "send", peer=2, tag=15)
+    assert not plan.decide("btl_dcn", "send", peer=1, tag=9)
+    assert plan.decide("btl_dcn", "send", peer=1, tag=10)
+    assert not plan.decide("btl_sm", "send", peer=1, tag=10)
+
+
+def test_coll_peer_is_victim_not_filter():
+    # at the coll layer peer= names the rank_kill victim; the dispatch
+    # probe (which has no peer) must still match the spec
+    plan = inject.FaultPlan("rank_kill@coll:op=allreduce,peer=3")
+    hits = plan.decide("coll", "allreduce")
+    assert hits and hits[0].peer == 3
+
+
+def test_schedule_deterministic_across_runs():
+    def drive(plan):
+        for i in range(20):
+            plan.decide("btl_dcn", "send", peer=i % 2, tag=i)
+        return plan.digest()
+
+    spec = "drop@btl_dcn:prob=0.5,count=inf;delay@btl_dcn:prob=0.3,count=inf"
+    d1 = drive(inject.FaultPlan(spec, seed=42))
+    d2 = drive(inject.FaultPlan(spec, seed=42))
+    assert d1 == d2  # same seed => byte-identical fault schedule
+    d3 = drive(inject.FaultPlan(spec, seed=43))
+    assert d3 != d1
+
+
+def test_arm_from_cvars_and_disarm():
+    config.set("faultline_base_plan", "delay@pml:op=send,ms=1")
+    config.set("faultline_base_seed", 9)
+    try:
+        plan = inject.arm()
+        assert inject.armed()
+        assert plan.seed == 9 and len(plan.specs) == 1
+        assert inject.disarm() is plan
+        assert not inject.armed()
+    finally:
+        config.set("faultline_base_plan", "")
+        config.set("faultline_base_seed", 0)
+
+
+# -- modex / dpm deadline semantics (satellite: backoff migration) ---------
+
+def test_modex_probe_and_deadline():
+    from ompi_tpu.runtime import modex
+
+    with pytest.raises(modex.ModexError):
+        modex.get("faultline/missing", timeout_s=0)
+    t0 = time.monotonic()
+    with pytest.raises(modex.ModexError):
+        modex.get("faultline/missing", timeout_s=0.05)
+    assert time.monotonic() - t0 < 1.0
+    modex.put("faultline/present", {"x": 1})
+    assert modex.get("faultline/present", timeout_s=1.0) == {"x": 1}
+
+
+def test_modex_late_publication_resolves():
+    from ompi_tpu.runtime import modex
+
+    def late():
+        time.sleep(0.05)
+        modex.put("faultline/late", 7)
+
+    t = threading.Thread(target=late)
+    t.start()
+    try:
+        assert modex.get("faultline/late", timeout_s=5.0) == 7
+    finally:
+        t.join()
+
+
+def test_modex_injected_drop():
+    from ompi_tpu.runtime import modex
+
+    modex.put("faultline/dropped", 1)
+    inject.arm("drop@modex:op=get,key=faultline/dropped,count=1")
+    with pytest.raises(modex.ModexError, match="injected"):
+        modex.get("faultline/dropped", timeout_s=0.1)
+    # count exhausted: the retry sees the value
+    assert modex.get("faultline/dropped", timeout_s=0.1) == 1
+
+
+def test_dpm_lookup_probe_and_backoff():
+    from ompi_tpu.runtime import dpm
+
+    with pytest.raises(dpm.NameServiceError):
+        dpm.lookup_name("faultline-missing")
+
+    def late():
+        time.sleep(0.05)
+        dpm.publish_name("faultline-late", {"port": 1})
+
+    t = threading.Thread(target=late)
+    t.start()
+    try:
+        got = dpm.lookup_name("faultline-late", timeout=5.0)
+        assert got == {"port": 1}
+    finally:
+        t.join()
+        dpm.unpublish_name("faultline-late")
+
+
+# -- circuit breaker -------------------------------------------------------
+
+def test_breaker_trips_routes_and_reprobes():
+    config.set("coll_breaker_cooldown_ms", 30)
+    try:
+        assert breaker.route("allreduce", "quant_ring") == "quant_ring"
+        breaker.record_failure("allreduce", "quant_ring")
+        assert breaker.state("allreduce", "quant_ring") == breaker.OPEN
+        assert breaker.route("allreduce", "quant_ring") == "ring"
+        time.sleep(0.05)  # cooldown elapses -> half-open
+        # exactly one caller gets the probe...
+        assert not breaker.is_open("allreduce", "quant_ring")
+        # ...concurrent callers keep routing around until it reports
+        assert breaker.is_open("allreduce", "quant_ring")
+        breaker.record_success("allreduce", "quant_ring")
+        assert breaker.state("allreduce", "quant_ring") == breaker.CLOSED
+        assert breaker.route("allreduce", "quant_ring") == "quant_ring"
+    finally:
+        config.set("coll_breaker_cooldown_ms", 30000)
+
+
+def test_breaker_halfopen_failure_reopens():
+    config.set("coll_breaker_cooldown_ms", 30)
+    try:
+        breaker.record_failure("allreduce", "ring")
+        time.sleep(0.05)
+        assert not breaker.is_open("allreduce", "ring")  # probe admitted
+        breaker.record_failure("allreduce", "ring")      # probe fails
+        assert breaker.state("allreduce", "ring") == breaker.OPEN
+        assert breaker.route("allreduce", "ring") == "gather_reduce"
+    finally:
+        config.set("coll_breaker_cooldown_ms", 30000)
+
+
+def test_breaker_chain_terminates():
+    assert breaker.next_tier("quant_pallas") == "quant_ring"
+    assert breaker.next_tier("quant_ring") == "ring"
+    assert breaker.next_tier("ring") == "gather_reduce"
+    assert breaker.next_tier("gather_reduce") is None
+    # every open tier: route lands on the terminal, not a cycle
+    for algo in list(breaker.NEXT_TIER) + [breaker.TERMINAL]:
+        breaker.record_failure("allreduce", algo)
+    assert breaker.route("allreduce", "quant_pallas") == "gather_reduce"
+
+
+def test_breaker_disabled_is_passthrough():
+    config.set("coll_breaker_enable", False)
+    try:
+        breaker.record_failure("allreduce", "ring")
+        assert breaker.route("allreduce", "ring") == "ring"
+        assert not breaker.is_open("allreduce", "ring")
+    finally:
+        config.set("coll_breaker_enable", True)
+
+
+# -- breaker integration: quant tier fault degrades bit-identically --------
+
+@pytest.fixture
+def quant_enabled():
+    config.set("coll_quant_enable", True)
+    config.set("coll_quant_min_bytes", 1 << 10)
+    try:
+        yield
+    finally:
+        config.set("coll_quant_enable", False)
+        config.set("coll_quant_min_bytes", 64 << 10)
+
+
+def test_quant_tier_fault_falls_back_bit_identical(quant_enabled):
+    """An injected quant_ring kernel fault must degrade to the plain
+    chain and return exactly what the safe tier returns (the rank-
+    divergence argument for quant->plain fallback: DESIGN.md §14)."""
+    data = np.random.default_rng(3).standard_normal(
+        (mt.world().size, 4096)).astype(np.float32)
+
+    # reference: the same reduction forced onto the safe tier
+    config.set("coll_tuned_allreduce_algorithm", "ring")
+    try:
+        ref_comm = mt.world().dup()
+        ref = np.asarray(ref_comm.allreduce(
+            ref_comm.put_rank_major(data.copy())))
+    finally:
+        config.set("coll_tuned_allreduce_algorithm", "")
+
+    inject.arm("disconnect@coll:op=allreduce,algo=quant_ring,count=1")
+    comm = mt.world().dup()
+    before = SPC.snapshot().get("coll_tier_fallbacks", 0)
+    out = np.asarray(comm.allreduce(comm.put_rank_major(data.copy())))
+    after = SPC.snapshot().get("coll_tier_fallbacks", 0)
+
+    np.testing.assert_array_equal(out, ref)  # bit-identical
+    assert after > before, "fallback must record coll_tier_fallbacks"
+    assert breaker.state("allreduce", "quant_ring") == breaker.OPEN
+    # fired log shows exactly the one injected tier fault
+    assert "disconnect@coll" in inject.plan().schedule()
+
+
+def test_rank_kill_shrink_respawn_restores_checkpoint(
+        tmp_path, quant_enabled):
+    """Satellite drill: rank-kill mid-allreduce, then shrink + respawn;
+    the restored state must equal the pre-fault checkpoint (resharded
+    over the survivors)."""
+    from ompi_tpu.ft.manager import CheckpointManager
+
+    elastic.enable()
+    comm0 = mt.world()
+    m = CheckpointManager(str(tmp_path / "drill"))
+    state = {
+        "w": np.stack([
+            np.full(4, r, np.float32) for r in range(comm0.size)
+        ]),
+        "step_count": np.int32(5),
+    }
+    m.save(1, state, comm=comm0)
+
+    inject.arm("rank_kill@coll:op=allreduce,peer=2,count=1")
+    comm = mt.world().dup()  # vtable wrapped at selection
+    with pytest.raises(inject.FaultInjected):
+        comm.allreduce(comm.put_rank_major(
+            np.ones((comm.size, 4), np.float32)))
+    assert 2 in elastic.failed_ranks()
+
+    # agree: the dead rank's veto vanishes
+    flags = [True] * comm.size
+    flags[2] = False
+    assert elastic.agree(comm, flags) is True
+
+    new_comm, restored, meta = elastic.respawn(comm, m, like=state)
+    assert meta["step"] == 1
+    assert new_comm.size == comm.size - 1
+    w = np.asarray(restored["w"])
+    survivors = [r for r in range(comm.size) if r != 2]
+    np.testing.assert_array_equal(
+        w, np.stack([np.full(4, r, np.float32) for r in survivors])
+    )
+    # the shrunken comm still reduces (count exhausted: no re-fire)
+    out = np.asarray(new_comm.allreduce(
+        new_comm.put_rank_major(
+            np.ones((new_comm.size, 2), np.float32))))
+    np.testing.assert_array_equal(out[0], [new_comm.size] * 2)
+
+
+# -- DCN endpoint faults + failover (native-gated) -------------------------
+
+needs_native = pytest.mark.skipif(
+    not build.available(), reason="native library unavailable"
+)
+
+
+@pytest.fixture
+def pair():
+    from ompi_tpu.btl import dcn as dcn_mod
+
+    a = dcn_mod.DcnEndpoint()
+    b = dcn_mod.DcnEndpoint()
+    peer = a.connect(b.address[0], b.address[1], cookie=1)
+    yield a, b, peer
+    a.close()
+    b.close()
+
+
+@needs_native
+def test_dcn_drop_and_duplicate_and_corrupt(pair):
+    a, b, peer = pair
+    inject.arm(
+        "drop@btl_dcn:op=send,tag=1,count=1;"
+        "duplicate@btl_dcn:op=send,tag=2,count=1;"
+        "corrupt@btl_dcn:op=send,tag=3,count=1"
+    )
+    fa = inject.maybe_wrap_dcn(a)
+    msgid = fa.send_bytes(peer, 1, b"lost")     # dropped on the wire
+    assert msgid >= (1 << 62)                    # fake completion id
+    fa.send_bytes(peer, 2, b"twice")             # duplicated
+    fa.send_bytes(peer, 3, b"\x00clean")         # first byte flipped
+    got = [b.recv_bytes(timeout=10) for _ in range(3)]
+    tags = sorted(t for _, t, _ in got)
+    assert tags == [2, 2, 3]                     # tag-1 never arrives
+    assert all(d == b"twice" for _, t, d in got if t == 2)
+    (corrupted,) = [d for _, t, d in got if t == 3]
+    assert corrupted == b"\xffclean"
+    # the dropped send still completes locally (fake msgid drains)
+    done = set()
+    for _ in range(50):
+        mid = fa.poll_send_complete()
+        if mid is None:
+            break
+        done.add(mid)
+    assert msgid in done
+
+
+@needs_native
+def test_dcn_kill_link_restripes_and_survives(pair):
+    a, b, peer = pair
+    links = a.peer_links(peer)
+    if links < 2:
+        pytest.skip("endpoint opened a single link")
+    # quiesce so no frags sit in the dying socket's kernel buffer
+    a.send_bytes(peer, 0, b"warmup")
+    b.recv_bytes(timeout=10)
+    before = SPC.snapshot().get("dcn_restripes", 0)
+    assert a.kill_link(peer, 0) == links - 1
+    assert a.heal_links(peer) == links - 1       # detects + re-stripes
+    assert SPC.snapshot().get("dcn_restripes", 0) > before
+    big = np.random.RandomState(1).bytes(2 * 1024 * 1024)
+    a.send_bytes(peer, 9, big)                   # rides the survivors
+    _, tag, got = b.recv_bytes(timeout=30)
+    assert tag == 9 and got == big
+    assert a.stats()["restriped_frames"] >= 0
+    # degraded is not dead: no DEVICE_ERROR escalation
+    a.check_peer(peer)
+
+
+@needs_native
+def test_dcn_injected_disconnect_then_endpoint_death(pair):
+    a, b, peer = pair
+    a.send_bytes(peer, 0, b"warmup")
+    b.recv_bytes(timeout=10)
+    links = a.peer_links(peer)
+    inject.arm(
+        "disconnect@btl_dcn:op=send,count=%d" % links
+    )
+    fa = inject.maybe_wrap_dcn(a)
+    seen = []
+    events.register(events.EventClass.DEVICE_ERROR,
+                    lambda ev: seen.append(ev))
+    # each faulted send kills one link; when the last dies the send
+    # path escalates DEVICE_ERROR -> DcnError
+    from ompi_tpu.btl.dcn import DcnError
+
+    config.set("btl_dcn_send_retry_ms", 50)
+    try:
+        with pytest.raises(DcnError):
+            for _ in range(links + 1):
+                fa.send_bytes(peer, 1, b"x")
+    finally:
+        config.set("btl_dcn_send_retry_ms", 200)
+    assert seen and seen[0].info.get("transport") == "dcn"
+    assert a.peer_links(peer) == 0
+
+
+@needs_native
+def test_dcn_connect_retries_cold_start():
+    """Cold-start race: the listener appears after the first refused
+    connection; connect must retry with backoff instead of failing."""
+    import socket
+
+    from ompi_tpu.btl import dcn as dcn_mod
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    box = {}
+
+    def late_listener():
+        time.sleep(0.3)
+        box["ep"] = dcn_mod.DcnEndpoint("127.0.0.1", port)
+
+    t = threading.Thread(target=late_listener)
+    t.start()
+    a = dcn_mod.DcnEndpoint()
+    try:
+        before = SPC.snapshot().get("dcn_connect_retries", 0)
+        peer = a.connect("127.0.0.1", port, cookie=1,
+                         timeout_ms=10000)
+        assert SPC.snapshot().get("dcn_connect_retries", 0) > before
+        a.send_bytes(peer, 5, b"late but here")
+        _, tag, got = box["ep"].recv_bytes(timeout=10)
+        assert tag == 5 and got == b"late but here"
+    finally:
+        t.join()
+        a.close()
+        if "ep" in box:
+            box["ep"].close()
