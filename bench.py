@@ -19,7 +19,11 @@ loops (ompi/mca/op/avx) — measured THROUGH the framework:
   kernel (correctness asserted against the XLA implementation);
 - `detail.fabric_loopback` / `detail.fabric_2proc_mpi` measure the
   DCN wire (raw engine loopback; MPI-level p2p across two controller
-  processes).
+  processes);
+- `detail.smallmsg_latency` is the fastpath report card: p50/p99 RTT
+  at 64 B / 1 KiB / 64 KiB over the shm descriptor lane and the
+  MPI-level fabric path, plus collective/persistent dispatch p50s,
+  each with its speedup over the round-5 (pre-fastpath) value.
 
 Measurement technique: the runner reaches the TPU through an RPC tunnel
 with ~70 ms constant round-trip latency, so a single kernel launch is
@@ -430,23 +434,79 @@ def _fabric_loopback() -> dict:
 
 
 _SHM_PERF_WORKER = r"""
-import sys, time
+import ctypes, json, sys, time
 import numpy as np
 from ompi_tpu.btl.sm import ShmEndpoint
 rank = int(sys.argv[1]); prefix = sys.argv[3]  # argv[2] = unused coord
 ep = ShmEndpoint(prefix, rank)
-ep.connect(1 - rank, timeout_s=30)
-N = 1000
+peer = 1 - rank
+ep.connect(peer, timeout_s=30)
+fp_ok = ep.fp_available(peer)
+lib = ep._lib; fp = ep._fp
+
+def pctl(ts):
+    ts = sorted(ts)
+    return (round(ts[len(ts) // 2] * 1e6, 2),
+            round(ts[int(len(ts) * 0.99)] * 1e6, 2))
+
+# (payload bytes, warmup, timed iters): 64 B rides the inline
+# descriptor, 1 KiB and 64 KiB ride slab frames (frame = 64 KiB).
+PHASES = ((64, "64B", 200, 2000), (1 << 10, "1KiB", 100, 1000),
+          (64 << 10, "64KiB", 50, 400))
+N_V2 = 500
 small = b"x" * 64
 if rank == 0:
+    out = {"fp": bool(fp_ok)}
+    if fp_ok:
+        # Headline: native-to-native round trips (fp_pingpong against a
+        # responder parked in fp_echo) — the wire RTT of the descriptor
+        # lane with both turnarounds in C. The _pyinit rows re-run the
+        # 64 B round with a Python initiator (hoisted fp_sendrecv FFI
+        # entry), and _api with the full ep.fp_sendrecv wrapper, so the
+        # interpreter's share of the round trip is visible.
+        for nbytes, label, warm, iters in PHASES:
+            ts = ep.fp_pingpong(peer, nbytes, warm + iters)
+            assert len(ts) == warm + iters, len(ts)
+            p50, p99 = pctl(list(ts[warm:]))
+            out["p50_%s_rtt_us" % label] = p50
+            out["p99_%s_rtt_us" % label] = p99
+        rbuf = np.empty(64 << 10, np.uint8)
+        rtag = ctypes.c_longlong(0)
+        rptr, rn = rbuf.ctypes.data, rbuf.nbytes
+        rref = ctypes.byref(rtag)
+        fps = lib.fp_sendrecv
+        sptr = ctypes.cast(ctypes.c_char_p(small), ctypes.c_void_p)
+        ts = []
+        for i in range(200 + 1000):  # Python initiator, 64 B
+            t0 = time.perf_counter()
+            rc = fps(fp, peer, 5, sptr, 64, peer, 2_000_000,
+                     rptr, rn, rref)
+            t1 = time.perf_counter()
+            assert rc == 64, rc
+            if i >= 200:
+                ts.append(t1 - t0)
+        out["p50_64B_rtt_us_pyinit"], out["p99_64B_rtt_us_pyinit"] = \
+            pctl(ts)
+        ts = []
+        for i in range(100 + 500):  # full framework wrapper, 64 B
+            t0 = time.perf_counter()
+            ep.fp_sendrecv(peer, 5, small, peer, 2.0)
+            if i >= 100:
+                ts.append(time.perf_counter() - t0)
+        out["p50_64B_rtt_us_api"], out["p99_64B_rtt_us_api"] = pctl(ts)
+    # v2 general-engine lane (the pre-fastpath path; r4/r5 measured
+    # exactly this loop — the honest before/after pair).
     for _ in range(50):
         ep.send_bytes(1, 1, small); ep.recv_bytes(10)
     ts = []
-    for _ in range(N):
+    for _ in range(N_V2):
         t1 = time.perf_counter()
         ep.send_bytes(1, 1, small); ep.recv_bytes(10)
         ts.append(time.perf_counter() - t1)
-    ts.sort()
+    out["p50_64B_rtt_us_v2"], out["p99_64B_rtt_us_v2"] = pctl(ts)
+    if not fp_ok:  # lane absent: headline falls back to the v2 path
+        out["p50_64B_rtt_us"] = out["p50_64B_rtt_us_v2"]
+        out["p99_64B_rtt_us"] = out["p99_64B_rtt_us_v2"]
     big = np.random.default_rng(0).integers(
         0, 255, 64 << 20, dtype=np.uint8).tobytes()
     # cold: recv_bytes allocates the landing pages per message
@@ -466,17 +526,19 @@ if rank == 0:
         ep.send_bytes(1, 3, big); ep.recv_bytes(30)
         bws2.append(time.perf_counter() - t1)
     bws2.sort()
-    import json
-    print("SHMPERF " + json.dumps({
-        "p50_64B_rtt_us": round(ts[len(ts) // 2] * 1e6, 1),
-        "p99_64B_rtt_us": round(ts[int(len(ts) * 0.99)] * 1e6, 1),
-        "gbps_64MiB": round(len(big) / bws[len(bws) // 2] / 1e9, 2),
-        "gbps_64MiB_into": round(
-            len(big) / bws2[len(bws2) // 2] / 1e9, 2),
-        "cma": ep.peer_cma(1),
-    }), flush=True)
+    out["gbps_64MiB"] = round(len(big) / bws[len(bws) // 2] / 1e9, 2)
+    out["gbps_64MiB_into"] = round(
+        len(big) / bws2[len(bws2) // 2] / 1e9, 2)
+    out["cma"] = ep.peer_cma(1)
+    out["fp_stats"] = ep.fp_stats()
+    print("SHMPERF " + json.dumps(out), flush=True)
 else:
-    for _ in range(50 + N):
+    if fp_ok:
+        echoes = sum(w + n for _, _, w, n in PHASES) \
+            + (200 + 1000) + (100 + 500)
+        done = ep.fp_echo(0, echoes, timeout=30.0)
+        assert done == echoes, done
+    for _ in range(50 + N_V2):
         ep.recv_bytes(30); ep.send_bytes(0, 1, small)
     for _ in range(6):
         ep.recv_bytes(60); ep.send_bytes(0, 2, b"a")
@@ -544,10 +606,30 @@ if pid == 0:
         world.rank(0).send(big, dest=2, tag=7)
         world.rank(0).recv(source=2, tag=8)       # tiny ack = delivery
         bws.append(time.perf_counter() - t0)
+    # sized MPI-level RTT sweep (the smallmsg_latency fabric rows)
+    sized = {}
+    for li, (label, elems) in enumerate(
+            (("64B", 16), ("1KiB", 256), ("64KiB", 16384))):
+        m = np.ones((elems,), np.float32)
+        tb = 20 + 2 * li
+        world.rank(0).send(m, dest=2, tag=tb)     # warm this size
+        world.rank(0).recv(source=2, tag=tb + 1)
+        ts = []
+        for i in range(150):
+            t0 = time.perf_counter()
+            world.rank(0).send(m, dest=2, tag=tb)
+            world.rank(0).recv(source=2, tag=tb + 1)
+            ts.append(time.perf_counter() - t0)
+        ts.sort()
+        sized["p50_%s_rtt_us" % label] = round(
+            ts[len(ts) // 2] * 1e6, 1)
+        sized["p99_%s_rtt_us" % label] = round(
+            ts[int(len(ts) * 0.99)] * 1e6, 1)
     print("FABRICPERF " + json.dumps({
         "p50_small_rtt_us": round(float(np.median(rtts)) * 1e6, 1),
         "gbps_8MiB_mpi": round(
             big.nbytes / float(np.median(bws)) / 1e9, 2),
+        "smallmsg": sized,
     }), flush=True)
 else:
     world.rank(2).recv(source=0, tag=1)
@@ -560,6 +642,13 @@ else:
     for i in range(6):
         world.rank(2).recv(source=0, tag=7)
         world.rank(2).send(small, dest=0, tag=8)
+    for li, (label, elems) in enumerate(
+            (("64B", 16), ("1KiB", 256), ("64KiB", 16384))):
+        m = np.ones((elems,), np.float32)
+        tb = 20 + 2 * li
+        for i in range(151):
+            world.rank(2).recv(source=0, tag=tb)
+            world.rank(2).send(m, dest=0, tag=tb + 1)
 print("WORKER %d OK" % pid, flush=True)
 """
 
@@ -600,6 +689,58 @@ _R4 = {
     "mpi_p50_small_rtt_us": 382.7,
     "mpi_gbps_8MiB": 0.25,
 }
+
+#: Round-5 small-message reference values (BENCH_r05.json): the
+#: before side of the fastpath rewrite's vs_baseline deltas.
+_R5 = {
+    "shm_p50_64B_rtt_us": 35.6,
+    "shm_p99_64B_rtt_us": 117.4,
+    "mpi_p50_small_rtt_us": 336.5,
+    "allreduce_p50_us_32B": 325.0,
+    "persistent_start_us": 635.3,
+}
+
+
+def _smallmsg_summary(shm: dict, mpi: dict, cpu: dict) -> dict:
+    """The smallmsg_latency row: p50/p99 RTT per size over the shm
+    descriptor lane and the MPI-level fabric path, plus the dispatch
+    p50s, each with its speedup over the round-5 value."""
+    def ratio(old, new):
+        if isinstance(new, (int, float)) and new > 0:
+            return round(old / new, 1)
+        return None
+
+    out = {
+        "shm": {k: v for k, v in shm.items() if "_rtt_us" in k},
+        "fabric": dict(mpi.get("smallmsg") or {}),
+        "dispatch": {
+            "allreduce_p50_us_32B": cpu.get("allreduce_p50_us_32B"),
+            "persistent_start_us": cpu.get("persistent_start_us"),
+            "persistent_start_only_us": cpu.get(
+                "persistent_start_only_us"),
+        },
+        "vs_baseline": {
+            "shm_p50_64B_rtt_us_r5": _R5["shm_p50_64B_rtt_us"],
+            "shm_p50_64B_speedup": ratio(
+                _R5["shm_p50_64B_rtt_us"], shm.get("p50_64B_rtt_us")),
+            "shm_p99_64B_rtt_us_r5": _R5["shm_p99_64B_rtt_us"],
+            "shm_p99_64B_speedup": ratio(
+                _R5["shm_p99_64B_rtt_us"], shm.get("p99_64B_rtt_us")),
+            "fabric_p50_small_rtt_us_r5": _R5["mpi_p50_small_rtt_us"],
+            "fabric_p50_small_speedup": ratio(
+                _R5["mpi_p50_small_rtt_us"],
+                mpi.get("p50_small_rtt_us")),
+            "dispatch_p50_us_32B_r5": _R5["allreduce_p50_us_32B"],
+            "dispatch_speedup": ratio(
+                _R5["allreduce_p50_us_32B"],
+                cpu.get("allreduce_p50_us_32B")),
+            "persistent_start_us_r5": _R5["persistent_start_us"],
+            "persistent_start_speedup": ratio(
+                _R5["persistent_start_us"],
+                cpu.get("persistent_start_us")),
+        },
+    }
+    return out
 
 
 def _run_pair(worker: str, marker: str, *args,
@@ -781,16 +922,21 @@ for nbytes in (8 * 4, 16 << 10, 1 << 20):
         ts.append(time.perf_counter() - t0)
     out[f"allreduce_p50_us_{nbytes}B"] = round(
         float(np.median(ts)) * 1e6, 1)
-# persistent-collective start() dispatch p50
+# persistent-collective dispatch p50: start()+wait() (the r5
+# comparable) plus start() alone — the pure re-arm cost the cached
+# bound plan is meant to eliminate.
 req = world.allreduce_init(x)
 req.start(); req.wait()
-ts = []
+ts = []; ts_start = []
 for _ in range(30):
     t0 = time.perf_counter()
     req.start()
+    ts_start.append(time.perf_counter() - t0)
     req.wait()
     ts.append(time.perf_counter() - t0)
 out["persistent_start_us"] = round(float(np.median(ts)) * 1e6, 1)
+out["persistent_start_only_us"] = round(
+    float(np.median(ts_start)) * 1e6, 1)
 
 # partitioned overlap: MPI-4's motivating shape — a producer thread
 # that finishes the message bucket-by-bucket and a consumer thread
@@ -1326,6 +1472,8 @@ def _host_rows() -> dict:
     rows["monitoring_overhead"] = cpu.pop(
         "monitoring_overhead", {"error": "missing"})
     rows["cpu_mesh_dispatch"] = cpu
+    _set_phase("small-message latency summary")
+    rows["smallmsg_latency"] = _smallmsg_summary(shm, mpi, cpu)
     _set_phase("quantized allreduce sweep (8-rank mesh)")
     rows["quant_allreduce_sweep"] = _quant_sweep_row()
     _set_phase("dp gradient bucket fusion (8-rank mesh)")

@@ -1,0 +1,70 @@
+"""Fast-path latency-hygiene rule.
+
+``fastsleep``: a constant ``time.sleep(<c>)`` on the small-message fast
+path (btl/sm, the pml engine, the progress pump, coll/sm) puts a fixed
+latency floor under every message that crosses it — the exact failure
+mode the fastpath rework removed (a single 1 ms park was ~30x the
+whole-descriptor-hop budget). Unlike ``polldeadline`` this rule is not
+about unbounded loops: even a deadline-bounded constant sleep is wrong
+here. Fast-path waits must ride an event primitive — the shm doorbell
+(``wait_event``), the fastpath ring futex (``fp_recv``/``fp_sendrecv``),
+a condition variable, or ``core.backoff.Backoff`` whose delays grow
+from a sub-millisecond floor.
+
+Suppression: ``# commlint: allow(fastsleep)`` on the sleep line, for
+the rare wait that genuinely models elapsed wall time (fault drills).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule
+from .polling import _is_time_sleep, _sleep_const
+
+#: Modules on the small-message hot path. Matched against the
+#: '/'-normalised repo-relative path, so both repo-root and package-root
+#: lint invocations agree.
+_FAST_PATH = (
+    "btl/sm.py",
+    "core/progress.py",
+    "coll/smcoll.py",
+)
+_FAST_PATH_DIRS = ("pml/",)
+
+
+def _on_fast_path(relpath: str) -> bool:
+    p = relpath.replace("\\", "/")
+    if any(p.endswith(suffix) for suffix in _FAST_PATH):
+        return True
+    return any(f"/{d}" in p or p.startswith(d) for d in _FAST_PATH_DIRS)
+
+
+@COMMLINT.register
+class FastPathSleepRule(LintRule):
+    NAME = "fastsleep"
+    PRIORITY = 54  # right below polldeadline: same family, narrower scope
+    DESCRIPTION = ("no constant time.sleep on the sm/pml fast path — "
+                   "wait on the doorbell/futex primitives instead")
+    SEVERITY = Severity.ERROR
+
+    def check(self, ctx) -> Iterable:
+        if not _on_fast_path(ctx.relpath):
+            return
+        for node in ast.walk(ctx.tree):
+            if not _is_time_sleep(node):
+                continue
+            val = _sleep_const(node)
+            if val is None or val <= 0:
+                continue  # dynamic delays and yields are polldeadline's
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"constant time.sleep({val!r}) on the small-message "
+                "fast path adds a fixed latency floor to every message "
+                "crossing it; park on the shm doorbell (wait_event), "
+                "the fastpath ring futex, or core.backoff.Backoff",
+            )

@@ -63,6 +63,13 @@ class BtlComponent(mca.Component):
         array immediately, completion = array readiness)."""
         raise NotImplementedError
 
+    def wire_label(self, comm, src_rank: int, dst_rank: int) -> str:
+        """comm_method detail string for this pair. Components that mux
+        several mechanisms behind one name (sm: descriptor fastpath,
+        CMA pull, eager rings) append the negotiated lanes, e.g.
+        "sm/fp+cma". Base: just the component name."""
+        return self.NAME
+
 
 @BTL.register
 class SelfBtl(BtlComponent):
@@ -127,3 +134,9 @@ class Bml:
                 btl = inject.maybe_wrap_sm(btl)
             self._cache[key] = btl
         return btl
+
+    def wire_label(self, src_rank: int, dst_rank: int) -> str:
+        """The selected BTL's lane-qualified label for this pair
+        (reference: hook_comm_method printing the chosen mechanism)."""
+        btl = self.btl_for(src_rank, dst_rank)
+        return btl.wire_label(self._comm, src_rank, dst_rank)

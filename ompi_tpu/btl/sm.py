@@ -29,6 +29,7 @@ from typing import Any, Optional
 import numpy as np
 
 from ..core import config
+from ..core.backoff import Backoff
 from ..core.counters import SPC
 from ..core.errors import OmpiTpuError
 from ..core.logging import get_logger
@@ -75,6 +76,37 @@ _cma_min_var = config.register(
                 "CMA is a rendezvous (the sender parks until the "
                 "receiver reads the message); below this, bulk keeps "
                 "the buffered chunk tier and completes on return.",
+)
+_fp_enable_var = config.register(
+    "btl", "sm", "fp_enable", type=bool, default=True,
+    description="Use the fastpath shared-ring doorbell lane "
+                "(native/src/fastpath.cc) for small messages: SPSC "
+                "descriptor rings with inline payload <=256 B and slab "
+                "frames above; full rings spill to the general engine",
+)
+_fp_ring_entries_var = config.register(
+    "btl", "sm", "fp_ring_entries", type=int, default=64,
+    description="Descriptors per fastpath ring (power of two). 64 x "
+                "320 B descriptors = one 20 KiB ring per peer pair",
+)
+_fp_slab_frames_var = config.register(
+    "btl", "sm", "fp_slab_frames", type=int, default=32,
+    description="Slab frames per fastpath peer pair (payloads between "
+                "256 B inline and fp_frame_size ride these; exhaustion "
+                "spills to the general engine)",
+)
+_fp_frame_size_var = config.register(
+    "btl", "sm", "fp_frame_size", type=int, default=64 * 1024,
+    description="Bytes per fastpath slab frame — the fast lane's upper "
+                "payload bound; larger messages always take the "
+                "eager/chunk/CMA tiers",
+)
+_fp_spin_us_var = config.register(
+    "btl", "sm", "fp_spin_us", type=int, default=20,
+    description="Bounded spin budget (us) a fastpath/doorbell waiter "
+                "burns (sched_yield loop) before parking on the futex. "
+                "On few-core hosts the yield IS the handoff to the "
+                "producer; 0 parks immediately",
 )
 
 
@@ -251,6 +283,25 @@ _STAT_NAMES = (
     "offload_unexpected",
 )
 
+_inject_mod = None
+
+
+def _inject():
+    """Lazy ft.inject handle (the ft package pulls in pml.framework at
+    module scope, so a top-level import here would be circular)."""
+    global _inject_mod
+    if _inject_mod is None:
+        from ..ft import inject as m
+
+        _inject_mod = m
+    return _inject_mod
+
+
+_FP_STAT_NAMES = (
+    "sends_inline", "sends_frame", "ring_full", "slab_full", "recvs",
+    "crc_drops", "futex_parks", "bytes_sent", "bytes_recv",
+)
+
 
 class ShmEndpoint:
     """One process's shared-memory presence: its own segment plus maps
@@ -275,7 +326,22 @@ class ShmEndpoint:
             raise ShmError(
                 f"cannot create shm segment /{prefix}_{my_rank}"
             )
+        spin_us = max(0, _fp_spin_us_var.value)
+        lib.shm_set_spin(self._ctx, spin_us)
+        # The fastpath lane: a second, minimal segment of SPSC
+        # descriptor rings + slab frame pools. Optional — every caller
+        # falls back to the general engine when it is absent or full.
+        self._fp = None
+        if _fp_enable_var.value and hasattr(lib, "fp_attach"):
+            self._fp = lib.fp_attach(
+                prefix.encode(), my_rank, _max_peers_var.value,
+                _fp_ring_entries_var.value, _fp_slab_frames_var.value,
+                _fp_frame_size_var.value, spin_us,
+            ) or None
+        self.fp_peers: set[int] = set()
+        self._fp_tls = threading.local()
         self._mu = threading.Lock()
+        self._drained = threading.Condition(self._mu)
         self._inflight = 0
         self._closed = False
         self.peers: set[int] = set()
@@ -292,6 +358,8 @@ class ShmEndpoint:
     def _end(self) -> None:
         with self._mu:
             self._inflight -= 1
+            if self._closed and self._inflight == 0:
+                self._drained.notify_all()  # close() waits on this
 
     @contextlib.contextmanager
     def _native_call(self, *, what: str):
@@ -302,8 +370,7 @@ class ShmEndpoint:
         try:
             yield
         finally:
-            with self._mu:
-                self._inflight -= 1
+            self._end()
 
     def connect(self, peer_rank: int, timeout_s: float = 30.0) -> None:
         with self._native_call(what="connect"):
@@ -316,6 +383,19 @@ class ShmEndpoint:
                 f"(/{self.prefix}_{peer_rank})"
             )
         self.peers.add(peer_rank)
+        # The fastpath lane rides along: claim a producer slot in the
+        # peer's fp segment. Failure is non-fatal (sends spill to the
+        # general engine just attached above).
+        if self._fp is not None:
+            with self._native_call(what="fp_connect"):
+                rc = self._lib.fp_connect(
+                    self._fp, peer_rank, int(timeout_s * 1000)
+                )
+            if rc == 0:
+                self.fp_peers.add(peer_rank)
+            else:
+                logger.debug("fp_connect to %d failed rc=%d (fastpath "
+                             "disabled toward this peer)", peer_rank, rc)
 
     @staticmethod
     def _as_ptr(data):
@@ -362,6 +442,316 @@ class ShmEndpoint:
         del hkeep, pkeep
         return self._check_send_rc(rc, peer_rank, hn + pn)
 
+    # -- fastpath lane (native/src/fastpath.cc): per-peer SPSC
+    # descriptor rings with inline payload + slab frames. Strictly
+    # opportunistic — every entry point spills to the general engine
+    # when the lane is absent, unconnected, or full, so callers keep
+    # the v2 tiers' delivery guarantees. ------------------------------
+
+    def fp_available(self, peer_rank: Optional[int] = None) -> bool:
+        if self._fp is None:
+            return False
+        return peer_rank is None or peer_rank in self.fp_peers
+
+    def fp_send(self, peer_rank: int, tag: int, data) -> bool:
+        """Post one descriptor on the fast lane. True when posted
+        (delivery complete from the sender's view — copy semantics);
+        False when the caller must spill to send_bytes (lane missing,
+        ring/slab full, payload larger than a slab frame)."""
+        if self._fp is None or peer_rank not in self.fp_peers:
+            return False
+        inj = _inject()
+        if inj.armed():
+            inj.on_fp_send(self, peer_rank, tag)
+        ptr, n, keep = self._as_ptr(data)
+        self._begin("fp_send")
+        try:
+            rc = self._lib.fp_send(self._fp, peer_rank, tag, ptr, n)
+        finally:
+            self._end()
+        del keep
+        if rc == 0:
+            SPC.record("sm_send_bytes", n)
+            return True
+        if rc == -2:
+            raise ShmError(f"shm peer {peer_rank} is dead")
+        SPC.record("sm_fp_spills")
+        return False
+
+    def send_small(self, peer_rank: int, tag: int, data) -> int:
+        """Small-message send: fastpath descriptor post when the lane
+        has room, general-engine send otherwise. Always completes on
+        return (both lanes have copy semantics)."""
+        if self.fp_send(peer_rank, tag, data):
+            return 0
+        return self.send_bytes(peer_rank, tag, data)
+
+    def fp_send_many(self, peer_rank: int, msgs) -> int:
+        """Coalesced post: msgs is a sequence of (tag, bytes). All
+        descriptors land under ONE native call and one doorbell ring;
+        whatever does not fit spills to the general engine here.
+        Returns how many rode the fast lane."""
+        if self._fp is None or peer_rank not in self.fp_peers:
+            posted = 0
+        else:
+            n = len(msgs)
+            tags = (ctypes.c_longlong * n)(*(t for t, _ in msgs))
+            lens = (ctypes.c_longlong * n)(*(len(p) for _, p in msgs))
+            blob = b"".join(bytes(p) for _, p in msgs)
+            self._begin("fp_send_many")
+            try:
+                posted = int(self._lib.fp_send_many(
+                    self._fp, peer_rank, n, tags, lens, blob
+                ))
+            finally:
+                self._end()
+            if posted < 0:
+                posted = 0
+            if posted:
+                SPC.record("sm_send_bytes",
+                           int(sum(lens[:posted])))
+        for tag, payload in msgs[posted:]:
+            SPC.record("sm_fp_spills")
+            self.send_bytes(peer_rank, tag, payload)
+        return posted
+
+    def send_many(self, peer_rank: int, msgs) -> None:
+        """Coalesced v2-lane post: msgs is a sequence of (tag, bytes).
+        Fastbox-tier messages land under ONE native call and one
+        doorbell ring (shm_send_many); whatever does not batch (bulk
+        tiers, ring stalls) ships per-message here. Copy semantics
+        throughout — every message is delivered or raised on return."""
+        n = len(msgs)
+        if n == 0:
+            return
+        if n == 1 or not hasattr(self._lib, "shm_send_many"):
+            for tag, payload in msgs:
+                self.send_bytes(peer_rank, tag, payload)
+            return
+        tags = (ctypes.c_longlong * n)(*(t for t, _ in msgs))
+        lens = (ctypes.c_longlong * n)(*(len(p) for _, p in msgs))
+        blob = b"".join(bytes(p) for _, p in msgs)
+        self._begin("send_many")
+        try:
+            posted = int(self._lib.shm_send_many(
+                self._ctx, peer_rank, n, tags, lens, blob
+            ))
+        finally:
+            self._end()
+        if posted == -1:
+            raise ShmError(f"shm peer {peer_rank} not connected")
+        if posted == -2:
+            raise ShmError(f"shm peer {peer_rank} is dead")
+        SPC.record("sm_send_bytes", int(sum(lens[:posted])))
+        if posted:
+            SPC.record("sm_batched_sends", posted)
+        for tag, payload in msgs[posted:]:
+            self.send_bytes(peer_rank, tag, payload)
+
+    def _fp_wait(self, src: int, deadline: float, native_fn, *cells):
+        """Shared fp receive loop: <=100 ms native slices (the drain
+        discipline — close() must observe _inflight within one slice),
+        CRC-rejected descriptors counted and skipped."""
+        while True:
+            rem_us = int((deadline - time.monotonic()) * 1e6)
+            if rem_us <= 0:
+                raise ShmError("fp recv timeout")
+            self._begin("fp_recv")
+            try:
+                rc = native_fn(self._fp, src, min(rem_us, 100_000),
+                               *cells)
+            finally:
+                self._end()
+            if rc >= 0:
+                return rc
+            if rc == -5:
+                SPC.record("sm_fp_crc_drops")
+                continue
+            if rc != -3:
+                raise ShmError(f"fastpath recv error rc={rc}")
+
+    def _fp_scratch(self) -> np.ndarray:
+        """Per-thread landing buffer for the copy-out fp receives.
+        Both users (fp_recv, fp_sendrecv) copy the payload out before
+        returning, so one frame-sized buffer per thread is safe and
+        saves a 64 KiB allocation per call — measurable against a
+        ~3 us wire RTT."""
+        buf = getattr(self._fp_tls, "buf", None)
+        if buf is None or buf.nbytes < _fp_frame_size_var.value:
+            buf = np.empty(_fp_frame_size_var.value, np.uint8)
+            self._fp_tls.buf = buf
+        return buf
+
+    def fp_recv(self, src: int, timeout: float = 10.0):
+        """Next fast-lane message from `src` as (tag, bytes). Single
+        consumer per source ring (the fabric progress thread or the
+        collective leader — never both)."""
+        if self._fp is None:
+            raise ShmError("fastpath lane unavailable")
+        buf = self._fp_scratch()
+        tag = ctypes.c_longlong(0)
+        n = self._fp_wait(
+            src, time.monotonic() + timeout, self._lib.fp_recv,
+            buf.ctypes.data, buf.nbytes, ctypes.byref(tag),
+        )
+        SPC.record("sm_recv_bytes", n)
+        return int(tag.value), buf[:n].tobytes()
+
+    def fp_sendrecv(self, peer_rank: int, tag: int, data, src: int,
+                    timeout: float = 10.0):
+        """Combined post + reap in ONE native transition — the
+        ping-pong hop primitive. Falls back to send_small + fp_recv
+        when the post spills."""
+        if self._fp is None or peer_rank not in self.fp_peers:
+            self.send_small(peer_rank, tag, data)
+            return self.fp_recv(src, timeout)
+        ptr, n, keep = self._as_ptr(data)
+        buf = self._fp_scratch()
+        rtag = ctypes.c_longlong(0)
+        deadline = time.monotonic() + timeout
+        self._begin("fp_sendrecv")
+        try:
+            rc = self._lib.fp_sendrecv(
+                self._fp, peer_rank, tag, ptr, n, src,
+                min(int(timeout * 1e6), 100_000), buf.ctypes.data,
+                buf.nbytes, ctypes.byref(rtag),
+            )
+        finally:
+            self._end()
+        del keep
+        if rc <= -20:  # send side failed: spill and recv separately
+            SPC.record("sm_fp_spills")
+            self.send_bytes(peer_rank, tag, data)
+            return self.fp_recv(src, max(0.001,
+                                         deadline - time.monotonic()))
+        SPC.record("sm_send_bytes", n)
+        while rc < 0:  # recv side: timeout slice or CRC drop — retry
+            if rc == -5:
+                SPC.record("sm_fp_crc_drops")
+            rc = self._fp_wait(
+                src, deadline, self._lib.fp_recv,
+                buf.ctypes.data, buf.nbytes, ctypes.byref(rtag),
+            )
+        SPC.record("sm_recv_bytes", rc)
+        return int(rtag.value), buf[:rc].tobytes()
+
+    def fp_echo(self, src: int, count: int, timeout: float = 10.0) -> int:
+        """Bench/drill responder: bounce `count` fast-lane messages from
+        `src` straight back, entirely in native code (the initiator's
+        measured round trip never includes interpreter turnaround).
+        Returns echoes completed."""
+        if self._fp is None or src not in self.fp_peers:
+            raise ShmError("fastpath lane unavailable")
+        with self._native_call(what="fp_echo"):
+            return int(self._lib.fp_echo(
+                self._fp, src, count, int(timeout * 1e6)))
+
+    def fp_pingpong(self, peer_rank: int, nbytes: int, iters: int,
+                    timeout: float = 10.0) -> np.ndarray:
+        """Bench initiator: `iters` native ping-pong round trips of
+        `nbytes` against a peer sitting in fp_echo. Returns per-round
+        wall seconds (float64 array of the rounds completed)."""
+        if self._fp is None or peer_rank not in self.fp_peers:
+            raise ShmError("fastpath lane unavailable")
+        ns = np.zeros(iters, np.int64)
+        with self._native_call(what="fp_pingpong"):
+            done = int(self._lib.fp_pingpong(
+                self._fp, peer_rank, peer_rank, nbytes, iters,
+                int(timeout * 1e6),
+                ns.ctypes.data_as(ctypes.POINTER(ctypes.c_longlong)),
+            ))
+        if done < 0:
+            raise ShmError(f"fp_pingpong error rc={done}")
+        SPC.record("sm_send_bytes", nbytes * done)
+        return ns[:done].astype(np.float64) * 1e-9
+
+    def fp_recv_view(self, src: int, timeout: float = 10.0):
+        """Zero-copy receive: (tag, uint8 array aliasing the payload
+        IN the shared segment, release_token). The view is valid until
+        fp_release(token) (token -1: inline payload in a ctx-local
+        scratch, nothing to release — but the NEXT fp_recv_view
+        overwrites it, so consume before re-polling). This is the
+        PiP-style reduction plane: smcoll accumulates straight out of
+        the peer's frame."""
+        if self._fp is None:
+            raise ShmError("fastpath lane unavailable")
+        ptr = ctypes.c_void_p(0)
+        tag = ctypes.c_longlong(0)
+        tok = ctypes.c_longlong(-1)
+        n = self._fp_wait(
+            src, time.monotonic() + timeout, self._lib.fp_recv_view,
+            ctypes.byref(ptr), ctypes.byref(tag), ctypes.byref(tok),
+        )
+        SPC.record("sm_recv_bytes", n)
+        if n == 0:
+            arr = np.empty(0, np.uint8)
+        else:
+            arr = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_ubyte)),
+                shape=(n,),
+            )
+        return int(tag.value), arr, int(tok.value)
+
+    def fp_try_recv_view(self, src: int):
+        """Nonblocking fp_recv_view: ONE native poll, None when the
+        ring is empty (a CRC-rejected descriptor is dropped, counted
+        and also reported as empty — the retry is the caller's next
+        poll). This is the demux primitive: coll/sm's router drains a
+        source ring under its own lock without committing to a wait."""
+        if self._fp is None:
+            return None
+        ptr = ctypes.c_void_p(0)
+        tag = ctypes.c_longlong(0)
+        tok = ctypes.c_longlong(-1)
+        self._begin("fp_recv")
+        try:
+            rc = self._lib.fp_recv_view(
+                self._fp, src, 0, ctypes.byref(ptr),
+                ctypes.byref(tag), ctypes.byref(tok),
+            )
+        finally:
+            self._end()
+        if rc == -3:
+            return None
+        if rc == -5:
+            SPC.record("sm_fp_crc_drops")
+            return None
+        if rc < 0:
+            raise ShmError(f"fastpath recv error rc={rc}")
+        SPC.record("sm_recv_bytes", rc)
+        if rc == 0:
+            arr = np.empty(0, np.uint8)
+        else:
+            arr = np.ctypeslib.as_array(
+                ctypes.cast(ptr, ctypes.POINTER(ctypes.c_ubyte)),
+                shape=(int(rc),),
+            )
+        return int(tag.value), arr, int(tok.value)
+
+    def fp_release(self, token: int) -> None:
+        """Return a fp_recv_view slab frame to the sender's pool."""
+        if token < 0 or self._fp is None:
+            return
+        with self._native_call(what="fp_release"):
+            self._lib.fp_release(self._fp, token)
+
+    def fp_corrupt_next(self) -> None:
+        """Faultline drill hook: the next fp_send posts a descriptor
+        with a deliberately wrong CRC; the receiver must reject it."""
+        if self._fp is None:
+            return
+        with self._native_call(what="fp_corrupt_next"):
+            self._lib.fp_corrupt_next(self._fp)
+
+    def fp_stats(self) -> dict:
+        if self._fp is None:
+            return {}
+        with self._native_call(what="fp_stats"):
+            return {
+                n: int(self._lib.fp_stat(self._fp, i))
+                for i, n in enumerate(_FP_STAT_NAMES)
+            }
+
     def poll_recv(self) -> Optional[tuple[int, int, Any]]:
         """One completed message as (peer, tag, payload) or None.
         Payload is `bytes` up to 64 KiB and a read-only memoryview
@@ -387,6 +777,46 @@ class ShmEndpoint:
             if not msgid:
                 return None
             return self._consume(msgid, peer, tag, length)
+        finally:
+            self._end()
+
+    def poll_recv_many(self, max_msgs: int = 16) -> list:
+        """Batched completion reap: up to max_msgs completed messages
+        as [(peer, tag, payload), ...] out of ONE native sweep + lock
+        cycle (shm_poll_recv_many). The pml progress loop uses this so
+        a burst of N small messages costs one Python->C transition for
+        the reap instead of N+1 polls."""
+        try:
+            self._begin("poll_many")
+        except ShmError:
+            return []  # closed
+        try:
+            if not hasattr(self._lib, "shm_poll_recv_many"):
+                out1 = self.poll_recv()
+                return [out1] if out1 is not None else []
+            LL = ctypes.c_longlong
+            ids = (LL * max_msgs)()
+            peers = (ctypes.c_int * max_msgs)()
+            tags = (LL * max_msgs)()
+            lens = (LL * max_msgs)()
+            n = int(self._lib.shm_poll_recv_many(
+                self._ctx, max_msgs, ids, peers, tags, lens
+            ))
+            out = []
+            for i in range(n):
+                try:
+                    payload = self._read_payload(int(ids[i]),
+                                                 int(lens[i]))
+                except ShmPullError as exc:
+                    # Same absorption the pml does for the single-poll
+                    # path: an alive sender re-delivers via the chunk
+                    # tier, so the rest of the batch must still land.
+                    SPC.record("sm_pull_failures")
+                    logger.warning("shm pull failure in batch "
+                                   "absorbed: %s", exc)
+                    continue
+                out.append((int(peers[i]), int(tags[i]), payload))
+            return out
         finally:
             self._end()
 
@@ -626,14 +1056,18 @@ class ShmEndpoint:
             from ..core.logging import warn_once
 
             warn_once("btl.sm", "shm close: wake notify failed: %s", exc)
-        deadline = time.monotonic() + 5.0
-        remaining = 1
-        while time.monotonic() < deadline:
-            with self._mu:
-                remaining = self._inflight
-            if remaining == 0:
-                break
-            time.sleep(0.001)
+        # Drain: _end() notifies _drained when the last in-flight
+        # native call returns, so this parks instead of polling; the
+        # timed wait (Backoff schedule, bounded by the 5 s deadline)
+        # only guards a missed notify or a call wedged in its <=100 ms
+        # futex slice.
+        bo = Backoff(timeout=5.0, initial=0.001, maximum=0.05)
+        with self._mu:
+            while self._inflight and not bo.expired:
+                self._drained.wait(
+                    timeout=max(0.001, min(bo.next_delay(), 0.1))
+                )
+            remaining = self._inflight
         if remaining:
             logger.warning(
                 "shm close: %d native call(s) did not drain; leaking "
@@ -641,6 +1075,9 @@ class ShmEndpoint:
                 remaining,
             )
             return
+        if self._fp is not None:
+            self._lib.fp_detach(self._fp)
+            self._fp = None
         self._lib.shm_destroy(self._ctx)
 
     def __del__(self) -> None:
@@ -718,11 +1155,13 @@ class SmBtl(BtlComponent):
         )
 
     def wire_label(self, comm, src_rank: int, dst_rank: int) -> str:
-        """comm_method detail: "sm/cma" when bulk toward the remote
-        side of this pair rides the single-copy pull, plain "sm"
-        otherwise (mirrors the reference printing the sm mechanism).
+        """comm_method detail: the negotiated sm lanes for this pair —
+        "fp" when small messages toward the remote side ride the
+        shared-ring descriptor fastpath, "cma" when bulk rides the
+        single-copy pull. Renders "sm/fp+cma", "sm/fp", "sm/cma", or
+        plain "sm" (mirrors the reference printing the sm mechanism).
         Local view only: pairs not involving this process render plain
-        "sm" even if those two processes negotiated CMA between
+        "sm" even if those two processes negotiated lanes between
         themselves — their mechanism is not observable from here."""
         from ..pml.framework import PML
 
@@ -742,8 +1181,13 @@ class SmBtl(BtlComponent):
         if me not in indices:
             return self.NAME  # not our pair: mechanism unobservable
         remote = [idx for idx in indices if idx != me]
+        lanes = []
+        if remote and all(eng.shm.fp_available(idx) for idx in remote):
+            lanes.append("fp")
         if remote and all(eng.shm.peer_cma(idx) for idx in remote):
-            return f"{self.NAME}/cma"
+            lanes.append("cma")
+        if lanes:
+            return f"{self.NAME}/{'+'.join(lanes)}"
         return self.NAME
 
     def transfer(self, value, src_proc, dst_proc):

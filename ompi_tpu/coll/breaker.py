@@ -92,10 +92,30 @@ class _Tier:
 
 _tiers: dict[tuple[str, str], _Tier] = {}
 _mu = threading.Lock()
+# Bumped on every recorded failure/success/reset. The tuned fast
+# dispatch cache stamps itself with this (plus config.generation());
+# any breaker activity invalidates the memoized route.
+_generation = 0
 
 
 def enabled() -> bool:
     return _enable.value
+
+
+def generation() -> int:
+    """Monotonic breaker-activity stamp (cache invalidation)."""
+    with _mu:
+        return _generation
+
+
+def quiet() -> bool:
+    """True when no tier is in a non-CLOSED state — the precondition
+    for memoizing a routed dispatch (an OPEN tier's cooldown expiry is
+    a lazy transition that a memoized route would never observe)."""
+    if not _tiers:
+        return True
+    with _mu:
+        return all(t.state == CLOSED for t in _tiers.values())
 
 
 def _get(op: str, algo: str) -> _Tier:
@@ -137,7 +157,9 @@ def is_open(op: str, algo: str) -> bool:
 
 
 def record_failure(op: str, algo: str) -> None:
+    global _generation
     with _mu:
+        _generation += 1
         t = _get(op, algo)
         t.failures += 1
         if t.state == HALF_OPEN or t.failures >= _threshold.value:
@@ -154,12 +176,15 @@ def record_failure(op: str, algo: str) -> None:
 
 
 def record_success(op: str, algo: str) -> None:
+    global _generation
     if not _tiers:  # hot path: nothing ever tripped, skip the lock
         return
     with _mu:
         t = _tiers.get((op, algo))
         if t is None:
             return
+        if t.state != CLOSED or t.failures:
+            _generation += 1
         if t.state != CLOSED:
             logger.info("breaker %s/%s: probe succeeded, CLOSED", op,
                         algo)
@@ -197,5 +222,7 @@ def route(op: str, algo: str, *, deny: tuple = ()) -> str:
 
 def reset() -> None:
     """Forget all tier state (tests / re-init)."""
+    global _generation
     with _mu:
+        _generation += 1
         _tiers.clear()

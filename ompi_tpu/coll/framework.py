@@ -215,6 +215,10 @@ class PersistentColl(Request):
         self.buffer = x
         self._pending = None
         self._dispatch = None  # resolved once, on first start()
+        # Interned at construction: start() is the latency-critical
+        # call (persistent_start_us bench row) and must do no per-call
+        # string building or allocation beyond the dispatch itself.
+        self._spc_name = f"coll_persistent_{opname}_starts"
 
     def bind(self, x: Any) -> None:
         """Rebind the input buffer (same shape/dtype reuses the plan)."""
@@ -269,7 +273,7 @@ class PersistentColl(Request):
             self._resolve()
         from ..core.counters import SPC
 
-        SPC.record(f"coll_persistent_{self._opname}_starts")
+        SPC.record(self._spc_name)
         self._pending = self._dispatch(self.buffer)
 
     def _poll(self) -> bool:

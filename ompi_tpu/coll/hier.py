@@ -81,6 +81,38 @@ class HierError(OmpiTpuError):
     errclass = "ERR_OTHER"
 
 
+#: In-place ufunc per predefined op (SUM's np_combine is a lambda, not
+#: a ufunc, so the out= form needs its own table). Custom/decorated ops
+#: fall back to the allocating np_reduce path.
+_INPLACE_UFUNC = {
+    "sum": np.add,
+    "prod": np.multiply,
+    "max": np.maximum,
+    "min": np.minimum,
+    "band": np.bitwise_and,
+    "bor": np.bitwise_or,
+    "bxor": np.bitwise_xor,
+}
+
+
+def _inplace_ufunc(op):
+    """The ufunc that can fold into an accumulator with out=, or None
+    (which keeps the tiered np_reduce path — native op kernels, custom
+    combines)."""
+    if getattr(op, "predefined", False):
+        return _INPLACE_UFUNC.get(op.name)
+    return None
+
+
+def _fold(acc: np.ndarray, incoming: np.ndarray, op) -> np.ndarray:
+    """acc = acc (op) incoming, in place when the op allows it."""
+    ufunc = _inplace_ufunc(op)
+    if ufunc is not None and acc.flags.writeable:
+        ufunc(acc, incoming, out=acc)
+        return acc
+    return op.np_reduce(acc, incoming)
+
+
 @dataclass
 class SliceHandle:
     """One slice's participation in a hierarchical collective."""
@@ -164,6 +196,19 @@ class SliceHandle:
                 return raw
             self._reorder.setdefault((src, got_tag), []).append(raw)
 
+    def recv_reduce_into(self, src_slice: int, tag: int, timeout: float,
+                         acc: np.ndarray, op) -> np.ndarray:
+        """Receive src's block and fold it into ``acc`` — the
+        accumulate hook of the exchange schedules. The base
+        implementation receives bytes and folds in place (saving the
+        np_reduce result allocation); transports whose frames are
+        peer-mapped (coll/sm's fastpath slab) override this to reduce
+        DIRECTLY out of the sender's frame, skipping the wire copy
+        entirely (the PiP-style single-copy reduction plane)."""
+        raw = self.recv_from(src_slice, tag, timeout)
+        incoming = np.frombuffer(raw, acc.dtype).reshape(acc.shape)
+        return _fold(acc, incoming, op)
+
 
 def _exchange_ring(h: SliceHandle, block: np.ndarray, op,
                    timeout: float, tag_base: int = _HIER_TAG
@@ -183,8 +228,10 @@ def _exchange_ring(h: SliceHandle, block: np.ndarray, op,
             h.peer_ids[right], tag_base + rnd, cur.tobytes()
         )
         raw = h.recv_from(left, tag_base + rnd, timeout)
+        # the received block is FORWARDED next round, so the ring keeps
+        # the copying receive; only the fold itself goes in-place
         cur = np.frombuffer(raw, block.dtype).reshape(block.shape)
-        acc = op.np_reduce(acc, cur)
+        acc = _fold(acc, cur, op)
     return acc
 
 
@@ -202,9 +249,8 @@ def _exchange_rd(h: SliceHandle, block: np.ndarray, op,
         h.endpoint.send_bytes(
             h.peer_ids[partner], tag_base + rnd, acc.tobytes()
         )
-        raw = h.recv_from(partner, tag_base + rnd, timeout)
-        incoming = np.frombuffer(raw, block.dtype).reshape(block.shape)
-        acc = op.np_reduce(acc, incoming)
+        acc = h.recv_reduce_into(partner, tag_base + rnd, timeout,
+                                 acc, op)
         dist <<= 1
         rnd += 1
     return acc
@@ -221,10 +267,7 @@ def _exchange_gather(h: SliceHandle, block: np.ndarray, op,
     if h.slice_id == 0:
         acc = block.copy()
         for src in range(1, h.n_slices):
-            raw = h.recv_from(src, tag_base, timeout)
-            acc = op.np_reduce(
-                acc, np.frombuffer(raw, block.dtype).reshape(block.shape)
-            )
+            acc = h.recv_reduce_into(src, tag_base, timeout, acc, op)
         for dst in range(1, h.n_slices):
             h.endpoint.send_bytes(
                 h.peer_ids[dst], tag_base + 1, acc.tobytes()
@@ -465,6 +508,14 @@ class FabricSlice:
         # the surviving controllers (SliceHandle.recv_from semantics)
         val = req.result(timeout=timeout)
         return np.asarray(val).tobytes()
+
+    def recv_reduce_into(self, src_slice: int, tag: int, timeout: float,
+                         acc: np.ndarray, op) -> np.ndarray:
+        """SliceHandle.recv_reduce_into, for the duck-typed surface
+        (coll/sm's ShmSlice overrides with the zero-copy slab fold)."""
+        raw = self.recv_from(src_slice, tag, timeout)
+        incoming = np.frombuffer(raw, acc.dtype).reshape(acc.shape)
+        return _fold(acc, incoming, op)
 
     def rank_ordered(self) -> bool:
         """True when comm ranks ascend with slice index (each process's

@@ -19,20 +19,36 @@ inherited from HierColl — only the wire changes.
 
 from __future__ import annotations
 
+import os
 import struct
 import threading
 import time
 from collections import deque
 from typing import Optional
 
+import numpy as np
+
 from ..core import progress as _progress
 from ..core.counters import SPC
 from ..pml.fabric import COLL_SM_TAG
 from .framework import COLL
-from .hier import FabricSlice, HierColl, HierError, _fabric_wired
+from .hier import FabricSlice, HierColl, HierError, _fabric_wired, _fold
 
 #: per-frame header: collective tag (q), source slice (i), comm cid (i)
+#: — the v2 (spill) lane only; the fastpath lane carries the same
+#: triple packed INTO the descriptor tag, so zero header bytes ride
+#: the frame (see _fp_tag)
 _HDR = struct.Struct("<qii")
+
+#: fastpath descriptor-tag packing: cid (12 bits) | src_slice (8) |
+#: collective tag (40). The hier tag window tops out near 2^28
+#: (_HIER_TAG + 4096*0x10000), so 40 bits are lossless.
+_FP_TAG_MASK = (1 << 40) - 1
+
+
+def _fp_tag(cid: int, src_slice: int, tag: int) -> int:
+    return (((cid & 0xFFF) << 48) | ((src_slice & 0xFF) << 40)
+            | (tag & _FP_TAG_MASK))
 
 
 def _engine():
@@ -54,6 +70,10 @@ class _Router:
         self.engine = engine
         self.q = engine.open_channel(COLL_SM_TAG)
         self.stash: dict[tuple, deque] = {}
+        # fastpath frames that arrived for a different (comm,
+        # collective) than the one draining the ring: copied out,
+        # released, parked here — (src_proc, fp_tag) -> deque[bytes]
+        self.fp_stash: dict[tuple, deque] = {}
         self._mu = threading.Lock()
 
     def _drain_locked(self) -> None:
@@ -77,16 +97,56 @@ class _Router:
                 return out
             return None
 
+    def fp_pop(self, src_proc: int, fptag: int):
+        """Next fastpath frame from ``src_proc`` matching ``fptag``:
+        ("view", arr, token) — zero-copy, the caller folds out of the
+        sender's slab frame then fp_release(token) — when the ring
+        head matches; ("bytes", raw, None) when a matching frame was
+        stashed by an earlier drain; None when nothing matches yet.
+        Head frames for OTHER (comm, collective) keys are copied out,
+        released immediately (slab frames are a scarce pool) and
+        stashed, so interleaved collectives never wedge each other.
+        Locked: one ring consumer at a time (the SPSC contract)."""
+        shm = self.engine.shm
+        with self._mu:
+            q = self.fp_stash.get((src_proc, fptag))
+            if q:
+                raw = q.popleft()
+                if not q:
+                    del self.fp_stash[(src_proc, fptag)]
+                return ("bytes", raw, None)
+            while True:
+                got = shm.fp_try_recv_view(src_proc)
+                if got is None:
+                    return None
+                tag, arr, token = got
+                if tag == fptag:
+                    if token < 0:
+                        # inline scratch: only valid until the next
+                        # poll on this ctx — hand out a copy
+                        return ("bytes", arr.tobytes(), None)
+                    return ("view", arr, token)
+                self.fp_stash.setdefault(
+                    (src_proc, tag), deque()).append(arr.tobytes())
+                shm.fp_release(token)
+
     def purge_window(self, cid: int, lo: int, hi: int) -> None:
         """Drop stashed frames of an aborted collective so the 4096-
         epoch tag-window recycle can never resurrect them as a later
-        collective's data."""
+        collective's data (both lanes)."""
         with self._mu:
             self._drain_locked()
             dead = [k for k in self.stash
                     if k[0] == cid and lo <= k[2] < hi]
             for k in dead:
                 del self.stash[k]
+            deadfp = [
+                k for k in self.fp_stash
+                if (k[1] >> 48) & 0xFFF == cid & 0xFFF
+                and lo <= (k[1] & _FP_TAG_MASK) < hi
+            ]
+            for k in deadfp:
+                del self.fp_stash[k]
 
 
 def _router(engine) -> _Router:
@@ -112,38 +172,100 @@ class ShmSlice(FabricSlice):
 
     def send_bytes(self, peer_slice: int, tag: int, raw: bytes) -> None:
         dst_proc = self.slices[peer_slice]
-        hdr = _HDR.pack(tag, self.slice_id, self.parent.cid)
-        self.engine.shm.send_bytes(dst_proc, COLL_SM_TAG, hdr + raw)
+        shm = self.engine.shm
+        # fastpath first: the (cid, slice, tag) triple rides packed in
+        # the descriptor tag, so the frame is pure payload — no header
+        # pack, no hdr+raw join. Spills (lane absent/full, frame-size
+        # overflow) take the enveloped v2 channel.
+        if shm.fp_send(dst_proc,
+                       _fp_tag(self.parent.cid, self.slice_id, tag),
+                       raw):
+            SPC.record("coll_sm_fp_sends")
+        else:
+            hdr = _HDR.pack(tag, self.slice_id, self.parent.cid)
+            shm.send_bytes(dst_proc, COLL_SM_TAG, hdr + raw)
         SPC.record("coll_sm_leader_sends")
         SPC.record("coll_sm_leader_bytes", len(raw))
 
-    def recv_from(self, src_slice: int, tag: int,
-                  timeout: float) -> bytes:
+    def _await_frame(self, src_slice: int, tag: int, timeout: float):
+        """Wait for (cid, src_slice, tag) on EITHER lane. Returns
+        ("view", arr, release_token) — payload aliasing the sender's
+        slab frame — or ("bytes", raw, None)."""
+        shm = self.engine.shm
+        src_proc = self.slices[src_slice]
+        fp_live = shm.fp_available()  # receive side: own lane attached
+        fptag = _fp_tag(self.parent.cid, src_slice, tag)
         key = (self.parent.cid, src_slice, tag)
-        deadline = time.monotonic() + timeout
-        spins = 0
+        now = time.monotonic()
+        deadline = now + timeout
+        # fastpath frames land in single-digit µs: a short yield-spin
+        # before parking is the latency win; the park cap stays small
+        # because fp doorbells ring the RING futex, not the v2 event
+        # this thread parks on.
+        spin_end = now + 0.0002
+        probes = 0
         while True:
+            if fp_live:
+                hit = self.router.fp_pop(src_proc, fptag)
+                if hit is not None:
+                    return hit
             out = self.router.pop(key)
             if out is not None:
-                return out
+                return ("bytes", out, None)
             # liveness probe (a kill(pid,0) syscall) only every ~50th
             # pass — per-iteration it would tax the very latency path
             # this transport shortens
-            spins += 1
-            if spins % 50 == 0 and not self.engine.shm.peer_alive(
-                    self.slices[src_slice]):
+            probes += 1
+            if probes % 50 == 0 and not shm.peer_alive(src_proc):
                 raise HierError(
                     f"coll/sm: slice {src_slice}'s controller died "
                     "mid-collective"
                 )
-            if time.monotonic() >= deadline:
+            now = time.monotonic()
+            if now >= deadline:
                 raise HierError(
                     f"coll/sm: timeout waiting for {key}"
                 )
-            # pump the fabric (fills the channel), then park briefly on
-            # the shm doorbell
+            if now < spin_end:
+                os.sched_yield()
+                continue
+            # pump the fabric (fills the v2 channel), then park briefly
+            # on the shm doorbell
             if _progress.progress() == 0:
-                self.engine.shm.wait_event(0.002)
+                self.engine.shm.wait_event(0.0005)
+
+    def recv_from(self, src_slice: int, tag: int,
+                  timeout: float) -> bytes:
+        kind, payload, token = self._await_frame(src_slice, tag, timeout)
+        if kind == "view":
+            raw = payload.tobytes()
+            self.engine.shm.fp_release(token)
+            return raw
+        return payload
+
+    def recv_reduce_into(self, src_slice: int, tag: int, timeout: float,
+                         acc: np.ndarray, op) -> np.ndarray:
+        """The single-copy reduction plane: fold the incoming block
+        into ``acc`` straight OUT of the sender's slab frame — the
+        only copy in the hop is the sender's post (PiP-style; the
+        reference's coll/sm reduces out of the shared fragment
+        segments the same way)."""
+        kind, payload, token = self._await_frame(src_slice, tag, timeout)
+        if kind == "view":
+            try:
+                if payload.nbytes != acc.nbytes:
+                    raise HierError(
+                        f"coll/sm: frame size {payload.nbytes} != "
+                        f"accumulator {acc.nbytes}"
+                    )
+                incoming = payload.view(acc.dtype).reshape(acc.shape)
+                out = _fold(acc, incoming, op)
+            finally:
+                self.engine.shm.fp_release(token)
+            SPC.record("coll_sm_slab_folds")
+            return out
+        incoming = np.frombuffer(payload, acc.dtype).reshape(acc.shape)
+        return _fold(acc, incoming, op)
 
     def next_tag_base(self) -> int:
         self._window = super().next_tag_base()

@@ -23,6 +23,7 @@ from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..core import config
 from ..core.errors import ArgumentError
@@ -90,6 +91,22 @@ _alltoall_small = _V("alltoall_small_msg", type=int, default=256,
 _alltoall_large = _V("alltoall_large_msg", type=int, default=32 << 10,
                      description="Alltoall: bytes/dest above which "
                                  "pairwise exchange is used")
+_fast_cache_var = _V("fast_dispatch_cache", type=bool, default=True,
+                     description="Memoize the routed allreduce dispatch "
+                                 "per (comm, shape, dtype, op): repeat "
+                                 "calls skip the decision pipeline "
+                                 "entirely. Invalidated by any config "
+                                 "mutation or breaker activity; bypassed "
+                                 "while faultline is armed")
+_host_small_max = _V("host_small_max_bytes", type=int, default=4096,
+                     description="Fully-addressable allreduces at or "
+                                 "below this many bytes reduce on the "
+                                 "HOST (numpy over the rank axis + one "
+                                 "device_put) instead of launching an "
+                                 "XLA program — dispatch latency beats "
+                                 "device compute at this size. 0 "
+                                 "disables. Skipped under forced "
+                                 "algorithms or a rules file")
 
 # Quantized-wire cvars live in coll/quant (coll_quant_enable / _wire /
 # _block / _min_bytes); decide_allreduce reads them through the quant
@@ -696,8 +713,86 @@ class TunedColl(XlaColl):
         return algo, compile_plan(comm, key, per_rank,
                                   check_vma=not is_pallas_algo(algo))
 
+    # Host-reducible predefined ops: ufunc.reduce over the rank axis
+    # preserves dtype and matches the device tier's combine.
+    _HOST_NP_OPS = {
+        "sum": np.add, "prod": np.multiply,
+        "max": np.maximum, "min": np.minimum,
+    }
+
+    def _fast_allreduce(self, comm, x, op):
+        """Memoized hot-path dispatch: the routed-and-compiled plan for
+        (shape, dtype, op) is cached on the comm and repeat calls skip
+        the whole decision pipeline (~hundreds of us of rules, breaker
+        walk, key building and SPC f-strings per call in r05 profiles).
+        Tiny fully-addressable payloads get the host tier instead — a
+        numpy reduction over the rank axis plus one device_put beats an
+        XLA program launch below ~4 KiB. Returns the result, or None
+        when the slow path must run (cache disabled/invalid, pytree
+        input, faultline armed, breaker non-quiet)."""
+        if not _fast_cache_var.value or not isinstance(x, jax.Array):
+            return None
+        if x.ndim < 1 or x.shape[0] != comm.size:
+            return None  # slow path raises the proper ArgumentError
+        from ..ft import inject
+
+        if inject.armed():
+            return None  # every drill must see the real dispatch
+        from . import breaker
+
+        stamp = (config.generation(), breaker.generation())
+        cache = comm.__dict__.setdefault("_tuned_fast", {})
+        key = (x.shape, x.dtype.name, op.cache_key)
+        ent = cache.get(key)
+        if ent is None or ent[0] != stamp:
+            if not breaker.quiet():
+                return None  # lazy OPEN->HALF_OPEN needs live routing
+            fn = self._build_fast_allreduce(comm, x, op)
+            if fn is None:
+                return None
+            ent = cache[key] = (stamp, fn)
+        try:
+            return ent[1](x)
+        except ArgumentError:
+            raise
+        except Exception:  # commlint: allow(broadexcept)
+            # Tier fault under a memoized plan: forget the entry and
+            # let the slow path re-route (and trip the breaker there).
+            cache.pop(key, None)
+            return None
+
+    def _build_fast_allreduce(self, comm, x, op):
+        from ..core.counters import SPC
+
+        limit = _host_small_max.value
+        if (0 < limit >= x.size * x.dtype.itemsize and op.predefined
+                and op.name in self._HOST_NP_OPS
+                and x.is_fully_addressable
+                and not _force_allreduce.value and _rules() is None):
+            ufunc = self._HOST_NP_OPS[op.name]
+            SPC.record("coll_allreduce_algo_host")
+
+            def host_plan(buf):
+                a = np.asarray(buf)
+                red = ufunc.reduce(a, axis=0)
+                return jax.device_put(np.broadcast_to(red, a.shape),
+                                      buf.sharding)
+
+            return host_plan
+        try:
+            _algo, plan = self._allreduce_choice(comm, x, op)
+        except ArgumentError:
+            raise
+        except Exception:  # commlint: allow(broadexcept)
+            return None  # slow path surfaces the real error
+        return plan
+
     def allreduce(self, comm, x, op):
         op = op_lookup(op)
+        if comm.size > 1:
+            out = self._fast_allreduce(comm, x, op)
+            if out is not None:
+                return out
         x = _leaf_check(comm, x)
         if comm.size == 1:
             return x

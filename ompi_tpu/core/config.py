@@ -133,6 +133,11 @@ class VarRegistry:
         self._lock = threading.RLock()
         self._file_values: dict[str, str] = {}
         self._files_loaded = False
+        # Bumped on every post-registration mutation (set /
+        # set_if_unset / load_param_file / reset). Fast-path caches
+        # (coll/tuned's memoized dispatch) key their validity on this
+        # instead of re-reading every cvar per call.
+        self._generation = 0
 
     # -- registration -----------------------------------------------------
 
@@ -226,6 +231,7 @@ class VarRegistry:
                     var._apply(
                         self._file_values[var.full_name], VarSource.FILE
                     )
+            self._generation += 1
 
     # -- access -----------------------------------------------------------
 
@@ -244,6 +250,8 @@ class VarRegistry:
         if var.flags & VarFlag.READONLY:
             raise PermissionError(f"{full_name} is read-only")
         var._apply(value, VarSource.API)
+        with self._lock:
+            self._generation += 1
 
     def set_if_unset(self, full_name: str, value: Any) -> None:
         var = self._vars.get(full_name)
@@ -251,6 +259,13 @@ class VarRegistry:
             raise KeyError(f"unknown config var: {full_name}")
         if var.source == VarSource.DEFAULT:
             var._apply(value, VarSource.API)
+            with self._lock:
+                self._generation += 1
+
+    def generation(self) -> int:
+        """Monotonic mutation counter (cache-invalidation stamp)."""
+        with self._lock:
+            return self._generation
 
     def dump(self, include_internal: bool = False) -> list[dict]:
         """Introspection dump (ompi_info equivalent)."""
@@ -284,6 +299,7 @@ class VarRegistry:
             self._vars.clear()
             self._file_values.clear()
             self._files_loaded = False
+            self._generation += 1
 
 
 # The process-global registry (the reference has exactly one, too).
@@ -300,3 +316,8 @@ def get(full_name: str, default: Any = None) -> Any:
 
 def set(full_name: str, value: Any) -> None:  # noqa: A001 - mirrors API name
     VARS.set(full_name, value)
+
+
+def generation() -> int:
+    """Registry mutation stamp — see VarRegistry.generation()."""
+    return VARS.generation()

@@ -14,13 +14,31 @@ and user generalized requests.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable
 
+from . import config
+
 # Reference constant: low-priority callbacks every 8th call
 # (opal_progress.c:240-245).
 LOW_PRIORITY_PERIOD = 8
+
+_spin_var = config.register(
+    "core", "progress", "spin_us", type=int, default=50,
+    description="Bounded spin budget (us) a pumping waiter burns on "
+                "empty sweeps (sched_yield between sweeps) before "
+                "escalating to parked idle waits. On few-core hosts "
+                "the yield IS the handoff to the producer; 0 parks "
+                "after the first empty sweep",
+)
+_idle_max_var = config.register(
+    "core", "progress", "idle_max_ms", type=float, default=1.0,
+    description="Cap on the escalating idle-park budget: past the spin "
+                "phase, empty sweeps park on transport doorbells for "
+                "0.1 ms doubling up to this cap (resets on any event)",
+)
 
 ProgressFn = Callable[[], int]  # returns number of "events" progressed
 
@@ -140,6 +158,13 @@ class ProgressEngine:
         reference's multi-waiter wait_sync design
         (opal/mca/threads/wait_sync.h) instead of N spinning threads."""
         deadline = None if timeout is None else time.monotonic() + timeout
+        # Empty-sweep hybrid: spin (yield between sweeps) for the first
+        # spin_us of idleness — the common case is a completion landing
+        # within microseconds — then park on the idle hooks' doorbells
+        # with an escalating budget so a long wait costs wakeups, not
+        # CPU. Both knobs are cvars; state is local to this wait loop.
+        spin_deadline: float | None = None
+        idle_budget = 1e-4
         while not predicate():
             if self._pumper.acquire(blocking=False):
                 try:
@@ -151,7 +176,20 @@ class ProgressEngine:
                 if deadline is not None and time.monotonic() >= deadline:
                     return False
                 if events == 0:
-                    self._idle(0.001)
+                    now = time.monotonic()
+                    if spin_deadline is None:
+                        spin_deadline = now + _spin_var.value * 1e-6
+                        idle_budget = 1e-4
+                    if now < spin_deadline:
+                        os.sched_yield()
+                    else:
+                        self._idle(idle_budget)
+                        idle_budget = min(
+                            idle_budget * 2,
+                            max(1e-4, _idle_max_var.value * 1e-3),
+                        )
+                else:
+                    spin_deadline = None
             else:
                 # someone else is pumping: sleep until a completion
                 # fires (bounded so a missed wakeup degrades to a tick)

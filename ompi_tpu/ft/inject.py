@@ -573,6 +573,31 @@ def maybe_wrap_sm(component):
     return FaultSmBtl(component)
 
 
+def on_fp_send(endpoint, peer: int, tag: Optional[int]) -> None:
+    """btl/sm fastpath descriptor-post hook. ``corrupt@btl_sm:
+    op=fp_send`` arms the endpoint's corrupt-next latch: the native
+    sender posts the next descriptor with its CRC XORed, and the drill
+    proves the receiver's validate path rejects and DROPS it (counted
+    in sm_fp_crc_drops) instead of delivering garbage or wedging the
+    ring. drop raises before the post (a torn lane); delay models a
+    descheduled producer."""
+    p = _PLAN
+    if p is None:
+        return
+    for spec in p.decide("btl_sm", "fp_send", peer=peer, tag=tag):
+        if spec.action == "corrupt":
+            endpoint.fp_corrupt_next()
+            SPC.record("faultline_fp_corrupts")
+        elif spec.action == "delay":
+            _apply_delay(spec)
+        elif spec.action == "drop":
+            from ..core.errors import CommError
+
+            raise CommError(
+                "faultline: fp descriptor post dropped (injected)"
+            )
+
+
 # -- modex/KV boundary (hooked inside runtime/modex.py) ----------------
 
 def on_modex(op: str, key: str) -> None:

@@ -121,6 +121,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
             ]
         _declare_dcn(lib)
         _declare_pool(lib)
+        _declare_fp(lib)
         _lib = lib
         return _lib
 
@@ -220,6 +221,58 @@ def _declare_pool(lib: ctypes.CDLL) -> None:
     lib.pool_free.argtypes = [P, LL]
     lib.pool_stat.restype = LL
     lib.pool_stat.argtypes = [P, ctypes.c_int]
+
+
+def _declare_fp(lib: ctypes.CDLL) -> None:
+    """fastpath.cc: the shared-ring doorbell lane (small messages)."""
+    LL = ctypes.c_longlong
+    P = ctypes.c_void_p
+    LLP = ctypes.POINTER(LL)
+    lib.fp_attach.restype = P
+    lib.fp_attach.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int,
+                              LL, LL, LL, LL]
+    lib.fp_connect.restype = ctypes.c_int
+    lib.fp_connect.argtypes = [P, ctypes.c_int, ctypes.c_int]
+    lib.fp_send.restype = LL
+    lib.fp_send.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL]
+    lib.fp_send_many.restype = LL
+    lib.fp_send_many.argtypes = [P, ctypes.c_int, LL, LLP, LLP,
+                                 ctypes.c_void_p]
+    lib.fp_recv.restype = LL
+    lib.fp_recv.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL, LLP]
+    lib.fp_sendrecv.restype = LL
+    lib.fp_sendrecv.argtypes = [P, ctypes.c_int, LL, ctypes.c_void_p, LL,
+                                ctypes.c_int, LL, ctypes.c_void_p, LL, LLP]
+    lib.fp_echo.restype = LL
+    lib.fp_echo.argtypes = [P, ctypes.c_int, LL, LL]
+    lib.fp_pingpong.restype = LL
+    lib.fp_pingpong.argtypes = [P, ctypes.c_int, ctypes.c_int, LL, LL,
+                                LL, LLP]
+    lib.fp_recv_view.restype = LL
+    lib.fp_recv_view.argtypes = [P, ctypes.c_int, LL,
+                                 ctypes.POINTER(ctypes.c_void_p), LLP, LLP]
+    lib.fp_release.restype = None
+    lib.fp_release.argtypes = [P, LL]
+    lib.fp_set_spin.restype = None
+    lib.fp_set_spin.argtypes = [P, LL]
+    lib.fp_corrupt_next.restype = None
+    lib.fp_corrupt_next.argtypes = [P]
+    lib.fp_stat.restype = LL
+    lib.fp_stat.argtypes = [P, ctypes.c_int]
+    lib.fp_detach.restype = None
+    lib.fp_detach.argtypes = [P]
+    # shm.cc additions riding with fastpath: batched completion reap
+    # and the tunable bounded-spin budget.
+    lib.shm_poll_recv_many.restype = LL
+    lib.shm_poll_recv_many.argtypes = [
+        P, LL, LLP, ctypes.POINTER(ctypes.c_int), LLP, LLP,
+    ]
+    lib.shm_set_spin.restype = None
+    lib.shm_set_spin.argtypes = [P, LL]
+    lib.shm_send_many.restype = LL
+    lib.shm_send_many.argtypes = [
+        P, ctypes.c_int, LL, LLP, LLP, ctypes.c_char_p,
+    ]
 
 
 def available() -> bool:

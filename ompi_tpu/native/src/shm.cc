@@ -329,6 +329,11 @@ struct Ctx {
 
   uint64_t eager_limit = 32 * 1024;  // btl_sm_component.c:243 lineage
   uint64_t fbox_msg_limit = 0;       // fbox_size/4, reference :200 regime
+  // Bounded spin budget before shm_wait_recv parks on the futex. On
+  // oversubscribed (few-core) hosts sched_yield IS the context switch
+  // to the producer, so a short yield-spin beats the futex round trip
+  // by ~2x; tuned via the btl_sm_fp_spin_us cvar through shm_set_spin.
+  std::atomic<int64_t> spin_ns{20000};
   bool cma_enabled = true;
   // Below this, bulk keeps the buffered chunk tier: CMA is rendezvous
   // (the sender parks until the receiver reads THIS message), and that
@@ -1175,6 +1180,59 @@ long long shm_send2(void* ctx, int peer_rank, long long tag,
                    (uint64_t)hlen, pay, (uint64_t)plen);
 }
 
+// Coalesced post: N small messages (payloads concatenated in `blob`)
+// to one peer under ONE connection lookup and, for the fastbox tier,
+// ONE deferred doorbell ring — a startall of N tiny sends costs one
+// wake instead of N. Messages that overflow the fastbox take the
+// eager ring via push_progress (which rings as it publishes — the
+// consumer may need the wake to drain the very ring we are filling);
+// anything above the eager tier stops the batch. Returns how many
+// messages were posted (the caller ships the rest via shm_send), or
+// -1 unknown peer / -2 peer dead with nothing posted.
+long long shm_send_many(void* ctx, int peer_rank, long long nmsg,
+                        const long long* tags, const long long* lens,
+                        const void* blob) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  PeerConn* p;
+  {
+    std::lock_guard<std::mutex> g(c->conn_mu);
+    auto it = c->peers.find(peer_rank);
+    if (it == c->peers.end()) return -1;
+    p = it->second;
+  }
+  if (p->seg->dead.load(std::memory_order_acquire)) return -2;
+  const char* cur = static_cast<const char*>(blob);
+  long long posted = 0;
+  bool pending_bell = false;
+  for (long long i = 0; i < nmsg; i++) {
+    uint64_t n = (uint64_t)lens[i];
+    if (n > c->eager_limit) break;
+    bool boxed = false;
+    if (n <= c->fbox_msg_limit) {
+      std::lock_guard<std::mutex> g(p->mu);
+      boxed = ring_push(slot_fbox(p->seg, p->slot), (uint64_t)tags[i],
+                        kEager, cur, n, nullptr, 0);
+    }
+    if (boxed) {
+      c->fbox_sends.fetch_add(1, std::memory_order_relaxed);
+      pending_bell = true;
+    } else {
+      if (!push_progress(c, p, slot_ring(p->seg, p->slot),
+                         (uint64_t)tags[i], kEager, cur, n, nullptr,
+                         0)) {
+        if (pending_bell) ring_doorbell(p->seg);
+        return posted > 0 ? posted : -2;
+      }
+      c->ring_sends.fetch_add(1, std::memory_order_relaxed);
+    }
+    c->bytes_sent.fetch_add((int64_t)n, std::memory_order_relaxed);
+    cur += n;
+    posted++;
+  }
+  if (pending_bell) ring_doorbell(p->seg);
+  return posted;
+}
+
 // One completed message, or 0. Out-params mirror dcn_poll_recv.
 long long shm_poll_recv(void* ctx, int* peer, long long* tag,
                         long long* len) {
@@ -1189,6 +1247,37 @@ long long shm_poll_recv(void* ctx, int* peer, long long* tag,
   *tag = m.tag;
   *len = (long long)(m.cma_slot >= 0 ? m.cma_total : m.data.len);
   return id;
+}
+
+// Batched completion reap: drain up to `max` completed messages in ONE
+// native call (one sweep, one lock cycle), filling parallel out arrays.
+// Returns the count. The pml progress loop uses this so a burst of N
+// small messages costs one Python->C transition instead of N+1.
+long long shm_poll_recv_many(void* ctx, long long max, long long* ids,
+                             int* peers, long long* tags, long long* lens) {
+  Ctx* c = static_cast<Ctx*>(ctx);
+  std::lock_guard<std::mutex> g(c->sweep_mu);
+  if (c->ready.empty()) sweep_locked(c);
+  long long n = 0;
+  while (n < max && !c->ready.empty()) {
+    int64_t id = c->ready.front();
+    c->ready.pop_front();
+    Msg& m = c->msgs[id];
+    ids[n] = id;
+    peers[n] = m.peer;
+    tags[n] = m.tag;
+    lens[n] = (long long)(m.cma_slot >= 0 ? m.cma_total : m.data.len);
+    ++n;
+  }
+  return n;
+}
+
+// Tune the bounded-spin budget shm_wait_recv burns before parking on
+// the futex (see Ctx::spin_ns). us < 0 leaves the default.
+void shm_set_spin(void* ctx, long long us) {
+  if (us < 0) return;
+  static_cast<Ctx*>(ctx)->spin_ns.store(us * 1000,
+                                        std::memory_order_relaxed);
 }
 
 // Deliver msgid into buf. For a pending CMA message this IS the single
@@ -1239,6 +1328,18 @@ long long shm_wait_recv(void* ctx, int timeout_ms, int* peer,
   // or not), and under a busy doorbell the nominal accounting would
   // expire the call long before timeout_ms real time elapsed.
   int64_t deadline = now_ns() + int64_t(timeout_ms) * 1000000;
+  // Phase 1 — bounded yield-spin: cheap when the message is imminent
+  // (the common ping-pong case), and capped so an idle wait costs at
+  // most spin_ns of CPU before escalating to the kernel.
+  int64_t spin_end = now_ns() + c->spin_ns.load(std::memory_order_relaxed);
+  if (spin_end > deadline) spin_end = deadline;
+  for (;;) {
+    long long id = shm_poll_recv(ctx, peer, tag, len);
+    if (id) return id;
+    if (now_ns() >= spin_end) break;
+    sched_yield();
+  }
+  // Phase 2 — futex park on the doorbell.
   for (;;) {
     long long id = shm_poll_recv(ctx, peer, tag, len);
     if (id) return id;

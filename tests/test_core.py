@@ -392,7 +392,7 @@ class TestIdleHooks:
         assert len(prog.ENGINE._idle_hooks) == before
 
     def test_idle_called_only_on_zero_event_sweeps(self):
-        from ompi_tpu.core import progress as prog
+        from ompi_tpu.core import config, progress as prog
 
         calls = []
 
@@ -400,6 +400,10 @@ class TestIdleHooks:
             calls.append(budget)
             return True
 
+        # no spin phase: the first zero-event sweep must park on the
+        # hooks (default spin_us would absorb this short pump entirely)
+        spin0 = config.get("core_progress_spin_us")
+        config.set("core_progress_spin_us", 0)
         prog.register_idle(hook)
         try:
             flag = {"done": False}
@@ -423,6 +427,7 @@ class TestIdleHooks:
             assert all(b > 0 for b in calls)
         finally:
             prog.unregister_idle(hook)
+            config.set("core_progress_spin_us", spin0)
 
     def test_failing_hook_never_breaks_a_wait(self):
         from ompi_tpu.core import progress as prog
