@@ -1432,6 +1432,92 @@ def _degraded_allreduce_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def _trace_overhead_row() -> dict:
+    """Flight-recorder cost on the latency-critical lane: p50 of the
+    fastpath 64 B RTT with the recorder (python cvar + native ring)
+    enabled vs disabled, interleaved blocks so thermal/scheduler drift
+    cancels, min-of-blocks on each side. The always-on claim is
+    overhead_pct < 5."""
+    try:
+        from ompi_tpu.native import build as _build
+
+        if not _build.available():
+            return {"error": "native library unavailable"}
+        import threading
+        import uuid
+
+        from ompi_tpu.btl.sm import ShmEndpoint
+        from ompi_tpu.core import config as _config
+        from ompi_tpu.trace import recorder as _trec
+
+        warm, iters, blocks = 100, 400, 4
+        prefix = f"tr{uuid.uuid4().hex[:10]}"
+        a = ShmEndpoint(prefix, 0)
+        b = ShmEndpoint(prefix, 1)
+        a.connect(1)
+        b.connect(0)
+        try:
+            total = 2 * blocks * (warm + iters)
+            echo = threading.Thread(
+                target=b.fp_echo, args=(0, total),
+                kwargs={"timeout": 120.0}, daemon=True)
+            echo.start()
+
+            def block_p50(on: bool) -> float:
+                _config.set("trace_base_enable", on)
+                _trec.native_trace_enable(on)
+                ts = sorted(a.fp_pingpong(1, 64, warm + iters)[warm:])
+                return ts[len(ts) // 2] * 1e6
+
+            p_off, p_on = [], []
+            for _ in range(blocks):
+                p_off.append(block_p50(False))
+                p_on.append(block_p50(True))
+            echo.join(timeout=30.0)
+        finally:
+            _config.set("trace_base_enable", True)  # always-on default
+            _trec.native_trace_enable(True)
+            a.close()
+            b.close()
+        off, on = float(min(p_off)), float(min(p_on))
+        pct = (on - off) / off * 100.0
+        return {
+            "p50_off_us": round(off, 2),
+            "p50_on_us": round(on, 2),
+            "overhead_pct": round(pct, 2),
+            "blocks": blocks,
+            "pass": pct < 5.0,
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _latency_hist_row() -> dict:
+    """The histogram pvar class feeding percentile rows: time
+    recorder.emit itself into an SPC histogram and snapshot it (plus
+    any coll/pml histograms populated earlier in the run)."""
+    try:
+        from ompi_tpu.core.counters import SPC
+        from ompi_tpu.trace import recorder as _trec
+
+        n = 20000
+        for _ in range(n):
+            t0 = time.perf_counter_ns()
+            _trec.emit("i", "bench.emit", cat="bench")
+            SPC.record_latency(
+                "trace_emit", (time.perf_counter_ns() - t0) * 1e-9)
+        snaps = SPC.histogram_snapshots()
+        emit = snaps.get("trace_emit", {})
+        return {
+            "emit_p50_ns": round(emit.get("p50", 0.0) * 1e9),
+            "emit_p99_ns": round(emit.get("p99", 0.0) * 1e9),
+            "samples": emit.get("count", 0),
+            "histograms": snaps,
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _HOST_ROWS_CACHE: dict = {}
 
 
@@ -1484,6 +1570,10 @@ def _host_rows() -> dict:
     rows["degraded_allreduce"] = _degraded_allreduce_row()
     _set_phase("fault drill (inject -> detect -> respawn -> resume)")
     rows["fault_drill"] = _fault_drill_row()
+    _set_phase("trace overhead (recorder on/off, fp 64B RTT)")
+    rows["trace_overhead"] = _trace_overhead_row()
+    _set_phase("latency histograms (pvar percentile snapshots)")
+    rows["latency_histograms"] = _latency_hist_row()
     return rows
 
 
@@ -1725,6 +1815,15 @@ def _watchdog(seconds: float, metric: str):
     import threading
 
     def fire():
+        # Post-mortem flight-recorder dump first: the wedged process is
+        # about to be hard-killed, and the ring buffer is the only
+        # record of what the comm stack was doing when it stuck.
+        try:
+            from ompi_tpu.trace import dump_post_mortem
+
+            dump_post_mortem("watchdog")
+        except BaseException:
+            pass
         # Exception-proof: this is the line of last resort — if the
         # emit itself fails (e.g. a non-serializable partial value),
         # the exit must still happen, with a minimal fallback line.

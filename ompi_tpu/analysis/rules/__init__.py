@@ -265,5 +265,6 @@ def ensure_rules() -> None:
         from . import quantuse  # noqa: F401
         from . import requests  # noqa: F401
         from . import tags  # noqa: F401
+        from . import tracespan  # noqa: F401
 
         _registered = True

@@ -85,6 +85,9 @@ def init(
         from .analysis import sanitizer as _sanitizer
 
         _sanitizer.maybe_enable()
+        from . import trace as _trace
+
+        _trace.at_init(comm_world)
         from .hook import run_hooks
 
         run_hooks("at_init_bottom", comm_world)
@@ -118,6 +121,12 @@ def finalize() -> None:
             from .monitoring.monitoring import maybe_dump_at_finalize
 
             maybe_dump_at_finalize()
+        except ImportError:
+            pass
+        try:
+            from . import trace as _trace
+
+            _trace.at_finalize(_state.comm_world)
         except ImportError:
             pass
         try:

@@ -428,6 +428,10 @@ class DcnEndpoint:
             except DcnError:
                 pass
             SPC.record("dcn_restripes")
+            from ..trace import span as tspan
+
+            tspan.instant("dcn.restripe", cat="btl", peer=peer,
+                          lost=seen - live, survivors=live)
             logger.warning(
                 "dcn peer %d: %d link(s) down, re-striped over %d "
                 "survivor(s)", peer, seen - live, live,

@@ -132,6 +132,12 @@ class Bml:
                 from ..ft import inject
 
                 btl = inject.maybe_wrap_sm(btl)
+            # once per pair: record which wire won the reachability
+            # race (the hook_comm_method story, now on the timeline)
+            from ..trace import span as tspan
+
+            tspan.instant("btl.select", cat="btl", src=src_rank,
+                          dst=dst_rank, btl=btl.NAME)
             self._cache[key] = btl
         return btl
 

@@ -151,6 +151,10 @@ def is_open(op: str, algo: str) -> bool:
         if not t.probing:
             t.probing = True
             SPC.record("coll_breaker_reprobes")
+            from ..trace import span as tspan
+
+            tspan.instant("breaker.reprobe", cat="coll", op=op,
+                          algo=algo)
             logger.info("breaker %s/%s: half-open re-probe", op, algo)
             return False
         return True
@@ -165,6 +169,10 @@ def record_failure(op: str, algo: str) -> None:
         if t.state == HALF_OPEN or t.failures >= _threshold.value:
             if t.state != OPEN:
                 SPC.record("coll_breaker_trips")
+                from ..trace import span as tspan
+
+                tspan.instant("breaker.trip", cat="coll", op=op,
+                              algo=algo, failures=t.failures)
                 logger.warning(
                     "breaker %s/%s: OPEN after %d failure(s); "
                     "degrading to %r for %d ms", op, algo, t.failures,
@@ -215,6 +223,10 @@ def route(op: str, algo: str, *, deny: tuple = ()) -> str:
         SPC.record("coll_tier_fallbacks")
         algo = nxt
     if seen:
+        from ..trace import span as tspan
+
+        tspan.instant("breaker.fallback", cat="coll", op=op,
+                      routed=seen, algo=algo)
         logger.info("breaker: %s routed %s -> %s", op,
                     " -> ".join(seen), algo)
     return algo

@@ -97,7 +97,13 @@ def select_for_comm(comm) -> dict[str, tuple[Any, Callable]]:
     # fault plan is armed, every vtable entry consults it on dispatch.
     from ..ft import inject
 
-    return inject.maybe_wrap_coll(table)
+    table = inject.maybe_wrap_coll(table)
+    # commtrace wraps outermost: every dispatch runs under a span whose
+    # trace_id all ranks derive identically (trace/span.py). The
+    # component half of each entry stays unwrapped.
+    from ..trace import span as tspan
+
+    return tspan.maybe_wrap_coll(table)
 
 
 # ---------------------------------------------------------------------------
@@ -272,8 +278,14 @@ class PersistentColl(Request):
         if self._dispatch is None:
             self._resolve()
         from ..core.counters import SPC
+        from ..trace import span as tspan
 
         SPC.record(self._spc_name)
+        # pure-dispatch iterations stay off the span path (the pcollreq
+        # latency promise); one instant record marks each start so the
+        # timeline still shows persistent traffic.
+        tspan.instant("coll.persistent_start", cat="coll",
+                      op=self._opname, cid=self._comm.cid)
         self._pending = self._dispatch(self.buffer)
 
     def _poll(self) -> bool:

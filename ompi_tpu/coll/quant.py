@@ -157,6 +157,10 @@ def record_wire_stats(logical_bytes: int, itemsize: int,
     SPC.record("coll_quant_bytes_logical", logical_bytes)
     SPC.counter("coll_quant_compression_ratio").set(
         logical_bytes / max(1, wb))
+    from ..trace import span as tspan
+
+    tspan.instant("quant.wire", cat="coll", logical=logical_bytes,
+                  wire=wb, ratio=round(logical_bytes / max(1, wb), 3))
 
 
 # ---------------------------------------------------------------------------

@@ -263,6 +263,10 @@ class ShmSlice(FabricSlice):
             finally:
                 self.engine.shm.fp_release(token)
             SPC.record("coll_sm_slab_folds")
+            from ..trace import span as tspan
+
+            tspan.instant("smcoll.fold", cat="coll", src=src_slice,
+                          tag=tag, nbytes=acc.nbytes)
             return out
         incoming = np.frombuffer(payload, acc.dtype).reshape(acc.shape)
         return _fold(acc, incoming, op)

@@ -636,6 +636,12 @@ def allreduce_by_decision(x: jax.Array, axis_name: str, op,
     from ..core.counters import SPC
 
     SPC.record(f"coll_allreduce_algo_{algo}")
+    # commtrace: one instant per decision shows *which* tier the tuned
+    # table (plus breaker routing) actually picked on the timeline.
+    from ..trace import span as tspan
+
+    tspan.instant("tuned.tier", cat="coll", op="allreduce",
+                  algo=algo, nbytes=nbytes)
     if is_quant_algo(algo):
         from . import quant
 
@@ -710,6 +716,11 @@ class TunedColl(XlaColl):
         from ..core.counters import SPC
 
         SPC.record(f"coll_allreduce_algo_{algo}")
+        from ..trace import span as tspan
+
+        tspan.instant("tuned.tier", cat="coll", op="allreduce",
+                      algo=algo, nbytes=nbytes,
+                      denied=list(deny) if deny else None)
         return algo, compile_plan(comm, key, per_rank,
                                   check_vma=not is_pallas_algo(algo))
 

@@ -40,9 +40,87 @@ class Counter:
         return self.value
 
 
+class Histogram:
+    """Log-bucketed latency histogram — a new pvar class alongside the
+    counter/watermark/timer classes (the reference's MPI_T pvar classes,
+    mca_base_pvar.h). Bucket ``b`` counts samples whose duration in
+    nanoseconds falls in ``[2^b, 2^(b+1))``, so 64 buckets span 1 ns to
+    ~584 years with ~2x resolution — enough to read p50/p99 off a
+    latency distribution without storing samples. Percentiles
+    interpolate linearly inside the winning bucket."""
+
+    __slots__ = ("name", "description", "unit", "counts", "count",
+                 "total", "min", "max", "_lock")
+
+    NBUCKETS = 64
+
+    def __init__(self, name: str, description: str = "",
+                 unit: str = "seconds"):
+        self.name = name
+        self.description = description
+        self.unit = unit
+        self.counts = [0] * self.NBUCKETS
+        self.count = 0
+        self.total = 0.0
+        self.min = float("inf")
+        self.max = 0.0
+        self._lock = threading.Lock()
+
+    def record(self, seconds: float) -> None:
+        self.record_ns(int(seconds * 1e9))
+
+    def record_ns(self, ns: int) -> None:
+        if ns < 1:
+            ns = 1
+        b = ns.bit_length() - 1
+        if b >= self.NBUCKETS:
+            b = self.NBUCKETS - 1
+        s = ns * 1e-9
+        with self._lock:
+            self.counts[b] += 1
+            self.count += 1
+            self.total += s
+            if s < self.min:
+                self.min = s
+            if s > self.max:
+                self.max = s
+
+    def percentile(self, q: float) -> float:
+        """Approximate q-quantile in seconds (0 when empty)."""
+        with self._lock:
+            if self.count == 0:
+                return 0.0
+            target = q * self.count
+            seen = 0.0
+            for b, n in enumerate(self.counts):
+                if n == 0:
+                    continue
+                if seen + n >= target:
+                    frac = (target - seen) / n
+                    lo = float(1 << b)
+                    return (lo + frac * lo) * 1e-9  # within [2^b, 2^(b+1))
+                seen += n
+            return self.max
+
+    def snapshot(self) -> dict[str, float]:
+        p50 = self.percentile(0.50)
+        p99 = self.percentile(0.99)
+        with self._lock:
+            n = self.count
+            return {
+                "count": n,
+                "mean": self.total / n if n else 0.0,
+                "min": self.min if n else 0.0,
+                "max": self.max,
+                "p50": p50,
+                "p99": p99,
+            }
+
+
 class CounterRegistry:
     def __init__(self) -> None:
         self._counters: dict[str, Counter] = {}
+        self._histograms: dict[str, Histogram] = {}
         self._lock = threading.Lock()
         self.enabled = True
 
@@ -93,6 +171,31 @@ class CounterRegistry:
                 time.perf_counter() - t0
             )
 
+    def histogram(
+        self, name: str, description: str = "", unit: str = "seconds"
+    ) -> Histogram:
+        with self._lock:
+            h = self._histograms.get(name)
+            if h is None:
+                h = Histogram(name, description, unit)
+                self._histograms[name] = h
+            return h
+
+    def record_latency(self, name: str, seconds: float) -> None:
+        """Histogram-class pvar record; same lock-dodging fast path as
+        record() for the already-registered case."""
+        if self.enabled:
+            h = self._histograms.get(name)
+            if h is None:
+                h = self.histogram(name)
+            h.record(seconds)
+
+    def histogram_snapshots(self) -> dict[str, dict[str, float]]:
+        with self._lock:
+            hists = list(self._histograms.values())
+        return {h.name: h.snapshot() for h in sorted(hists,
+                                                     key=lambda h: h.name)}
+
     def snapshot(self) -> dict[str, float]:
         with self._lock:
             return {n: c.value for n, c in self._counters.items()}
@@ -112,6 +215,7 @@ class CounterRegistry:
     def reset_for_testing(self) -> None:
         with self._lock:
             self._counters.clear()
+            self._histograms.clear()
 
 
 SPC = CounterRegistry()

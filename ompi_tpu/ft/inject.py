@@ -290,6 +290,15 @@ class FaultPlan:
                     f"op={op} peer={peer} tag={tag} occ={spec.seen}"
                 )
                 SPC.record("faultline_fired")
+                # commtrace: every injected fault is tagged on the
+                # timeline so drill traces distinguish injected from
+                # organic failures (injected=True is the contract the
+                # drill suite asserts).
+                from ..trace import span as tspan
+
+                tspan.instant(f"fault.{spec.action}", cat="fault",
+                              injected=True, layer=layer, op=op,
+                              peer=peer, tag=tag, occ=spec.seen)
                 logger.warning("faultline: %s fired (op=%s peer=%s "
                                "tag=%s occ=%d)", spec.describe(), op,
                                peer, tag, spec.seen)

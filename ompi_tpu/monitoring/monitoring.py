@@ -40,17 +40,25 @@ def maybe_dump_at_finalize() -> None:
         import json
 
         payload = MONITOR.flush()
-        from ..core.counters import SPC
-
         sanitizer = {
             k: v for k, v in SPC.snapshot().items()
             if k.startswith("sanitizer_")
         }
         if sanitizer:
             payload["sanitizer"] = sanitizer
-        print(
-            "ompi_tpu monitoring summary:\n"
-            + json.dumps(payload, indent=2)
+        hists = SPC.histogram_snapshots()
+        if hists:
+            payload["latency_histograms"] = hists
+        # Through core/logging's user-facing channel (not a bare
+        # print): the dump lands on the same stream as the rest of the
+        # run's diagnostics, banner-framed like every other
+        # user-requested report.
+        from ..core.logging import show_help
+
+        show_help(
+            "monitoring summary",
+            "%s", json.dumps(payload, indent=2),
+            once=False,
         )
 
 

@@ -122,6 +122,7 @@ def get_lib() -> Optional[ctypes.CDLL]:
         _declare_dcn(lib)
         _declare_pool(lib)
         _declare_fp(lib)
+        _declare_trace(lib)
         _lib = lib
         return _lib
 
@@ -273,6 +274,24 @@ def _declare_fp(lib: ctypes.CDLL) -> None:
     lib.shm_send_many.argtypes = [
         P, ctypes.c_int, LL, LLP, LLP, ctypes.c_char_p,
     ]
+
+
+def _declare_trace(lib: ctypes.CDLL) -> None:
+    """tracering.cc: the native half of the commtrace flight recorder."""
+    LL = ctypes.c_longlong
+    lib.ompi_tpu_trace_emit.restype = None
+    lib.ompi_tpu_trace_emit.argtypes = [ctypes.c_int, ctypes.c_int,
+                                        LL, LL]
+    lib.nt_trace_enable.restype = None
+    lib.nt_trace_enable.argtypes = [ctypes.c_int]
+    lib.nt_trace_count.restype = LL
+    lib.nt_trace_count.argtypes = []
+    lib.nt_trace_capacity.restype = LL
+    lib.nt_trace_capacity.argtypes = []
+    lib.nt_trace_dump.restype = LL
+    lib.nt_trace_dump.argtypes = [ctypes.c_void_p, LL]
+    lib.nt_trace_reset.restype = None
+    lib.nt_trace_reset.argtypes = []
 
 
 def available() -> bool:

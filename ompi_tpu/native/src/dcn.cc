@@ -47,7 +47,16 @@
 #include <unordered_map>
 #include <vector>
 
+// commtrace native flight recorder (tracering.cc): link drops and
+// frame re-stripes are recorded without crossing into Python. Kind
+// ids mirror trace/recorder.py NATIVE_KINDS.
+extern "C" void ompi_tpu_trace_emit(int kind, int a, long long b,
+                                    long long c);
+
 namespace {
+
+constexpr int kTraceDcnRestripe = 7;
+constexpr int kTraceDcnLinkDrop = 8;
 
 constexpr uint32_t kMagic = 0x7470756d;  // "mput"
 constexpr int64_t kFragBytes = 128 * 1024;  // reference max_send 128K
@@ -565,7 +574,13 @@ void drop_link(Ctx* c, int fd) {
       pit->second.credit.clear();
     }
     c->links.erase(it);
+    ompi_tpu_trace_emit(kTraceDcnLinkDrop, peer, fd,
+                        (long long)salvage.size());
     if (pit != c->peers.end() && !pit->second.link_fds.empty()) {
+      if (!salvage.empty())
+        ompi_tpu_trace_emit(kTraceDcnRestripe, peer,
+                            (long long)salvage.size(),
+                            (long long)pit->second.link_fds.size());
       for (auto& f : salvage) {
         f.sent = 0;
         c->restriped_frames++;

@@ -59,7 +59,18 @@
 #include <time.h>
 #include <unistd.h>
 
+// commtrace native flight recorder (tracering.cc): rare-event records
+// — parks, spills, drops — land in the process-global ring without
+// crossing into Python. Kind ids: trace/recorder.py NATIVE_KINDS.
+extern "C" void ompi_tpu_trace_emit(int kind, int a, long long b,
+                                    long long c);
+
 namespace {
+
+constexpr int kTraceFpFutexPark = 1;
+constexpr int kTraceFpRingFull = 2;
+constexpr int kTraceFpSlabSpill = 3;
+constexpr int kTraceFpCrcDrop = 4;
 
 constexpr uint32_t kFpMagic = 0x46506831;  // "FPh1"
 constexpr uint32_t kFpInline = 256;        // inline-payload descriptor tier
@@ -245,6 +256,7 @@ long long fp_post_locked(FpCtx* c, FpConn* p, long long tag,
   FpDesc* d = &fp_ring_descs(r)[t & (seg->entries - 1)];
   if (d->seq.load(std::memory_order_acquire) != 0) {
     c->ring_full.fetch_add(1, std::memory_order_relaxed);
+    ompi_tpu_trace_emit(kTraceFpRingFull, c->my_rank, (long long)t, len);
     return -4;
   }
   if (len <= (long long)kFpInline) {
@@ -265,6 +277,8 @@ long long fp_post_locked(FpCtx* c, FpConn* p, long long tag,
     }
     if (f == kNoFrame) {
       c->slab_full.fetch_add(1, std::memory_order_relaxed);
+      ompi_tpu_trace_emit(kTraceFpSlabSpill, c->my_rank, (long long)t,
+                          len);
       return -4;
     }
     p->frame_hint = (f + 1) % seg->frames;
@@ -307,6 +321,8 @@ FpDesc* fp_await(FpCtx* c, FpRing* r, uint64_t head, int64_t timeout_us) {
     int slice = (int)(left_ms < 5 ? (left_ms > 0 ? left_ms : 1) : 5);
     r->waiters.fetch_add(1, std::memory_order_acq_rel);
     c->futex_parks.fetch_add(1, std::memory_order_relaxed);
+    ompi_tpu_trace_emit(kTraceFpFutexPark, c->my_rank,
+                        (long long)head, slice);
     fp_futex_wait(&r->bell, seen, slice);
     r->waiters.fetch_sub(1, std::memory_order_acq_rel);
     if (d->seq.load(std::memory_order_acquire) == head + 1) return d;
@@ -350,6 +366,8 @@ bool fp_validate(FpCtx* c, FpRing* r, FpDesc* d, uint64_t head) {
   d->seq.store(0, std::memory_order_release);
   r->head.store(head + 1, std::memory_order_relaxed);
   c->crc_drops.fetch_add(1, std::memory_order_relaxed);
+  ompi_tpu_trace_emit(kTraceFpCrcDrop, c->my_rank,
+                      (long long)(head + 1), (long long)d->tag);
   return false;
 }
 

@@ -73,7 +73,16 @@
 #include <time.h>
 #include <unistd.h>
 
+// commtrace native flight recorder (tracering.cc): doorbell/drain
+// parks are recorded without crossing into Python. Kind ids mirror
+// trace/recorder.py NATIVE_KINDS.
+extern "C" void ompi_tpu_trace_emit(int kind, int a, long long b,
+                                    long long c);
+
 namespace {
+
+constexpr int kTraceShmDoorbellPark = 5;
+constexpr int kTraceShmDrainPark = 6;
 
 constexpr uint32_t kMagic = 0x534D5470;  // "SMTp"
 constexpr uint32_t kVersion = 2;
@@ -724,6 +733,7 @@ bool push_progress(Ctx* c, PeerConn* p, RingHdr* r, uint64_t tag,
       // park until the consumer advances a head (5 ms cap keeps this
       // robust against a consumer that exits without draining)
       p->seg->drain_waiters.fetch_add(1, std::memory_order_acq_rel);
+      ompi_tpu_trace_emit(kTraceShmDrainPark, c->my_rank, seen, 5);
       futex_wait(&p->seg->drain_bell, seen, 5);
       p->seg->drain_waiters.fetch_sub(1, std::memory_order_acq_rel);
     }
@@ -1352,6 +1362,7 @@ long long shm_wait_recv(void* ctx, int timeout_ms, int* peer,
     if (id) return id;
     int slice = (int)std::min<int64_t>(left_ms, 100);
     c->seg->doorbell_waiters.fetch_add(1, std::memory_order_acq_rel);
+    ompi_tpu_trace_emit(kTraceShmDoorbellPark, c->my_rank, seen, slice);
     futex_wait(&c->seg->doorbell, seen, slice);
     c->seg->doorbell_waiters.fetch_sub(1, std::memory_order_acq_rel);
   }

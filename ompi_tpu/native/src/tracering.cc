@@ -1,0 +1,90 @@
+// tracering — the native half of the commtrace flight recorder.
+//
+// C++-side rare events (doorbell futex parks, slab/ring spills, CRC
+// drops, DCN link drops and frame re-stripes) are recorded here
+// without crossing into Python: the transports call
+// ompi_tpu_trace_emit() directly, so a wedged or signal-killed
+// process still carries the last kCap transport events in this ring
+// for the Python side to drain post-mortem.
+//
+// Design mirrors the Python ring (trace/recorder.py): a process-global
+// fixed array of fixed-size 32-byte records, one atomic fetch_add on a
+// 64-bit sequence picks the slot, writers never block. Slot writes are
+// not made atomic as a unit — a reader racing a lapped writer can see
+// a torn record, which is acceptable for a flight recorder and keeps
+// the emit path to a clock read plus four plain stores. Timestamps use
+// CLOCK_MONOTONIC, the same clock Python's perf_counter_ns() reads on
+// Linux, so native and Python events merge on one time axis.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <ctime>
+
+namespace {
+
+struct NtRec {
+  long long t_ns;
+  int kind;
+  int a;
+  long long b;
+  long long c;
+};
+
+constexpr long long kCap = 16384;  // power of two: slot = seq & (kCap-1)
+NtRec g_ring[kCap];
+std::atomic<long long> g_seq{0};
+std::atomic<int> g_on{1};
+
+inline long long now_ns() {
+  struct timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return (long long)ts.tv_sec * 1000000000LL + ts.tv_nsec;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Internal emit: called from fastpath.cc / shm.cc / dcn.cc. Kind ids
+// are mirrored by trace/recorder.py NATIVE_KINDS.
+void ompi_tpu_trace_emit(int kind, int a, long long b, long long c) {
+  if (!g_on.load(std::memory_order_relaxed)) return;
+  long long seq = g_seq.fetch_add(1, std::memory_order_relaxed);
+  NtRec* r = &g_ring[seq & (kCap - 1)];
+  r->t_ns = now_ns();
+  r->kind = kind;
+  r->a = a;
+  r->b = b;
+  r->c = c;
+}
+
+void nt_trace_enable(int on) {
+  g_on.store(on ? 1 : 0, std::memory_order_relaxed);
+}
+
+long long nt_trace_count() {
+  return g_seq.load(std::memory_order_relaxed);
+}
+
+long long nt_trace_capacity() { return kCap; }
+
+// Copy the retained records, oldest first, into out (an array of at
+// least max records). Non-destructive. Returns the number copied.
+long long nt_trace_dump(void* out, long long max) {
+  long long seq = g_seq.load(std::memory_order_acquire);
+  long long n = seq < kCap ? seq : kCap;
+  if (n > max) n = max;
+  NtRec* dst = reinterpret_cast<NtRec*>(out);
+  long long first = seq - n;  // oldest retained seq
+  for (long long i = 0; i < n; ++i)
+    dst[i] = g_ring[(first + i) & (kCap - 1)];
+  return n;
+}
+
+void nt_trace_reset() {
+  g_seq.store(0, std::memory_order_relaxed);
+  std::memset(g_ring, 0, sizeof(g_ring));
+}
+
+}  // extern "C"

@@ -49,6 +49,12 @@ def select_for_comm(comm) -> PmlComponent:
         from ..analysis import sanitizer
 
         _selected = sanitizer.maybe_wrap_pml(_selected)
+        # commtrace spans wrap above the sanitizer: the recorded p2p
+        # span covers the call as the application issued it, sanitizer
+        # accounting included. Gated per-dispatch on the trace cvar.
+        from ..trace import span as tspan
+
+        _selected = tspan.maybe_wrap_pml(_selected)
     return _selected
 
 

@@ -1,0 +1,92 @@
+"""trace — merge per-rank commtrace dumps into one Perfetto timeline.
+
+Offline counterpart of the finalize-time modex gather (trace/__init__
+``at_finalize``): each rank leaves ``ompi_tpu-trace-rank<r>.json`` in
+``trace_base_dir``; this tool loads any number of them, aligns their
+clocks with the mpisync offsets stamped in each dump, and writes one
+Chrome/Perfetto trace_event JSON. Open the result at ui.perfetto.dev
+(or chrome://tracing). ``--timeline`` additionally prints the
+per-collective cross-rank text timeline on stdout.
+
+Usage::
+
+    python -m ompi_tpu.tools.trace rank0.json rank1.json -o merged.json
+    python -m ompi_tpu.tools.trace --dir /tmp/traces --timeline
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+from ..trace import export
+
+
+def load_dump(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if d.get("format") != "ompi_tpu-trace-v1":
+        raise SystemExit(f"{path}: not an ompi_tpu trace dump "
+                         f"(format={d.get('format')!r})")
+    return d
+
+
+def find_dumps(directory: str) -> list[str]:
+    pats = (os.path.join(directory, "ompi_tpu-trace-rank*.json"),)
+    found: list[str] = []
+    for pat in pats:
+        found.extend(sorted(glob.glob(pat)))
+    return found
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.trace",
+        description="Merge per-rank commtrace dumps into one "
+        "Perfetto trace_event JSON.",
+    )
+    ap.add_argument("dumps", nargs="*",
+                    help="per-rank dump files (ompi_tpu-trace-rank*.json)")
+    ap.add_argument("--dir", default=None,
+                    help="scan a directory for rank dumps")
+    ap.add_argument("-o", "--output", default="trace-merged.json",
+                    help="merged Perfetto JSON path "
+                    "(default: %(default)s)")
+    ap.add_argument("--no-align", action="store_true",
+                    help="skip mpisync clock alignment (raw per-rank "
+                    "monotonic clocks)")
+    ap.add_argument("--timeline", action="store_true",
+                    help="also print the per-collective cross-rank "
+                    "timeline")
+    args = ap.parse_args(argv)
+
+    paths = list(args.dumps)
+    if args.dir:
+        paths.extend(find_dumps(args.dir))
+    if not paths:
+        ap.error("no dump files given (pass paths or --dir)")
+    # de-dup while keeping order (a path may be both explicit and
+    # found by --dir)
+    seen: set[str] = set()
+    paths = [p for p in paths if not (p in seen or seen.add(p))]
+
+    dumps = [load_dump(p) for p in paths]
+    align = not args.no_align
+    merged = export.perfetto(dumps, align=align)
+    with open(args.output, "w") as f:
+        json.dump(merged, f)
+    ranks = sorted(d.get("rank", 0) for d in dumps)
+    print(f"merged {len(dumps)} rank dump(s) (ranks {ranks}) -> "
+          f"{args.output}: {len(merged['traceEvents'])} events")
+    if args.timeline:
+        print("per-collective timeline:")
+        for line in export.timeline(dumps, align=align).splitlines():
+            print(" ", line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,129 @@
+"""commtrace — always-on flight recorder, span tracing, Perfetto export.
+
+Public surface:
+
+- ``span.span(name, ...)`` / ``instant(name, ...)`` — emit events into
+  the per-process flight recorder (trace/recorder.py). The ``span``
+  attribute of this package is the *submodule* (so the selection seams
+  can ``from ..trace import span as tspan``); the context-manager
+  helper lives at ``trace.span.span``.
+- ``enabled()`` — the ``trace_base_enable`` gate (default on).
+- ``dump_post_mortem()`` — write this process's buffer now (also wired
+  to SIG<trace_base_signal> and the bench watchdog).
+- ``at_init(comm_world)`` / ``at_finalize(comm_world)`` — lifecycle
+  hooks called from api.init/api.finalize: arm the signal handler,
+  then at finalize dump per-rank files and optionally gather every
+  rank's buffer over the modex so rank 0 writes one merged Perfetto
+  trace (``trace_base_gather``).
+- ``python -m ompi_tpu.tools.trace`` merges rank dumps offline.
+
+DESIGN.md §16 documents the architecture, the span-ID ↔ tag-namespace
+mapping, and the clock-alignment scheme.
+"""
+
+from __future__ import annotations
+
+from ..core.logging import get_logger
+from . import export, recorder
+from .recorder import (  # noqa: F401 - re-exported API
+    dump_post_mortem,
+    enabled,
+    install_signal_handler,
+    process_rank,
+    set_clock_offset,
+    set_rank,
+)
+from .span import (  # noqa: F401 - re-exported API
+    Span,
+    coll_trace_id,
+    current,
+    instant,
+)
+from . import span as _span_mod
+
+# `trace.span` must stay the submodule, not the context-manager helper:
+# every selection seam does `from ..trace import span as tspan`.
+span = _span_mod
+
+logger = get_logger("trace")
+
+
+def at_init(comm_world=None) -> None:
+    """api.init hook: pin the rank label and arm the post-mortem
+    signal. Never raises — tracing must not break init."""
+    try:
+        import os
+
+        if "OMPI_TPU_TRACE_RANK" not in os.environ:
+            # the env override exists for emulated multi-rank runs
+            # (every controller reports process_index 0); an explicit
+            # rank wins over jax's view
+            try:
+                import jax
+
+                recorder.set_rank(int(jax.process_index()))
+            except Exception:  # commlint: allow(broadexcept)
+                pass  # single-controller / no jax: default rank stands
+        install_signal_handler()
+    except Exception:  # commlint: allow(broadexcept)
+        logger.exception("trace: init hook failed")
+
+
+def _process_count() -> int:
+    try:
+        import jax
+
+        return int(jax.process_count())
+    except Exception:  # commlint: allow(broadexcept)
+        return 1
+
+
+def at_finalize(comm_world=None) -> None:
+    """api.finalize hook: per-rank dump file (``trace_base_dir``) and
+    the optional modex gather + merged Perfetto write on rank 0.
+    Never raises — a trace failure must not turn finalize red."""
+    if not recorder.enabled():
+        return
+    try:
+        import os
+
+        d = recorder._dir.value
+        rank = recorder.process_rank()
+        nproc = _process_count()
+        if recorder._gather.value and nproc > 1:
+            _gather_and_merge(rank, nproc, d)
+        if d:
+            export.write_rank_dump(
+                os.path.join(d, f"ompi_tpu-trace-rank{rank}.json"),
+                reason="finalize",
+            )
+    except Exception:  # commlint: allow(broadexcept)
+        logger.exception("trace: finalize dump failed")
+
+
+def _gather_and_merge(rank: int, nproc: int, d: str) -> None:
+    """Every rank publishes its buffer over the modex; rank 0 collects
+    and writes the merged Perfetto JSON (clock-aligned via the
+    offsets stamped in each dump)."""
+    import json
+    import os
+
+    from ..runtime import modex
+
+    modex.put(f"trace/{rank}", export.dump_to_blob())
+    if rank != 0:
+        return
+    dumps = []
+    for r in range(nproc):
+        try:
+            dumps.append(export.blob_to_dump(
+                modex.get(f"trace/{r}", timeout_s=15.0)))
+        except Exception:  # commlint: allow(broadexcept)
+            logger.warning("trace: no buffer from rank %d", r)
+    if not dumps:
+        return
+    path = os.path.join(d or recorder.dump_dir(), "trace-merged.json")
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(export.perfetto(dumps), f)
+    logger.info("trace: merged %d rank(s) -> %s", len(dumps), path)

@@ -1,0 +1,195 @@
+"""commtrace exporters: per-rank dumps and Chrome/Perfetto JSON.
+
+A *rank dump* is this process's flight-recorder contents (Python ring +
+drained native ring) plus the clock metadata needed to merge it with
+other ranks:
+
+    {"format": "ompi_tpu-trace-v1", "rank": r, "pid": ..., "host": ...,
+     "clock": {"perf_ns": ..., "unix_ns": ..., "offset_s": ...},
+     "events": [[seq, t_ns, ph, name, cat, span, parent, tid, args],
+                ...]}
+
+``perf_ns``/``unix_ns`` are a paired sample of the monotonic and epoch
+clocks, so a monotonic record timestamp maps to epoch time as
+``unix_ns + (t_ns - perf_ns)``. ``offset_s`` is the mpisync
+(tools/mpisync) min-RTT estimate of this rank's clock offset versus
+rank 0 (remote - local); the merge subtracts it, which is exactly how
+mpigclock-style post-hoc alignment works.
+
+``perfetto()`` renders any set of rank dumps as one Chrome trace_event
+JSON object ({"traceEvents": [...]}, loadable in ui.perfetto.dev or
+chrome://tracing): pid = rank, tid = recording thread, span begin/end
+become "B"/"E" pairs, instants become "i", and every span's args carry
+the cross-rank ``trace_id``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+from typing import Any, Optional
+
+from . import recorder
+
+
+def rank_dump(reason: str = "") -> dict:
+    """This process's buffer as a merge-ready dump dict."""
+    rec = recorder.get()
+    events = [list(r) for r in rec.records()]
+    events += [list(r) for r in recorder.drain_native()]
+    out = {
+        "format": "ompi_tpu-trace-v1",
+        "rank": recorder.process_rank(),
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "clock": {
+            "perf_ns": rec.epoch_perf_ns,
+            "unix_ns": rec.epoch_unix_ns,
+            "offset_s": rec.clock_offset_s,
+        },
+        "events": events,
+    }
+    if reason:
+        out["reason"] = reason
+    return out
+
+
+def write_rank_dump(path: str, reason: str = "") -> str:
+    d = os.path.dirname(os.path.abspath(path))
+    os.makedirs(d, exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(rank_dump(reason=reason), f)
+    return path
+
+
+def dump_to_blob() -> bytes:
+    """Binary form (fixed-size records) for the modex gather path; the
+    clock metadata travels as a JSON header line."""
+    rec = recorder.get()
+    meta = json.dumps({
+        "rank": recorder.process_rank(),
+        "pid": os.getpid(),
+        "host": socket.gethostname(),
+        "clock": {
+            "perf_ns": rec.epoch_perf_ns,
+            "unix_ns": rec.epoch_unix_ns,
+            "offset_s": rec.clock_offset_s,
+        },
+    }).encode()
+    records = rec.records() + recorder.drain_native()
+    blob = recorder.FlightRecorder.encode(records)
+    return len(meta).to_bytes(4, "little") + meta + blob
+
+
+def blob_to_dump(data: bytes) -> dict:
+    n = int.from_bytes(data[:4], "little")
+    meta = json.loads(data[4:4 + n].decode())
+    records = recorder.FlightRecorder.decode(data[4 + n:])
+    meta["format"] = "ompi_tpu-trace-v1"
+    meta["events"] = [list(r) for r in records]
+    return meta
+
+
+# -- Perfetto / Chrome trace_event ------------------------------------------
+
+def _epoch_ns(dump: dict, t_ns: int, align: bool) -> int:
+    clock = dump.get("clock") or {}
+    base_unix = clock.get("unix_ns")
+    base_perf = clock.get("perf_ns")
+    if base_unix is None or base_perf is None:
+        return t_ns
+    t = base_unix + (t_ns - base_perf)
+    if align:
+        t -= int(clock.get("offset_s", 0.0) * 1e9)
+    return t
+
+
+def perfetto(dumps: list[dict], align: bool = True) -> dict:
+    """Merge rank dumps into one Chrome trace_event JSON dict."""
+    events: list[dict] = []
+    t_min: Optional[int] = None
+    per_rank: list[tuple[int, list]] = []
+    for d in dumps:
+        pid = int(d.get("rank", 0))
+        rows = []
+        for ev in d.get("events", []):
+            seq, t_ns, ph, name, cat, span, parent, tid, args = ev
+            t = _epoch_ns(d, t_ns, align)
+            if t_min is None or t < t_min:
+                t_min = t
+            rows.append((t, ph, name, cat, span, parent, tid, args))
+        per_rank.append((pid, rows))
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": f"rank{pid} ({d.get('host', '?')})"},
+        })
+    base = t_min or 0
+    for pid, rows in per_rank:
+        for (t, ph, name, cat, span, parent, tid, args) in rows:
+            e: dict[str, Any] = {
+                "name": name,
+                "cat": cat or "span",
+                "ph": ph,
+                "ts": (t - base) / 1000.0,  # trace_event ts is in us
+                "pid": pid,
+                "tid": tid,
+            }
+            a = dict(args) if args else {}
+            if span:
+                a["span"] = span
+            if parent:
+                a["parent"] = parent
+            if ph == "i":
+                e["s"] = "t"
+            if a:
+                e["args"] = a
+            events.append(e)
+    events.sort(key=lambda e: (e.get("ts", 0.0), e["pid"]))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"tool": "ompi_tpu.tools.trace",
+                      "ranks": len(dumps), "aligned": bool(align)},
+    }
+
+
+def timeline(dumps: list[dict], align: bool = True) -> str:
+    """Per-collective text timeline: one line per trace_id, with each
+    rank's begin offset and duration (the quick no-browser view)."""
+    # trace_id -> {"name": ..., rank -> (t_begin, t_end)}
+    colls: dict[int, dict] = {}
+    t0: Optional[int] = None
+    for d in dumps:
+        pid = int(d.get("rank", 0))
+        open_spans: dict[int, tuple[int, int, str]] = {}
+        for ev in d.get("events", []):
+            seq, t_ns, ph, name, cat, span, parent, tid, args = ev
+            if cat != "coll":
+                continue
+            t = _epoch_ns(d, t_ns, align)
+            if t0 is None or t < t0:
+                t0 = t
+            if ph == "B" and args:
+                open_spans[span] = (int(args.get("trace_id", 0)), t,
+                                    name)
+            elif ph == "E" and span in open_spans:
+                tid_, tb, nm = open_spans.pop(span)
+                ent = colls.setdefault(tid_, {"name": nm, "ranks": {}})
+                ent["ranks"][pid] = (tb, t)
+    if not colls:
+        return "(no collective spans)"
+    lines = []
+    for trace_id in sorted(colls):
+        ent = colls[trace_id]
+        parts = []
+        for pid in sorted(ent["ranks"]):
+            tb, te = ent["ranks"][pid]
+            parts.append(
+                f"rank{pid} +{(tb - (t0 or 0)) / 1e6:.3f}ms "
+                f"dur {(te - tb) / 1e6:.3f}ms"
+            )
+        lines.append(
+            f"0x{trace_id:x} {ent['name']:<22} " + " | ".join(parts)
+        )
+    return "\n".join(lines)

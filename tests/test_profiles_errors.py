@@ -79,9 +79,12 @@ def test_monitoring_dump_at_finalize(capsys):
         comm.rank(0).send(np.float32(1.0), dest=1, tag=1)
         comm.rank(1).recv(source=0, tag=1)
         maybe_dump_at_finalize()
-        out = capsys.readouterr().out
-        assert "monitoring summary" in out
-        assert "p2p" in out
+        # routed through core/logging's show_help channel (stderr),
+        # not a bare print on stdout
+        captured = capsys.readouterr()
+        assert "monitoring summary" in captured.err
+        assert "p2p" in captured.err
+        assert "monitoring summary" not in captured.out
     finally:
         config.set("monitoring_base_enable", False)
         config.set("monitoring_base_dump_at_finalize", False)
