@@ -260,6 +260,7 @@ def ensure_rules() -> None:
         from . import collectives  # noqa: F401
         from . import excepts  # noqa: F401
         from . import fastpath  # noqa: F401
+        from . import healthseam  # noqa: F401
         from . import lifecycle  # noqa: F401
         from . import polling  # noqa: F401
         from . import quantuse  # noqa: F401

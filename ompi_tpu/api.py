@@ -88,6 +88,9 @@ def init(
         from . import trace as _trace
 
         _trace.at_init(comm_world)
+        from . import health as _health
+
+        _health.at_init()
         from .hook import run_hooks
 
         run_hooks("at_init_bottom", comm_world)
@@ -127,6 +130,12 @@ def finalize() -> None:
             from . import trace as _trace
 
             _trace.at_finalize(_state.comm_world)
+        except ImportError:
+            pass
+        try:
+            from . import health as _health
+
+            _health.at_finalize()
         except ImportError:
             pass
         try:

@@ -575,6 +575,33 @@ class DcnEndpoint:
             pass
 
 
+def register_health_probe(endpoint, peer_ids: dict) -> None:
+    """Wire the dcn tier canary to a live endpoint: per-peer link ping
+    (heal_links re-counts live sockets; zero links to any peer is a
+    dead tier). Weakref only — a closed endpoint retires its probe
+    (health/prober contract; called at both wire-up seams)."""
+    import weakref
+
+    from ..health import prober as health_prober
+
+    ref = weakref.ref(endpoint)
+    peers = dict(peer_ids)
+
+    def _dcn_canary() -> None:
+        ep = ref()
+        if ep is None:
+            return  # endpoint retired; re-wire re-registers
+        ep.stats()  # native round trip: raises on a dead context
+        dead = [idx for idx, pid in sorted(peers.items())
+                if ep.heal_links(pid) <= 0]
+        if dead:
+            raise RuntimeError(f"dcn peer(s) linkless: {dead}")
+
+    health_prober.register_probe(
+        "dcn", _dcn_canary,
+        description="per-link peer ping (heal_links live-socket count)")
+
+
 @BTL.register
 class DcnBtl(BtlComponent):
     """BML-pluggable DCN transport: array payloads stage host-side,
@@ -689,6 +716,8 @@ class DcnBtl(BtlComponent):
             self._peer_ids[idx] = ep.connect(
                 best_ip, port, cookie=my_index + 1
             )
+        if self._peer_ids:
+            register_health_probe(self._endpoint, self._peer_ids)
 
     def transfer(self, value, src_proc, dst_proc):
         # Cross-process delivery needs the full MPI envelope + matching

@@ -1095,6 +1095,45 @@ def engine_available() -> bool:
     return lib is not None and hasattr(lib, "shm_create")
 
 
+def register_health_probes(shm, peers) -> None:
+    """Wire the shm + fastpath tier canaries to a live endpoint (the
+    health/prober registration contract — called from fabric wire-up,
+    the same selection seam the fault wrappers interpose at). The
+    canaries hold only a weakref: a torn-down endpoint quietly retires
+    its probes instead of keeping the segment mapped."""
+    import weakref
+
+    from ..health import prober as health_prober
+
+    ref = weakref.ref(shm)
+    peer_list = sorted(peers)
+
+    def _shm_canary() -> None:
+        ep = ref()
+        if ep is None:
+            return  # endpoint retired; re-wire re-registers
+        ep.stats()  # segment round trip: raises on a torn mapping
+        dead = [p for p in peer_list if not ep.peer_alive(p)]
+        if dead:
+            raise RuntimeError(f"shm peer(s) dead: {dead}")
+
+    def _fp_canary() -> None:
+        ep = ref()
+        if ep is None:
+            return
+        if not ep.fp_available():
+            raise RuntimeError("fastpath lane lost")
+        ep.fp_stats()  # ring walk: raises when the fp segment is torn
+
+    health_prober.register_probe(
+        "shm", _shm_canary,
+        description="shm v2 segment stat + peer liveness")
+    if shm.fp_available():
+        health_prober.register_probe(
+            "fastpath", _fp_canary,
+            description="fp lane availability + ring stats")
+
+
 def host_identity() -> dict:
     """Same-machine identity for the modex business card: hostname can
     collide across containers, so pair it with the kernel boot id."""

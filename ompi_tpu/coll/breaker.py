@@ -208,14 +208,59 @@ def next_tier(algo: str) -> Optional[str]:
     return NEXT_TIER.get(algo, TERMINAL)
 
 
-def route(op: str, algo: str, *, deny: tuple = ()) -> str:
-    """Walk the degradation chain past OPEN/denied tiers. Records the
+def on_tier_restored(tier: str) -> None:
+    """health-ledger restore hook: the transport tier is HEALTHY
+    again, so close every (op, algo) breaker riding it — the next
+    dispatch goes straight back to the fast tier instead of waiting
+    out each breaker's own cooldown."""
+    global _generation
+    if not _tiers:
+        return
+    from ..health.ledger import tier_of_algo
+
+    with _mu:
+        closed = []
+        for (op, algo), t in _tiers.items():
+            if t.state != CLOSED and tier_of_algo(algo) == tier:
+                t.state = CLOSED
+                t.failures = 0
+                t.probing = False
+                closed.append((op, algo))
+        if closed:
+            _generation += 1
+    for op, algo in closed:
+        logger.info("breaker %s/%s: closed by tier %r restore", op,
+                    algo, tier)
+
+
+def _health_denied(algo: str, scope: Optional[str]) -> bool:
+    """True when the algorithm's transport tier is QUARANTINED in the
+    health ledger (comm scope or global). Checked lock-free first so
+    the fully-healthy hot path costs two attribute loads."""
+    from ..health import ledger as _hl
+
+    if _hl.LEDGER.quiet():
+        return False
+    return _hl.LEDGER.is_denied(_hl.tier_of_algo(algo), scope)
+
+
+def route(op: str, algo: str, *, deny: tuple = (),
+          scope: Optional[str] = None) -> str:
+    """Walk the degradation chain past OPEN/denied/quarantined tiers.
+    ``scope`` is the calling communicator's health scope (its cid);
+    the health ledger's QUARANTINED verdict denies the whole transport
+    tier, on top of the per-(op, algo) breaker state. Records the
     ``coll_tier_fallbacks`` SPC per step so monitoring sees routed
     degradation, not just dispatch-time retries."""
-    if not _enable.value or (not _tiers and not deny):
+    if not _enable.value:
+        return algo
+    from ..health import ledger as _hl
+
+    if not _tiers and not deny and _hl.LEDGER.quiet():
         return algo
     seen = []
-    while algo in deny or is_open(op, algo):
+    while algo in deny or is_open(op, algo) \
+            or _health_denied(algo, scope):
         seen.append(algo)
         nxt = next_tier(algo)
         if nxt is None or nxt in seen:

@@ -679,7 +679,8 @@ class TunedColl(XlaColl):
         )
         from . import breaker
 
-        algo = breaker.route("allreduce", algo, deny=deny)
+        algo = breaker.route("allreduce", algo, deny=deny,
+                             scope=str(comm.cid))
         if is_pallas_algo(algo):
             _pallas_algos()
         if is_quant_algo(algo):
@@ -749,15 +750,18 @@ class TunedColl(XlaColl):
 
         if inject.armed():
             return None  # every drill must see the real dispatch
+        from ..health import ledger as health
         from . import breaker
 
-        stamp = (config.generation(), breaker.generation())
+        stamp = (config.generation(), breaker.generation(),
+                 health.LEDGER.generation())
         cache = comm.__dict__.setdefault("_tuned_fast", {})
         key = (x.shape, x.dtype.name, op.cache_key)
         ent = cache.get(key)
         if ent is None or ent[0] != stamp:
-            if not breaker.quiet():
-                return None  # lazy OPEN->HALF_OPEN needs live routing
+            if not breaker.quiet() or not health.LEDGER.quiet():
+                return None  # lazy OPEN->HALF_OPEN / quarantine
+                # cooldown are live transitions a memo would miss
             fn = self._build_fast_allreduce(comm, x, op)
             if fn is None:
                 return None
@@ -808,26 +812,42 @@ class TunedColl(XlaColl):
         if comm.size == 1:
             return x
         from ..ft import inject
+        from ..health import ledger as health, sentinel
         from . import breaker
 
+        scope = str(comm.cid)
         deny: tuple = ()
         while True:
             algo, plan = self._allreduce_choice(comm, x, op, deny)
-            try:
+
+            def _run(algo=algo, plan=plan):
+                # kernel_fault runs inside the bounded closure so an
+                # injected wedge@coll stall is cancellable: the
+                # sentinel abandons the wedged worker and the dispatch
+                # falls to the next tier mid-flight.
                 if inject.armed():
                     inject.kernel_fault("allreduce", algo)
-                out = plan(x)
+                return plan(x)
+
+            try:
+                out = sentinel.maybe_bounded(
+                    _run, what=f"allreduce[{algo}]")
             except ArgumentError:
                 raise  # caller error, not a tier fault
             except Exception as exc:  # commlint: allow(broadexcept)
                 # Tier fault (kernel compile/launch failure, injected
-                # FaultInjected, transport death inside the plan):
-                # trip the breaker and degrade to the next-cheaper
-                # tier instead of failing the collective.
+                # FaultInjected, sentinel StallError on a wedged tier,
+                # transport death inside the plan): trip the breaker,
+                # report the transport tier to the health ledger, and
+                # degrade to the next-cheaper tier instead of failing
+                # the collective.
                 if not breaker.enabled() \
                         or breaker.next_tier(algo) is None:
                     raise
                 breaker.record_failure("allreduce", algo)
+                health.report_failure(health.tier_of_algo(algo),
+                                      scope=scope,
+                                      cause=type(exc).__name__)
                 from ..core.counters import SPC
 
                 SPC.record("coll_tier_fallbacks")
@@ -840,6 +860,8 @@ class TunedColl(XlaColl):
                 continue
             if breaker.enabled():
                 breaker.record_success("allreduce", algo)
+                health.report_success(health.tier_of_algo(algo),
+                                      scope=scope)
             return out
 
     def alltoall(self, comm, x):

@@ -42,6 +42,17 @@ _idle_max_var = config.register(
 
 ProgressFn = Callable[[], int]  # returns number of "events" progressed
 
+# Heartbeat hook stamped once per sweep (health/sentinel installs its
+# beat() here via set_heartbeat — injection keeps core free of any
+# health import). None = disabled; the cost is one attribute load.
+_heartbeat: Callable[[], None] | None = None
+
+
+def set_heartbeat(fn: Callable[[], None] | None) -> None:
+    """Install (or clear, with None) the per-sweep heartbeat hook."""
+    global _heartbeat
+    _heartbeat = fn
+
 
 class ProgressEngine:
     def __init__(self) -> None:
@@ -114,6 +125,9 @@ class ProgressEngine:
 
     def progress(self) -> int:
         """One sweep over registered callbacks; returns events completed."""
+        hb = _heartbeat
+        if hb is not None:
+            hb()
         with self._lock:
             cbs = list(self._callbacks)
             self._call_count += 1

@@ -24,6 +24,8 @@ A plan is ``;``-separated specs, each ``action@layer[:key=val,...]``::
     rank_kill@coll:op=allreduce,after=2
     rank_kill@coll:op=allreduce,after=1,exit=17
     drop@modex:key=dcn/3,count=1,prob=0.5
+    wedge@coll:op=allreduce,algo=native,count=1
+    wedge@btl_dcn:op=send,ms=500,count=1
 
 Actions: ``drop`` (message vanishes on the wire — the sender still
 completes, exactly like TCP loss), ``delay`` (``ms=`` sleep before the
@@ -33,7 +35,10 @@ PML), ``disconnect`` (kill one DCN link via the engine's
 ``dcn_kill_link``; at the coll layer: the named algorithm tier raises
 ``FaultInjected``, the kernel/transport-fault the circuit breaker
 degrades on), ``rank_kill`` (raise ``FaultInjected`` — or ``os._exit``
-when ``exit=`` is given — modelling a controller death mid-call).
+when ``exit=`` is given — modelling a controller death mid-call),
+``wedge`` (the operation STALLS — blocks until ``ms=`` elapses, or
+indefinitely until ``disarm()`` releases it; the hang-not-fail mode
+the health sentinel's stall deadlines exist for).
 
 Scoping keys: ``op`` (operation name at the layer: send/recv at
 pml/btl, get/put at modex, the collective name at coll), ``peer``
@@ -89,16 +94,18 @@ logger = get_logger("ft.inject")
 
 LAYERS = ("btl_sm", "btl_dcn", "pml", "modex", "coll")
 ACTIONS = ("drop", "delay", "duplicate", "corrupt", "disconnect",
-           "rank_kill")
+           "rank_kill", "wedge")
 
 #: Which actions make sense at which boundary (parse-time validation —
 #: a spec that could never fire is a plan bug, not a quiet no-op).
+#: wedge is valid everywhere: any seam can stall indefinitely.
 _VALID = {
-    "btl_sm": {"drop", "delay", "corrupt"},
-    "btl_dcn": {"drop", "delay", "duplicate", "corrupt", "disconnect"},
-    "pml": {"drop", "delay", "duplicate", "corrupt"},
-    "modex": {"drop", "delay"},
-    "coll": {"delay", "disconnect", "rank_kill"},
+    "btl_sm": {"drop", "delay", "corrupt", "wedge"},
+    "btl_dcn": {"drop", "delay", "duplicate", "corrupt", "disconnect",
+                "wedge"},
+    "pml": {"drop", "delay", "duplicate", "corrupt", "wedge"},
+    "modex": {"drop", "delay", "wedge"},
+    "coll": {"delay", "disconnect", "rank_kill", "wedge"},
 }
 
 _plan_var = config.register(
@@ -298,7 +305,8 @@ class FaultPlan:
 
                 tspan.instant(f"fault.{spec.action}", cat="fault",
                               injected=True, layer=layer, op=op,
-                              peer=peer, tag=tag, occ=spec.seen)
+                              peer=peer, tag=tag, algo=algo, key=key,
+                              occ=spec.seen)
                 logger.warning("faultline: %s fired (op=%s peer=%s "
                                "tag=%s occ=%d)", spec.describe(), op,
                                peer, tag, spec.seen)
@@ -341,6 +349,7 @@ def arm(specs=None, *, seed: Optional[int] = None) -> FaultPlan:
         seed = _seed_var.value
     p = specs if isinstance(specs, FaultPlan) else \
         FaultPlan(specs, seed=seed)
+    _WEDGE_EV.clear()  # wedges in this plan will park
     _PLAN = p
     _reset_selections()
     logger.info("faultline armed: %d spec(s), seed=%d", len(p.specs),
@@ -353,6 +362,7 @@ def disarm() -> Optional[FaultPlan]:
     global _PLAN
     p = _PLAN
     _PLAN = None
+    _WEDGE_EV.set()  # release every wedged thread
     if p is not None:
         _reset_selections()
     return p
@@ -369,6 +379,22 @@ def _reset_selections() -> None:
 def _apply_delay(spec: FaultSpec) -> None:
     if spec.ms > 0:
         time.sleep(spec.ms / 1000.0)
+
+
+# Wedged operations park on this event, not a sleep: ``disarm()`` sets
+# it, releasing every wedged thread at once — how a drill (or the
+# bench) un-wedges the world after the sentinel has already abandoned
+# the stalled workers. arm() re-arms it for the next plan.
+_WEDGE_EV = threading.Event()
+
+
+def _apply_wedge(spec: FaultSpec) -> None:
+    """Stall the calling thread: for ``ms=`` when given, else until
+    the plan is disarmed (the indefinite-hang injection the health
+    sentinel's deadlines exist to catch). The stall is deliberately
+    un-failing — a wedged tier hangs, it does not raise."""
+    timeout = spec.ms / 1000.0 if spec.ms > 0 else None
+    _WEDGE_EV.wait(timeout)
 
 
 def _corrupt_bytes(data) -> bytes:
@@ -425,6 +451,8 @@ class FaultPml:
             for spec in p.decide("pml", "send", peer=dest, tag=tag):
                 if spec.action == "delay":
                     _apply_delay(spec)
+                elif spec.action == "wedge":
+                    _apply_wedge(spec)
                 elif spec.action == "corrupt":
                     value = _corrupt_value(value)
                 elif spec.action == "duplicate":
@@ -451,6 +479,8 @@ class FaultPml:
             for spec in p.decide("pml", "recv", peer=source, tag=tag):
                 if spec.action == "delay":
                     _apply_delay(spec)
+                elif spec.action == "wedge":
+                    _apply_wedge(spec)
 
     def recv(self, comm, source, tag, *, dest):
         self._recvish(comm, source, tag)
@@ -506,6 +536,8 @@ class FaultDcnEndpoint:
             for spec in p.decide("btl_dcn", "send", peer=peer, tag=tag):
                 if spec.action == "delay":
                     _apply_delay(spec)
+                elif spec.action == "wedge":
+                    _apply_wedge(spec)
                 elif spec.action == "corrupt":
                     data = _corrupt_bytes(data)
                 elif spec.action == "duplicate":
@@ -526,6 +558,8 @@ class FaultDcnEndpoint:
                                  tag=None):
                 if spec.action == "delay":
                     _apply_delay(spec)
+                elif spec.action == "wedge":
+                    _apply_wedge(spec)
         return self.host.connect(ip, port, **kw)
 
     def close(self) -> None:
@@ -563,6 +597,8 @@ class FaultSmBtl:
                                  tag=None):
                 if spec.action == "delay":
                     _apply_delay(spec)
+                elif spec.action == "wedge":
+                    _apply_wedge(spec)
                 elif spec.action == "corrupt":
                     value = _corrupt_value(value)
                 elif spec.action == "drop":
@@ -599,6 +635,8 @@ def on_fp_send(endpoint, peer: int, tag: Optional[int]) -> None:
             SPC.record("faultline_fp_corrupts")
         elif spec.action == "delay":
             _apply_delay(spec)
+        elif spec.action == "wedge":
+            _apply_wedge(spec)
         elif spec.action == "drop":
             from ..core.errors import CommError
 
@@ -618,6 +656,8 @@ def on_modex(op: str, key: str) -> None:
     for spec in p.decide("modex", op, key=key):
         if spec.action == "delay":
             _apply_delay(spec)
+        elif spec.action == "wedge":
+            _apply_wedge(spec)
         elif spec.action == "drop":
             from ..runtime.modex import ModexError
 
@@ -657,6 +697,8 @@ def on_coll(comm, opname: str) -> None:
     for spec in p.decide("coll", opname):
         if spec.action == "delay":
             _apply_delay(spec)
+        elif spec.action == "wedge":
+            _apply_wedge(spec)
         elif spec.action == "rank_kill":
             _rank_kill(spec, f"{opname} on {comm.name}")
 
@@ -675,3 +717,7 @@ def kernel_fault(opname: str, algo: str) -> None:
             )
         if spec.action == "delay":
             _apply_delay(spec)
+        elif spec.action == "wedge":
+            # the tier STALLS (no raise): only a sentinel deadline —
+            # or disarm() — gets the collective off this tier
+            _apply_wedge(spec)

@@ -1,0 +1,54 @@
+"""ompi_tpu.health — the runtime health supervisor ("medic").
+
+Three pieces (see docs/HEALTH.md for the operator guide):
+
+- :mod:`.ledger` — the per-(scope, tier) liveness state machine
+  (HEALTHY → SUSPECT → QUARANTINED → PROBATION → HEALTHY) with
+  hysteresis; routing (``coll/breaker.route``) consults it so the
+  breaker's failure domain is promoted from (op, algo) to the
+  transport tier, scoped per communicator.
+- :mod:`.prober` — deadline-bounded canary ops per tier plus the
+  background supervisor thread that re-probes quarantined tiers on a
+  seeded backoff and restores them with no live collective at risk.
+- :mod:`.sentinel` — progress-engine heartbeat + per-op stall
+  deadlines, so a collective wedged on a dead tier is cancelled and
+  re-issued on the next healthy tier instead of hanging the job.
+
+Lifecycle: ``api.init`` calls :func:`at_init` (installs the heartbeat,
+registers the device probe, and starts the supervisor when
+``health_base_autostart`` is set); ``api.finalize`` calls
+:func:`at_finalize`.
+"""
+
+from __future__ import annotations
+
+from . import ledger, prober, sentinel  # noqa: F401 (re-export)
+from .ledger import (  # noqa: F401
+    GLOBAL_SCOPE, HEALTHY, PROBATION, QUARANTINED, SUSPECT, TIERS,
+    LEDGER, tier_of_algo,
+)
+from .sentinel import StallError  # noqa: F401
+
+
+def at_init() -> None:
+    """api.init hook: wire the heartbeat and (optionally) start the
+    supervisor. Cheap and exception-free by construction."""
+    if not ledger.enabled():
+        return
+    sentinel.install()
+    prober.ensure_builtin_probes()
+    if prober.autostart_enabled():
+        prober.start()
+
+
+def at_finalize() -> None:
+    """api.finalize hook: stop the supervisor thread."""
+    prober.stop()
+
+
+def reset_for_testing() -> None:
+    """Tests: stop the supervisor and forget all ledger/sentinel
+    state (probe registrations are kept — they are selection-time)."""
+    prober.stop()
+    ledger.reset()
+    sentinel.reset()

@@ -1,0 +1,407 @@
+"""Health ledger: per-tier liveness state machine with hysteresis.
+
+The PR-5 circuit breaker (coll/breaker.py) is keyed (op, algo): a
+quant kernel fault opens *that* breaker, but the underlying cause —
+the device tunnel wedged, the shm segment torn — takes out every
+algorithm riding the same transport **tier**. The ledger promotes the
+failure domain from (op, algo) to the tier itself, a small lattice of
+transport planes:
+
+    device    XLA/pallas device collectives over the fabric
+    fastpath  shared-ring doorbell lane (btl/sm fp_*)
+    shm       shm v2 segment transfers
+    dcn       cross-slice TCP links
+    fabric    pml/fabric engine p2p
+    host      numpy gather_reduce — the always-healthy terminal
+
+Each (scope, tier) entry walks a four-state machine with hysteresis
+on both edges (one flaky success must not restore a dead tier, one
+flaky failure must not quarantine a healthy one):
+
+    HEALTHY ──failure──▶ SUSPECT ──suspect_threshold failures──▶
+    QUARANTINED ──probe success──▶ PROBATION
+    PROBATION ──probation_successes successes──▶ HEALTHY
+    PROBATION ──any failure──▶ QUARANTINED   (hysteresis)
+    SUSPECT ──success──▶ HEALTHY             (consecutive counts reset)
+
+``scope`` is a communicator cid (or "global"): one comm's quarantines
+never trip another's tiers — the isolation precursor to the
+multi-tenant daemon (ROADMAP). Routing (``is_denied``) consults both
+the comm scope and the global scope, so a supervisor-level global
+quarantine still protects every comm.
+
+Determinism: the transition log records (seq, scope, tier, from→to,
+cause) and **no timestamps**, so the same fault schedule reproduces a
+byte-identical ``digest()`` across runs and ranks — the same
+reproducibility contract faultline's plan digest carries. Wall-clock
+state (when a quarantine began, for time-to-restore pvars and the
+lazy cooldown) lives outside the log.
+
+When no supervisor thread is running, a QUARANTINED entry whose
+``health_ledger_quarantine_ms`` has elapsed lazily transitions to
+PROBATION at the next routing decision — the pre-supervisor in-band
+cooldown probe, kept so health degrades gracefully to exactly the
+PR-5 behaviour when the prober is off.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Callable, Optional
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.logging import get_logger
+
+logger = get_logger("health.ledger")
+
+_enable = config.register(
+    "health", "base", "enable", type=bool, default=True,
+    description="Track per-tier health and route collectives around "
+    "QUARANTINED tiers (the breaker's failure domain promoted from "
+    "(op, algo) to the transport tier)",
+)
+_suspect_threshold = config.register(
+    "health", "ledger", "suspect_threshold", type=int, default=3,
+    description="Consecutive tier failures before SUSPECT escalates "
+    "to QUARANTINED (hysteresis on the down edge)",
+)
+_probation_successes = config.register(
+    "health", "ledger", "probation_successes", type=int, default=2,
+    description="Consecutive successes a PROBATION tier needs before "
+    "it is HEALTHY again (hysteresis on the up edge)",
+)
+_quarantine_ms = config.register(
+    "health", "ledger", "quarantine_ms", type=int, default=60000,
+    description="Without a running supervisor, how long a QUARANTINED "
+    "tier stays denied before the lazy in-band cooldown admits a "
+    "probe (the supervisor's background re-probe replaces this)",
+)
+
+HEALTHY, SUSPECT, QUARANTINED, PROBATION = (
+    "healthy", "suspect", "quarantined", "probation",
+)
+
+#: The transport tiers, fastest first. "host" is the terminal plane
+#: (pure numpy + device_put) and is never quarantined — there must
+#: always be a routable tier.
+TIERS = ("device", "fastpath", "shm", "dcn", "fabric", "host")
+
+GLOBAL_SCOPE = "global"
+
+#: Collective algorithm -> the transport tier it rides. Everything
+#: that launches an XLA/pallas program reduces over the device fabric;
+#: gather_reduce is the host tier.
+_ALGO_TIER = {
+    "gather_reduce": "host",
+}
+
+
+def tier_of_algo(algo: str) -> str:
+    """The transport tier a collective algorithm executes on."""
+    return _ALGO_TIER.get(algo, "device")
+
+
+class _Entry:
+    __slots__ = ("state", "failures", "successes", "quarantined_at",
+                 "cause")
+
+    def __init__(self) -> None:
+        self.state = HEALTHY
+        self.failures = 0       # consecutive failures
+        self.successes = 0      # consecutive successes (PROBATION)
+        self.quarantined_at = 0.0  # monotonic; time-to-restore pvar
+        self.cause = ""
+
+
+class Ledger:
+    """The process health lattice: (scope, tier) -> state machine
+    entry plus the deterministic transition log."""
+
+    def __init__(self) -> None:
+        self._mu = threading.RLock()
+        self._entries: dict[tuple[str, str], _Entry] = {}
+        self._log: list[str] = []
+        self._generation = 0
+        # Lock-free fast-path flags (GIL-atomic bool reads): the hot
+        # dispatch path checks these before taking any lock.
+        self._any_tracked = False     # any entry exists at all
+        self._any_unhealthy = False   # any entry not HEALTHY
+        self._restore_cbs: list[Callable[[str, str], None]] = []
+
+    # -- cheap reads (no lock; GIL-atomic attribute loads) -------------
+
+    def quiet(self) -> bool:
+        """True when every tracked tier is HEALTHY — the precondition
+        for memoized routing (tuned's fast dispatch cache)."""
+        return not self._any_unhealthy
+
+    def tracked(self) -> bool:
+        return self._any_tracked
+
+    def generation(self) -> int:
+        return self._generation
+
+    # -- state machine -------------------------------------------------
+
+    def _entry(self, scope: str, tier: str) -> _Entry:
+        e = self._entries.get((scope, tier))
+        if e is None:
+            e = self._entries[(scope, tier)] = _Entry()
+            self._any_tracked = True
+        return e
+
+    def _transition(self, scope: str, tier: str, e: _Entry,
+                    to_state: str, cause: str) -> None:
+        """Record one edge: log line (timestamp-free — the digest
+        contract), generation bump, trace instant, pvars."""
+        frm = e.state
+        e.state = to_state
+        e.cause = cause
+        self._generation += 1
+        self._log.append(
+            f"{len(self._log)} {scope} {tier} {frm}->{to_state} {cause}"
+        )
+        self._any_unhealthy = any(
+            x.state != HEALTHY for x in self._entries.values()
+        )
+        from ..trace import span as tspan
+
+        tspan.instant(f"health.{to_state}", cat="health", tier=tier,
+                      scope=scope, prev=frm, cause=cause)
+        if to_state == QUARANTINED:
+            if frm != QUARANTINED:
+                e.quarantined_at = time.monotonic()
+            SPC.record("health_quarantines")
+            logger.warning("health: tier %r QUARANTINED (scope=%s, "
+                           "cause=%s)", tier, scope, cause)
+        elif to_state == HEALTHY and frm in (PROBATION, QUARANTINED):
+            SPC.record("health_restores")
+            if e.quarantined_at:
+                SPC.record_latency(
+                    "health_time_to_restore",
+                    time.monotonic() - e.quarantined_at,
+                )
+            e.quarantined_at = 0.0
+            logger.warning("health: tier %r restored to HEALTHY "
+                           "(scope=%s)", tier, scope)
+            for cb in list(self._restore_cbs):
+                try:
+                    cb(tier, scope)
+                except Exception:  # commlint: allow(broadexcept)
+                    logger.exception("health: restore callback failed")
+            # Tier back: close every (op, algo) breaker riding it so
+            # the next dispatch goes straight to the restored tier.
+            from ..coll import breaker
+
+            breaker.on_tier_restored(tier)
+        else:
+            logger.info("health: %s/%s %s -> %s (%s)", scope, tier,
+                        frm, to_state, cause)
+
+    def report_failure(self, tier: str, *, scope: str = GLOBAL_SCOPE,
+                       cause: str = "") -> None:
+        """An in-band operation (or probe) on ``tier`` failed."""
+        if not _enable.value or tier == "host":
+            return  # host is the terminal plane; never quarantined
+        with self._mu:
+            e = self._entry(scope, tier)
+            e.failures += 1
+            e.successes = 0
+            if e.state == HEALTHY:
+                self._transition(scope, tier, e, SUSPECT, cause)
+            if e.state == SUSPECT \
+                    and e.failures >= _suspect_threshold.value:
+                self._transition(scope, tier, e, QUARANTINED, cause)
+            elif e.state == PROBATION:
+                # hysteresis: one failure on probation re-quarantines
+                self._transition(scope, tier, e, QUARANTINED, cause)
+
+    def report_success(self, tier: str, *, scope: str = GLOBAL_SCOPE
+                       ) -> None:
+        """An in-band operation (or probe) on ``tier`` completed."""
+        if not self._any_tracked or not _enable.value:
+            return  # hot path: nothing ever failed, skip the lock
+        with self._mu:
+            e = self._entries.get((scope, tier))
+            if e is None:
+                return
+            e.failures = 0
+            if e.state == SUSPECT:
+                e.successes = 0
+                self._transition(scope, tier, e, HEALTHY, "recovered")
+            elif e.state == QUARANTINED:
+                # a probe got through (breaker HALF_OPEN / supervisor)
+                e.successes = 1
+                self._transition(scope, tier, e, PROBATION, "probe_ok")
+                if e.successes >= _probation_successes.value:
+                    self._transition(scope, tier, e, HEALTHY,
+                                     "probation_passed")
+            elif e.state == PROBATION:
+                e.successes += 1
+                if e.successes >= _probation_successes.value:
+                    self._transition(scope, tier, e, HEALTHY,
+                                     "probation_passed")
+
+    def quarantine(self, tier: str, *, scope: str = GLOBAL_SCOPE,
+                   cause: str = "forced") -> None:
+        """Operator/supervisor override: straight to QUARANTINED."""
+        if not _enable.value or tier == "host":
+            return
+        with self._mu:
+            e = self._entry(scope, tier)
+            e.failures = max(e.failures, _suspect_threshold.value)
+            e.successes = 0
+            if e.state != QUARANTINED:
+                self._transition(scope, tier, e, QUARANTINED, cause)
+
+    def restore(self, tier: str, *, scope: str = GLOBAL_SCOPE,
+                cause: str = "forced") -> None:
+        """Operator override: straight back to HEALTHY."""
+        with self._mu:
+            e = self._entries.get((scope, tier))
+            if e is None or e.state == HEALTHY:
+                return
+            e.failures = 0
+            e.successes = 0
+            self._transition(scope, tier, e, HEALTHY, cause)
+
+    # -- routing consult -----------------------------------------------
+
+    def state(self, tier: str, scope: str = GLOBAL_SCOPE) -> str:
+        with self._mu:
+            e = self._entries.get((scope, tier))
+            return e.state if e is not None else HEALTHY
+
+    def is_denied(self, tier: str, scope: Optional[str] = None) -> bool:
+        """True while routing must avoid ``tier``: QUARANTINED in the
+        caller's scope or globally. Only QUARANTINED denies — SUSPECT
+        and PROBATION tiers keep taking traffic (that traffic *is* the
+        hysteresis evidence). Applies the lazy cooldown when no
+        supervisor is running."""
+        if not self._any_unhealthy or not _enable.value:
+            return False
+        if tier == "host":
+            return False
+        scopes = (GLOBAL_SCOPE,) if scope in (None, GLOBAL_SCOPE) \
+            else (scope, GLOBAL_SCOPE)
+        with self._mu:
+            for s in scopes:
+                e = self._entries.get((s, tier))
+                if e is None or e.state != QUARANTINED:
+                    continue
+                from . import prober
+
+                if not prober.running() and e.quarantined_at and (
+                        (time.monotonic() - e.quarantined_at) * 1e3
+                        >= _quarantine_ms.value):
+                    # lazy in-band cooldown: admit the next call as
+                    # the probe (PR-5 breaker semantics, tier-wide)
+                    e.successes = 0
+                    self._transition(s, tier, e, PROBATION, "cooldown")
+                    continue
+                return True
+        return False
+
+    def quarantined_tiers(self) -> list[tuple[str, str]]:
+        """(scope, tier) pairs currently QUARANTINED — the supervisor's
+        re-probe worklist."""
+        if not self._any_unhealthy:
+            return []
+        with self._mu:
+            return [k for k, e in self._entries.items()
+                    if e.state == QUARANTINED]
+
+    # -- introspection ---------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """Ledger state for monitoring dumps / modex publication."""
+        with self._mu:
+            return {
+                "generation": self._generation,
+                "entries": {
+                    f"{scope}/{tier}": {
+                        "state": e.state,
+                        "failures": e.failures,
+                        "successes": e.successes,
+                        "cause": e.cause,
+                    }
+                    for (scope, tier), e in sorted(self._entries.items())
+                },
+                "transitions": len(self._log),
+            }
+
+    def transitions(self) -> list[str]:
+        with self._mu:
+            return list(self._log)
+
+    def digest(self) -> str:
+        """sha256 of the transition log — byte-identical for the same
+        fault schedule (the drill-reproducibility check)."""
+        with self._mu:
+            return hashlib.sha256(
+                "\n".join(self._log).encode()).hexdigest()
+
+    def on_restore(self, cb: Callable[[str, str], None]) -> None:
+        """Register cb(tier, scope) fired on a HEALTHY restore."""
+        with self._mu:
+            if cb not in self._restore_cbs:
+                self._restore_cbs.append(cb)
+
+    def reset(self) -> None:
+        """Forget all state (tests / re-init)."""
+        with self._mu:
+            self._entries.clear()
+            self._log.clear()
+            self._generation += 1
+            self._any_tracked = False
+            self._any_unhealthy = False
+            self._restore_cbs.clear()
+
+
+LEDGER = Ledger()
+
+
+def enabled() -> bool:
+    return _enable.value
+
+
+# -- module-level convenience (the API the rest of the tree uses) -------
+
+def report_failure(tier: str, *, scope: str = GLOBAL_SCOPE,
+                   cause: str = "") -> None:
+    LEDGER.report_failure(tier, scope=scope, cause=cause)
+
+
+def report_success(tier: str, *, scope: str = GLOBAL_SCOPE) -> None:
+    LEDGER.report_success(tier, scope=scope)
+
+
+def is_denied(tier: str, scope: Optional[str] = None) -> bool:
+    return LEDGER.is_denied(tier, scope)
+
+
+def state(tier: str, scope: str = GLOBAL_SCOPE) -> str:
+    return LEDGER.state(tier, scope)
+
+
+def quiet() -> bool:
+    return LEDGER.quiet()
+
+
+def generation() -> int:
+    return LEDGER.generation()
+
+
+def snapshot() -> dict:
+    return LEDGER.snapshot()
+
+
+def digest() -> str:
+    return LEDGER.digest()
+
+
+def reset() -> None:
+    LEDGER.reset()

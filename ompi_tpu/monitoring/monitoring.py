@@ -49,6 +49,10 @@ def maybe_dump_at_finalize() -> None:
         hists = SPC.histogram_snapshots()
         if hists:
             payload["latency_histograms"] = hists
+        from ..health import ledger as _health_ledger
+
+        if _health_ledger.LEDGER.tracked():
+            payload["health"] = _health_ledger.snapshot()
         # Through core/logging's user-facing channel (not a bare
         # print): the dump lands on the same stream as the rest of the
         # run's diagnostics, banner-framed like every other

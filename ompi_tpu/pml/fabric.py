@@ -1110,9 +1110,42 @@ def _wire_shm(engine: "FabricEngine", peer_recs: dict[int, dict],
     from .mtl import MTL_MATCH_TAG
 
     shm.enable_matching(MTL_MATCH_TAG)
+    _sm.register_health_probes(shm, good)
     SPC.record("fabric_sm_peers", len(good))
     logger.info("shm wired: process %d, co-located peers %s", my,
                 sorted(good))
+
+
+def _register_health_probes(engine, ep) -> None:
+    """Wire the dcn + fabric tier canaries once the engine is up (the
+    health/prober registration seam; weakrefs keep a torn-down engine
+    from being held alive by its own probes)."""
+    import weakref
+
+    from ..btl import dcn as _dcn
+    from ..health import prober as health_prober
+
+    # duck-typed: the endpoint may arrive wrapped (faultline drills)
+    if engine.peer_ids and hasattr(ep, "heal_links"):
+        _dcn.register_health_probe(ep, engine.peer_ids)
+    eref = weakref.ref(engine)
+
+    def _fabric_canary() -> None:
+        eng = eref()
+        if eng is None:
+            return  # engine retired; re-wire re-registers
+        # pml sendrecv self-check degenerate case: one progress sweep
+        # plus a live-peer count — a wedged engine hangs here and the
+        # probe deadline converts the hang into a tier failure.
+        eng.progress()
+        dead = [idx for idx, pid in sorted(eng.peer_ids.items())
+                if not eng.ep.peer_alive(pid)]
+        if dead:
+            raise RuntimeError(f"fabric peer(s) dead: {dead}")
+
+    health_prober.register_probe(
+        "fabric", _fabric_canary,
+        description="progress sweep + endpoint peer liveness")
 
 
 def wire_up(*, endpoint=None, timeout_s: float = 60.0,
@@ -1159,6 +1192,7 @@ def wire_up(*, endpoint=None, timeout_s: float = 60.0,
     engine.attach_pml(ob1)
     _progress.register(engine.progress)
     _progress.register_idle(engine.idle_wait, wake=engine.notify)
+    _register_health_probes(engine, ep)
     # Re-run coll selection on live comms: components gated on fabric
     # availability (coll/hier for spanning comms) become selectable now
     # (the reference's comm_select runs after add_procs+modex for the

@@ -184,7 +184,7 @@ def _nbytes_of(value) -> int:
     return total
 
 
-@PML.register
+@PML.register  # commlint: allow(healthseam) — liveness delegated to the btl probes
 class Ob1Pml(PmlComponent):
     NAME = "ob1"
     PRIORITY = 50

@@ -156,6 +156,24 @@ def exchange_dcn_addresses(endpoint, process_index: int,
     return collect_dcn_addresses(num_processes, timeout_s=timeout_s)
 
 
+def publish_health(snapshot: dict) -> None:
+    """Publish this controller's health-ledger snapshot (the
+    supervisor calls this on generation change — best effort, peers
+    read it for cross-rank health visibility and the monitoring merge;
+    versioned key: each publication overwrites, the generation inside
+    the snapshot orders them)."""
+    from ..trace import recorder
+
+    put(f"health/{recorder.process_rank()}", snapshot)
+
+
+def peer_health(rank: int, timeout_s: float = 0.0) -> dict:
+    """Read a peer controller's last published health snapshot.
+    timeout_s=0 probes (raises ModexError when the peer has never
+    published — a peer with nothing wrong may never publish)."""
+    return get(f"health/{rank}", timeout_s=timeout_s)
+
+
 def clear_local() -> None:
     with _lock:
         _local.clear()

@@ -20,6 +20,9 @@ def _run(prog: str, timeout: int = 60):
 
 
 def test_watchdog_emits_partial_rows_on_hang():
+    # last_chance=True is the watchdog the bench re-arms after one
+    # supervisor-driven restore: a SECOND wedge skips the re-probe and
+    # takes the abort path directly (the contract under test here).
     prog = textwrap.dedent("""
         import time
         import bench
@@ -27,7 +30,8 @@ def test_watchdog_emits_partial_rows_on_hang():
         bench._record("headline_vs_baseline", 9.9)
         bench._record("sweep", [{"bytes": 4, "device_gbps": 1.0}])
         bench._set_phase("pallas ring proof")
-        bench._watchdog(0.5, "allreduce_sum_reduce_512MiB_f32")
+        bench._watchdog(0.5, "allreduce_sum_reduce_512MiB_f32",
+                        last_chance=True)
         time.sleep(30)   # the simulated wedge: never returns on its own
     """)
     r = _run(prog)
@@ -47,7 +51,8 @@ def test_watchdog_zero_value_before_any_phase():
         import time
         import bench
         bench._set_phase("probe (trivial op through the tunnel)")
-        bench._watchdog(0.5, "allreduce_sum_reduce_512MiB_f32")
+        bench._watchdog(0.5, "allreduce_sum_reduce_512MiB_f32",
+                        last_chance=True)
         time.sleep(30)
     """)
     r = _run(prog)
@@ -55,6 +60,34 @@ def test_watchdog_zero_value_before_any_phase():
     out = json.loads(r.stdout.strip().splitlines()[-1])
     assert out["value"] == 0 and out["vs_baseline"] == 0
     assert out["detail"]["phase"].startswith("probe")
+
+
+def test_watchdog_first_fire_restores_and_continues():
+    """The first wedge no longer aborts the run: the watchdog routes
+    it through the health supervisor (quarantine device -> re-probe ->
+    restore), records the quarantine window, and later rows come out
+    tagged degraded=true instead of being discarded."""
+    prog = textwrap.dedent("""
+        import json, time
+        import bench
+        bench._set_phase("sweep (allreduce)")
+        bench._watchdog(0.5, "allreduce_sum_reduce_512MiB_f32")
+        time.sleep(20)   # wedge long enough for fire + restore cycle
+        bench._record("post_restore", {"gbps": 1.0})
+        print("Q " + json.dumps(bench._PARTIAL["rows"]["tier_quarantine"]))
+        print("R " + json.dumps(bench._PARTIAL["rows"]["post_restore"]))
+    """)
+    r = _run(prog)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l[:2] in ("Q ", "R ")]
+    quarantine = json.loads(lines[0][2:])
+    assert quarantine["restored"] is True
+    assert quarantine["tier"] == "device"
+    assert quarantine["quarantine_window_ms"] >= 0
+    after = json.loads(lines[1][2:])
+    assert after["degraded"] is True
+    assert after["quarantine_window_ms"] == \
+        quarantine["quarantine_window_ms"]
 
 
 def test_probe_device_times_out_on_stuck_tunnel():
