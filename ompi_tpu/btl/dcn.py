@@ -590,7 +590,9 @@ def register_health_probe(endpoint, peer_ids: dict) -> None:
     def _dcn_canary() -> None:
         ep = ref()
         if ep is None:
-            return  # endpoint retired; re-wire re-registers
+            # torn-down endpoint verified nothing: retire the probe
+            # instead of reporting a success on zero evidence
+            raise health_prober.ProbeRetired("dcn endpoint retired")
         ep.stats()  # native round trip: raises on a dead context
         dead = [idx for idx, pid in sorted(peers.items())
                 if ep.heal_links(pid) <= 0]

@@ -1111,7 +1111,10 @@ def register_health_probes(shm, peers) -> None:
     def _shm_canary() -> None:
         ep = ref()
         if ep is None:
-            return  # endpoint retired; re-wire re-registers
+            # torn-down endpoint: no evidence either way — retire the
+            # probe rather than report a success that would restore a
+            # quarantined tier with no live endpoint behind it
+            raise health_prober.ProbeRetired("shm endpoint retired")
         ep.stats()  # segment round trip: raises on a torn mapping
         dead = [p for p in peer_list if not ep.peer_alive(p)]
         if dead:
@@ -1120,7 +1123,7 @@ def register_health_probes(shm, peers) -> None:
     def _fp_canary() -> None:
         ep = ref()
         if ep is None:
-            return
+            raise health_prober.ProbeRetired("fp endpoint retired")
         if not ep.fp_available():
             raise RuntimeError("fastpath lane lost")
         ep.fp_stats()  # ring walk: raises when the fp segment is torn

@@ -841,6 +841,15 @@ class TunedColl(XlaColl):
                 # report the transport tier to the health ledger, and
                 # degrade to the next-cheaper tier instead of failing
                 # the collective.
+                #
+                # StallError only *abandons* the wedged worker — the
+                # stalled plan(x) keeps executing and may complete
+                # concurrently with the retry below. Safe in a single
+                # process because every tier is a pure function of its
+                # input buffer and the late result is dropped; across
+                # controllers a rank-local stall leaves ranks on
+                # divergent tiers with an extra in-flight device
+                # collective (hazard documented in DESIGN.md §17).
                 if not breaker.enabled() \
                         or breaker.next_tier(algo) is None:
                     raise

@@ -37,11 +37,15 @@ reproducibility contract faultline's plan digest carries. Wall-clock
 state (when a quarantine began, for time-to-restore pvars and the
 lazy cooldown) lives outside the log.
 
-When no supervisor thread is running, a QUARANTINED entry whose
-``health_ledger_quarantine_ms`` has elapsed lazily transitions to
-PROBATION at the next routing decision — the pre-supervisor in-band
-cooldown probe, kept so health degrades gracefully to exactly the
-PR-5 behaviour when the prober is off.
+When the supervisor cannot actively re-probe a tier — no supervisor
+thread running, or no canary registered for it — a QUARANTINED entry
+whose ``health_ledger_quarantine_ms`` has elapsed transitions to
+PROBATION anyway: lazily at the next routing decision (``is_denied``)
+or from the supervisor's tick (``apply_cooldown``). This is the
+pre-supervisor in-band cooldown probe, kept so health degrades
+gracefully to exactly the PR-5 behaviour when the prober is off, and
+so a quarantine never outlives its cooldown just because nothing can
+probe the tier.
 """
 
 from __future__ import annotations
@@ -130,6 +134,10 @@ class Ledger:
         self._any_tracked = False     # any entry exists at all
         self._any_unhealthy = False   # any entry not HEALTHY
         self._restore_cbs: list[Callable[[str, str], None]] = []
+        # (tier, scope) restores whose callbacks are still owed —
+        # queued under _mu by _transition, fired outside it by
+        # _drain_restored so a slow callback cannot stall dispatch.
+        self._pending_restored: list[tuple[str, str]] = []
 
     # -- cheap reads (no lock; GIL-atomic attribute loads) -------------
 
@@ -187,19 +195,42 @@ class Ledger:
             e.quarantined_at = 0.0
             logger.warning("health: tier %r restored to HEALTHY "
                            "(scope=%s)", tier, scope)
-            for cb in list(self._restore_cbs):
-                try:
-                    cb(tier, scope)
-                except Exception:  # commlint: allow(broadexcept)
-                    logger.exception("health: restore callback failed")
-            # Tier back: close every (op, algo) breaker riding it so
-            # the next dispatch goes straight to the restored tier.
-            from ..coll import breaker
-
-            breaker.on_tier_restored(tier)
+            # Callbacks and breaker.on_tier_restored fire outside _mu
+            # (_drain_restored): a slow callback under the lock would
+            # stall every concurrent dispatch, and taking breaker._mu
+            # under ledger._mu would pin a ledger->breaker lock order
+            # a future breaker->ledger path could deadlock against.
+            self._pending_restored.append((tier, scope))
         else:
             logger.info("health: %s/%s %s -> %s (%s)", scope, tier,
                         frm, to_state, cause)
+
+    def _drain_restored(self) -> None:
+        """Fire restore callbacks + breaker.on_tier_restored for every
+        restore queued by _transition. Called by the mutators after
+        releasing ``_mu`` — never while holding it."""
+        if not self._pending_restored:
+            return  # GIL-atomic read; the common path stays lock-free
+        while True:
+            with self._mu:
+                if not self._pending_restored:
+                    return
+                items = self._pending_restored
+                self._pending_restored = []
+                cbs = list(self._restore_cbs)
+            from ..coll import breaker
+
+            for tier, scope in items:
+                for cb in cbs:
+                    try:
+                        cb(tier, scope)
+                    except Exception:  # commlint: allow(broadexcept)
+                        logger.exception(
+                            "health: restore callback failed")
+                # Tier back: close every (op, algo) breaker riding it
+                # so the next dispatch goes straight to the restored
+                # tier.
+                breaker.on_tier_restored(tier)
 
     def report_failure(self, tier: str, *, scope: str = GLOBAL_SCOPE,
                        cause: str = "") -> None:
@@ -218,6 +249,7 @@ class Ledger:
             elif e.state == PROBATION:
                 # hysteresis: one failure on probation re-quarantines
                 self._transition(scope, tier, e, QUARANTINED, cause)
+        self._drain_restored()
 
     def report_success(self, tier: str, *, scope: str = GLOBAL_SCOPE
                        ) -> None:
@@ -244,6 +276,7 @@ class Ledger:
                 if e.successes >= _probation_successes.value:
                     self._transition(scope, tier, e, HEALTHY,
                                      "probation_passed")
+        self._drain_restored()
 
     def quarantine(self, tier: str, *, scope: str = GLOBAL_SCOPE,
                    cause: str = "forced") -> None:
@@ -267,6 +300,26 @@ class Ledger:
             e.failures = 0
             e.successes = 0
             self._transition(scope, tier, e, HEALTHY, cause)
+        self._drain_restored()
+
+    def apply_cooldown(self, tier: str, *,
+                       scope: str = GLOBAL_SCOPE) -> bool:
+        """Time-based QUARANTINED -> PROBATION once ``quarantine_ms``
+        has elapsed — the fallback for a quarantined tier the
+        supervisor cannot actively re-probe (no registered canary:
+        operator quarantine on an unwired tier, probe retired). True
+        when the transition fired."""
+        with self._mu:
+            e = self._entries.get((scope, tier))
+            if e is None or e.state != QUARANTINED:
+                return False
+            if not e.quarantined_at or (
+                    (time.monotonic() - e.quarantined_at) * 1e3
+                    < _quarantine_ms.value):
+                return False
+            e.successes = 0
+            self._transition(scope, tier, e, PROBATION, "cooldown")
+            return True
 
     # -- routing consult -----------------------------------------------
 
@@ -279,8 +332,9 @@ class Ledger:
         """True while routing must avoid ``tier``: QUARANTINED in the
         caller's scope or globally. Only QUARANTINED denies — SUSPECT
         and PROBATION tiers keep taking traffic (that traffic *is* the
-        hysteresis evidence). Applies the lazy cooldown when no
-        supervisor is running."""
+        hysteresis evidence). Applies the lazy cooldown when the
+        supervisor cannot re-probe the tier (not running, or no canary
+        registered for it)."""
         if not self._any_unhealthy or not _enable.value:
             return False
         if tier == "host":
@@ -294,7 +348,9 @@ class Ledger:
                     continue
                 from . import prober
 
-                if not prober.running() and e.quarantined_at and (
+                if (not prober.running()
+                        or not prober.has_probe(tier)) \
+                        and e.quarantined_at and (
                         (time.monotonic() - e.quarantined_at) * 1e3
                         >= _quarantine_ms.value):
                     # lazy in-band cooldown: admit the next call as
@@ -313,6 +369,17 @@ class Ledger:
         with self._mu:
             return [k for k, e in self._entries.items()
                     if e.state == QUARANTINED]
+
+    def suspect_tiers(self) -> list[tuple[str, str]]:
+        """(scope, tier) pairs currently SUSPECT — swept by the
+        supervisor so a SUSPECT entry can escalate or recover instead
+        of dead-ending (a stuck SUSPECT would pin quiet() false and
+        disable memoized routing forever)."""
+        if not self._any_unhealthy:
+            return []
+        with self._mu:
+            return [k for k, e in self._entries.items()
+                    if e.state == SUSPECT]
 
     # -- introspection ---------------------------------------------------
 
@@ -359,6 +426,7 @@ class Ledger:
             self._any_tracked = False
             self._any_unhealthy = False
             self._restore_cbs.clear()
+            self._pending_restored = []
 
 
 LEDGER = Ledger()

@@ -27,9 +27,15 @@ The **supervisor** is a background daemon thread:
   schedule (fast first retry, exponential to the cap) — a restored
   tier comes back within ``reprobe_initial_ms`` of recovering instead
   of waiting out a fixed cooldown;
-- healthy tiers get a low-cadence liveness sweep
-  (``health_prober_interval_ms``) so a silently-dead tier is caught
-  before application traffic hits it;
+- HEALTHY and SUSPECT tiers get a low-cadence liveness sweep
+  (``health_prober_interval_ms``): a silently-dead tier is caught
+  before application traffic hits it, and a SUSPECT tier keeps
+  accumulating evidence until it escalates to QUARANTINED or recovers
+  to HEALTHY instead of dead-ending;
+- a quarantined tier with **no registered probe** (operator
+  quarantine on an unwired tier, canary retired with its endpoint)
+  falls back to the time-based ``health_ledger_quarantine_ms``
+  cooldown instead of staying denied until restart;
 - probe successes feed the ledger exactly like in-band successes, so
   QUARANTINED → PROBATION → HEALTHY runs entirely in the background
   and ``breaker.on_tier_restored`` re-opens the fast tiers with no
@@ -80,6 +86,14 @@ _deadline_ms = config.register(
 )
 
 
+class ProbeRetired(Exception):
+    """Raised by a canary whose endpoint has been torn down (dead
+    weakref): the probe verified *nothing*, so it must not advance the
+    ledger — a success here would march a quarantined tier back to
+    HEALTHY on zero evidence. ``probe_tier`` unregisters the probe;
+    component re-wire re-registers it with live endpoints."""
+
+
 class _Probe:
     __slots__ = ("fn", "deadline_s", "description")
 
@@ -113,6 +127,13 @@ def register_probe(tier: str, fn: Callable[[], None], *,
 def unregister_probe(tier: str) -> None:
     with _probes_mu:
         _probes.pop(tier, None)
+
+
+def has_probe(tier: str) -> bool:
+    """True when a canary is registered for ``tier`` (the supervisor
+    and the ledger's lazy cooldown both branch on this)."""
+    with _probes_mu:
+        return tier in _probes
 
 
 def probes() -> dict[str, str]:
@@ -160,17 +181,27 @@ def probe_tier(tier: str, *, scope: str = ledger.GLOBAL_SCOPE) -> bool:
         deadline = max(0.05, _deadline_ms.value / 1e3)
     SPC.record("health_probes")
     from . import sentinel
+    from ..trace import span as tspan
 
     ok, cause = True, ""
     try:
         sentinel.run_bounded(p.fn, deadline, what=f"probe[{tier}]")
+    except ProbeRetired:
+        # endpoint gone: the canary verified nothing. Retire the probe
+        # (re-wire re-registers) and leave the ledger untouched — no
+        # evidence is neither a success nor a failure, and with no
+        # probe left the tier falls to the time-based cooldown.
+        unregister_probe(tier)
+        tspan.instant("health.probe", cat="health", tier=tier,
+                      ok=False, scope=scope, cause="probe_retired")
+        logger.info("health: probe for tier %r retired (endpoint "
+                    "gone)", tier)
+        return False
     except sentinel.StallError:
         ok, cause = False, "probe_timeout"
     except Exception as exc:  # commlint: allow(broadexcept)
         # any canary failure is evidence, never an error to propagate
         ok, cause = False, f"probe_{type(exc).__name__}"
-    from ..trace import span as tspan
-
     tspan.instant("health.probe", cat="health", tier=tier, ok=ok,
                   scope=scope, cause=cause or None)
     if ok:
@@ -207,6 +238,14 @@ class Supervisor(threading.Thread):
         now = _time.monotonic()
         quarantined = ledger.LEDGER.quarantined_tiers()
         for (scope, tier) in quarantined:
+            if not has_probe(tier):
+                # No canary to run (operator quarantine on an unwired
+                # tier, probe retired): the time-based cooldown is the
+                # only way back — otherwise the tier stays denied
+                # until restart, strictly worse than no supervisor.
+                self._backoffs.pop((scope, tier), None)
+                ledger.LEDGER.apply_cooldown(tier, scope=scope)
+                continue
             ent = self._backoffs.get((scope, tier))
             if ent is None:
                 ent = self._backoffs[(scope, tier)] = [Backoff(
@@ -227,18 +266,29 @@ class Supervisor(threading.Thread):
         for key in list(self._backoffs):
             if key not in live:
                 scope, tier = key
-                if ledger.LEDGER.state(tier, scope) == ledger.PROBATION:
+                if (ledger.LEDGER.state(tier, scope) == ledger.PROBATION
+                        and has_probe(tier)):
                     probe_tier(tier, scope=scope)
                 else:
                     del self._backoffs[key]
-        # slow liveness sweep over healthy registered tiers
+        # slow liveness sweep: HEALTHY tiers for silent-death
+        # detection, SUSPECT tiers so the entry can escalate to
+        # QUARANTINED or recover to HEALTHY — without probing SUSPECT
+        # a probe-fed tier dead-ends there (never quarantined, never
+        # restored, quiet() pinned false).
         if (now - self._last_sweep) * 1e3 >= _interval_ms.value:
             self._last_sweep = now
             with _probes_mu:
                 tiers = list(_probes)
             for tier in tiers:
-                if ledger.LEDGER.state(tier) == ledger.HEALTHY:
+                if ledger.LEDGER.state(tier) in (ledger.HEALTHY,
+                                                 ledger.SUSPECT):
                     probe_tier(tier)
+            # comm-scoped SUSPECT entries (in-band failures on a comm
+            # that went idle) would dead-end the same way
+            for (scope, tier) in ledger.LEDGER.suspect_tiers():
+                if scope != ledger.GLOBAL_SCOPE and has_probe(tier):
+                    probe_tier(tier, scope=scope)
         self._maybe_publish()
 
     def _maybe_publish(self) -> None:

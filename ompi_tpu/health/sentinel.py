@@ -18,8 +18,11 @@ sentinel turns a wedge into an ordinary tier fault:
   re-issued on the next healthy tier mid-flight instead of hanging
   the job. The wedged worker is abandoned (daemon thread — Python
   cannot cancel a stuck C call); its eventual result is discarded,
-  which is safe because every tier is a pure function of its input
-  buffer.
+  which is safe within one process because every tier is a pure
+  function of its input buffer. Across controllers a rank-local stall
+  leaves an extra in-flight device op behind — see the abandoned-op
+  hazard in docs/DESIGN.md §17 before arming bounded dispatch on a
+  multi-controller mesh.
 
 Off by default (``health_sentinel_deadline_ms=0``): the bounded path
 costs a thread handoff per collective, so only drills, bench sweeps

@@ -1133,7 +1133,9 @@ def _register_health_probes(engine, ep) -> None:
     def _fabric_canary() -> None:
         eng = eref()
         if eng is None:
-            return  # engine retired; re-wire re-registers
+            # torn-down engine verified nothing: retire the probe
+            # instead of reporting a success on zero evidence
+            raise health_prober.ProbeRetired("fabric engine retired")
         # pml sendrecv self-check degenerate case: one progress sweep
         # plus a live-peer count — a wedged engine hangs here and the
         # probe deadline converts the hang into a tier failure.

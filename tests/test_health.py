@@ -275,6 +275,110 @@ def test_supervisor_restore_cycle_synchronous():
     assert not sup._backoffs
 
 
+def test_suspect_tier_swept_until_quarantined_then_restored():
+    """A probe-fed SUSPECT entry must not dead-end: the liveness sweep
+    keeps probing it, so repeated failures escalate to QUARANTINED and
+    a recovered tier walks back to HEALTHY (quiet() unpinned)."""
+    saved = config.get("health_prober_interval_ms")
+    config.set("health_prober_interval_ms", 0)  # sweep every tick
+    try:
+        prober.register_probe("shm", lambda: 1 // 0,
+                              description="always fails")
+        sup = prober.Supervisor(seed=0)
+        assert not prober.probe_tier("shm")
+        assert ledger.state("shm") == ledger.SUSPECT
+        deadline = time.monotonic() + 20
+        while ledger.state("shm") != ledger.QUARANTINED:
+            assert time.monotonic() < deadline, ledger.LEDGER.snapshot()
+            sup.tick()
+        # the tier recovers: probes succeed, supervisor restores it
+        prober.register_probe("shm", lambda: None, description="ok")
+        deadline = time.monotonic() + 20
+        while ledger.state("shm") != ledger.HEALTHY:
+            assert time.monotonic() < deadline, ledger.LEDGER.snapshot()
+            sup.tick()
+            time.sleep(0.01)
+        assert ledger.quiet()
+    finally:
+        config.set("health_prober_interval_ms", saved)
+
+
+def test_comm_scoped_suspect_swept_back_to_healthy():
+    """An in-band SUSPECT entry on an idle comm is also swept (a stuck
+    SUSPECT would disable memoized routing process-wide)."""
+    saved = config.get("health_prober_interval_ms")
+    config.set("health_prober_interval_ms", 0)
+    try:
+        prober.register_probe("shm", lambda: None, description="ok")
+        ledger.report_failure("shm", scope="9", cause="t")
+        assert ledger.state("shm", "9") == ledger.SUSPECT
+        sup = prober.Supervisor(seed=0)
+        sup.tick()
+        assert ledger.state("shm", "9") == ledger.HEALTHY
+        assert ledger.quiet()
+    finally:
+        config.set("health_prober_interval_ms", saved)
+
+
+def test_quarantined_probeless_tier_cooldown_under_supervisor():
+    """A QUARANTINED tier with no registered probe must fall back to
+    the time-based cooldown under the supervisor — not stay denied
+    until restart (strictly worse than no supervisor at all)."""
+    saved = config.get("health_ledger_quarantine_ms")
+    config.set("health_ledger_quarantine_ms", 20)
+    try:
+        assert not prober.has_probe("dcn")
+        ledger.LEDGER.quarantine("dcn", cause="unwired")
+        sup = prober.Supervisor(seed=0)
+        sup.tick()  # window not elapsed: still denied
+        assert ledger.state("dcn") == ledger.QUARANTINED
+        time.sleep(0.04)
+        sup.tick()
+        assert ledger.state("dcn") == ledger.PROBATION
+        assert not ledger.LEDGER.is_denied("dcn")
+        assert not sup._backoffs  # no fruitless re-probe schedule
+    finally:
+        config.set("health_ledger_quarantine_ms", saved)
+
+
+def test_probe_retired_is_no_evidence_not_success():
+    """A canary whose endpoint weakref died raises ProbeRetired: the
+    probe is unregistered and the ledger does NOT advance — a dead
+    endpoint must not restore a quarantined tier."""
+    ledger.LEDGER.quarantine("fastpath", cause="drill")
+
+    def dead_ep_canary():
+        raise prober.ProbeRetired("endpoint retired")
+
+    prober.register_probe("fastpath", dead_ep_canary)
+    assert not prober.probe_tier("fastpath")
+    assert ledger.state("fastpath") == ledger.QUARANTINED  # untouched
+    assert "fastpath" not in prober.probes()  # retired
+    probes = _instants("health.probe")
+    assert probes and probes[-1][8]["cause"] == "probe_retired"
+
+
+def test_restore_callbacks_fire_outside_ledger_lock():
+    """Restore callbacks must run with the ledger lock released: a
+    concurrent dispatch (is_denied/state need _mu) may not block on a
+    slow callback."""
+    probed = {}
+
+    def cb(tier, scope):
+        t = threading.Thread(
+            target=lambda: probed.setdefault(
+                "state", ledger.LEDGER.state(tier, scope)))
+        t.start()
+        t.join(5.0)
+        probed["unblocked"] = not t.is_alive()
+
+    ledger.LEDGER.on_restore(cb)
+    ledger.LEDGER.quarantine("shm", scope="cbl")
+    ledger.LEDGER.restore("shm", scope="cbl")
+    assert probed.get("unblocked") is True
+    assert probed.get("state") == ledger.HEALTHY
+
+
 def test_supervisor_publishes_ledger_over_modex():
     from ompi_tpu.runtime import modex
     from ompi_tpu.trace import recorder as trec
