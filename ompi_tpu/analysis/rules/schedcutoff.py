@@ -1,0 +1,121 @@
+"""Schedule-cutoff hygiene rule.
+
+``schedcutoff``: algorithm-selection code must not grow new hard-coded
+byte thresholds. Since the schedule compiler landed (coll/sched/), the
+single sanctioned home for static size cutoffs is ``sched/priors.py``
+— the cold-start prior the autotuner's cache overrides. A literal
+``nbytes < 65536``-style compare inside a ``decide_*`` / ``prior_*`` /
+``pick_*`` function anywhere else in coll/ is a tuning decision the
+cache can never learn past: it silently wins over measured winners and
+drifts out of sync with the bucket boundaries the cache keys on.
+
+Flagged: comparisons of a bytes/size-named value against an integer
+literal (including const folds like ``64 << 10``) inside an
+algorithm-pick function under coll/, outside sched/priors.py.
+Cvar-backed thresholds (``_small.value``) are fine — those are
+operator-tunable, not hard-coded. Legacy tables predating the rule
+carry ``# commlint: allow(schedcutoff)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..report import Severity
+from . import COMMLINT, LintRule, scope_walk
+
+#: Function-name prefixes that mark an algorithm-pick scope.
+_PICK_PREFIXES = ("decide", "prior", "pick", "choose", "select_algo")
+
+#: Smallest literal treated as a byte threshold — filters out rank
+#: counts and loop bounds that share the compare shape.
+_MIN_THRESHOLD = 512
+
+#: Identifier substrings that mark the compared value as a byte size.
+_SIZE_MARKERS = ("byte", "size", "msglen")
+
+
+def _const_int(node: ast.AST) -> Optional[int]:
+    """Fold an integer-literal expression: 4096, 64 << 10, 4 * 1024."""
+    if isinstance(node, ast.Constant):
+        v = node.value
+        return v if isinstance(v, int) and not isinstance(v, bool) \
+            else None
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        v = _const_int(node.operand)
+        return -v if v is not None else None
+    if isinstance(node, ast.BinOp):
+        lhs, rhs = _const_int(node.left), _const_int(node.right)
+        if lhs is None or rhs is None:
+            return None
+        if isinstance(node.op, ast.LShift):
+            return lhs << rhs
+        if isinstance(node.op, ast.Mult):
+            return lhs * rhs
+        if isinstance(node.op, ast.Add):
+            return lhs + rhs
+        if isinstance(node.op, ast.Sub):
+            return lhs - rhs
+        if isinstance(node.op, ast.Pow) and 0 <= rhs < 64:
+            return lhs ** rhs
+    return None
+
+
+def _is_size_expr(node: ast.AST) -> bool:
+    """True when the expression reads like a byte count: any Name or
+    Attribute whose identifier mentions bytes/size."""
+    for sub in ast.walk(node):
+        ident = None
+        if isinstance(sub, ast.Name):
+            ident = sub.id
+        elif isinstance(sub, ast.Attribute):
+            ident = sub.attr
+        if ident and any(m in ident.lower() for m in _SIZE_MARKERS):
+            return True
+    return False
+
+
+def _in_coll(relpath: str) -> bool:
+    p = "/" + relpath
+    return "/coll/" in p and not p.endswith("/sched/priors.py")
+
+
+@COMMLINT.register
+class SchedCutoffRule(LintRule):
+    NAME = "schedcutoff"
+    PRIORITY = 45
+    DESCRIPTION = ("hard-coded byte-threshold algorithm picks in coll/ "
+                   "belong in sched/priors.py (the tuner's cold-start "
+                   "prior), not inline")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        if not _in_coll(ctx.relpath):
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if not fn.name.lstrip("_").startswith(_PICK_PREFIXES):
+                continue
+            for node in scope_walk(fn):
+                if not isinstance(node, ast.Compare):
+                    continue
+                operands = [node.left, *node.comparators]
+                lits = [v for n in operands
+                        if (v := _const_int(n)) is not None]
+                if not lits or max(lits) < _MIN_THRESHOLD:
+                    continue
+                if not any(_is_size_expr(n) for n in operands
+                           if _const_int(n) is None):
+                    continue
+                if ctx.suppressed(node.lineno, self.NAME):
+                    continue
+                yield self.finding(
+                    ctx, node,
+                    f"hard-coded byte threshold ({max(lits)}) in "
+                    f"algorithm pick `{fn.name}` — move the cutoff to "
+                    "sched/priors.py (cold-start prior) or a cvar so "
+                    "the schedule cache can override it",
+                )

@@ -61,23 +61,16 @@ _cooldown = config.register(
 
 CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
 
-#: Degradation chain: every tier's next-cheaper fallback. Terminal is
-#: gather_reduce — the ordered, pure-XLA tier every input shape/pytree
-#: accepts (the "basic" of the driver model).
-NEXT_TIER = {
-    "quant_pallas": "quant_ring",
-    "quant_ring": "ring",
-    "pallas_ring": "ring",
-    "pallas_bidir": "ring",
-    "pallas_rd": "ring",
-    "pallas_ring_chunked": "ring",
-    "pallas_rsag": "ring",
-    "ring_segmented": "ring",
-    "recursive_doubling": "ring",
-    "ring": "gather_reduce",
-    "native": "gather_reduce",
-}
-TERMINAL = "gather_reduce"
+# Degradation chain: derived from the schedule lattice (coll/sched/
+# lattice.py) — the single declarative algorithm -> (tier, fallback)
+# map that health/ledger's tier_of_algo also reads. The breaker's
+# routing is a deny-set walk over that lattice where the deny set is
+# the OPEN/denied tiers of the moment. sched/lattice is pure data
+# (stdlib only), so this import cannot cycle.
+from .sched import lattice as _lattice  # noqa: E402
+
+NEXT_TIER = _lattice.fallback_map()
+TERMINAL = _lattice.TERMINAL
 
 
 class _Tier:

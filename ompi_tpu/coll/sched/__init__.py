@@ -1,0 +1,233 @@
+"""coll/sched: the schedule compiler.
+
+Collective algorithm choice as a compiler pipeline instead of an
+if-ladder:
+
+- ``ir``        declarative chunk/step programs (Schedule) + generators
+                (ring, recursive doubling, segmented ring, hierarchical,
+                quantized wire) parameterized by topology
+- ``lower``     Schedule -> fused jitted callable, plus the validity
+                checker (bit-identical vs the ring reference tier)
+- ``lattice``   the algorithm/tier/fallback lattice (breaker + health
+                derive from it; routing = deny-set walk)
+- ``priors``    the static cold-start decision tables
+- ``cache``     versioned on-disk winner cache (fleet warms once)
+- ``autotune``  the candidate sweep that fills the cache
+
+This package module is import-light (ir + lattice only); everything
+that touches jax, config, or the filesystem loads lazily through the
+functions below. ``lookup`` is the dispatch-path entry: tuned's
+decide_* consult it first and fall back to the priors only on a cache
+miss.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from . import ir, lattice
+from .ir import Schedule, ScheduleError
+
+#: (algo, nranks) -> built Schedule (construction is pure python; the
+#: lowering memo in lower.py is keyed by digest underneath this).
+_SCHED_MEMO: dict = {}
+
+#: Algorithms this package registers into tuned.ALLREDUCE_ALGOS.
+ALGOS = ("sched_ring", "sched_rd", "sched_ring_seg", "sched_hier",
+         "sched_quant")
+
+
+# ---------------------------------------------------------------------------
+# topology-aware schedule construction
+# ---------------------------------------------------------------------------
+
+def _topo_order(nranks: int) -> Optional[list]:
+    """ICI-aware ring order when the live mesh matches ``nranks``
+    (identity/None otherwise — e.g. CPU meshes or sub-communicators)."""
+    try:
+        from ...runtime import mesh
+
+        procs = mesh.discover()
+        if len(procs) == nranks:
+            return mesh.ring_order(procs)
+    except Exception:  # commlint: allow(broadexcept)
+        pass
+    return None
+
+
+def _host_groups(nranks: int) -> list:
+    """Host-grouped rank partition for the hierarchical schedule;
+    a single group when the live mesh doesn't match ``nranks``."""
+    try:
+        from ...runtime import mesh
+
+        procs = mesh.discover()
+        if len(procs) == nranks:
+            groups = [sorted(p.rank for p in g)
+                      for _h, g in sorted(mesh.hosts_of(procs).items())]
+            if sum(len(g) for g in groups) == nranks:
+                return groups
+    except Exception:  # commlint: allow(broadexcept)
+        pass
+    return [list(range(nranks))]
+
+
+def build_schedule(algo: str, nranks: int, *, segments: int = 2,
+                   groups=None) -> Schedule:
+    """Build (memoized) the Schedule behind a registered sched_* name,
+    enriched with live topology (ring order, host groups) when the
+    mesh matches."""
+    key = (algo, nranks, segments,
+           tuple(map(tuple, groups)) if groups else None)
+    if algo == "sched_quant":
+        from .. import quant
+
+        # the wire codec is part of the program; a cvar flip must
+        # rebuild, not hit the memo
+        key = key + (quant._wire_var.value, quant._block_var.value)
+    sch = _SCHED_MEMO.get(key)
+    if sch is not None:
+        return sch
+    if algo == "sched_ring":
+        sch = ir.ring(nranks, order=_topo_order(nranks))
+    elif algo == "sched_rd":
+        if nranks & (nranks - 1):
+            # degrade like tuned's pallas_rd guard: a rules file naming
+            # rd on a non-power-of-two world gets the ring, not a trace
+            # error
+            sch = ir.ring(nranks, order=_topo_order(nranks))
+        else:
+            sch = ir.recursive_doubling(nranks)
+    elif algo == "sched_ring_seg":
+        sch = ir.segmented_ring(nranks, segments,
+                                order=_topo_order(nranks))
+    elif algo == "sched_hier":
+        sch = ir.hierarchical(groups or _host_groups(nranks))
+    elif algo == "sched_quant":
+        from .. import quant
+
+        sch = ir.quantized_wire(nranks, quant._wire_var.value,
+                                quant._block_var.value,
+                                order=_topo_order(nranks))
+    else:
+        raise ScheduleError(f"unknown sched algorithm {algo!r}; "
+                            f"known: {list(ALGOS)}")
+    _SCHED_MEMO[key] = sch
+    return sch
+
+
+def clear_schedules() -> None:
+    """Forget built schedules and lowerings (tests / re-init)."""
+    from . import lower as _lower
+
+    _SCHED_MEMO.clear()
+    _lower.clear_lowered()
+
+
+# ---------------------------------------------------------------------------
+# registered algorithm wrappers (ALLREDUCE_ALGOS signature)
+# ---------------------------------------------------------------------------
+
+def _run(algo: str, x, axis_name: str, op):
+    from jax import lax
+
+    from . import lower as _lower
+
+    sch = build_schedule(algo, lax.axis_size(axis_name))
+    return _lower.lower(sch)(x, axis_name, op)
+
+
+def allreduce_sched_ring(x, axis_name, op):
+    return _run("sched_ring", x, axis_name, op)
+
+
+def allreduce_sched_rd(x, axis_name, op):
+    return _run("sched_rd", x, axis_name, op)
+
+
+def allreduce_sched_ring_seg(x, axis_name, op):
+    return _run("sched_ring_seg", x, axis_name, op)
+
+
+def allreduce_sched_hier(x, axis_name, op):
+    return _run("sched_hier", x, axis_name, op)
+
+
+def allreduce_sched_quant(x, axis_name, op):
+    return _run("sched_quant", x, axis_name, op)
+
+
+# ---------------------------------------------------------------------------
+# dispatch-path cache consult
+# ---------------------------------------------------------------------------
+
+def _usable(opname: str, algo: str, dtype, op) -> bool:
+    """Is a cached winner selectable right now? Guards the cases where
+    the cache was tuned under settings the current call doesn't meet
+    (quant consent/support, unknown algorithm after a version skew)."""
+    from .. import tuned
+
+    if algo not in tuned._algo_space(opname) and algo not in ALGOS:
+        return False
+    if tuned.is_quant_algo(algo) or algo == "sched_quant":
+        from .. import quant
+
+        if not quant._enable_var.value:
+            return False
+        if not quant.supports(op or "sum", dtype):
+            return False
+    return True
+
+
+def lookup(opname: str, nbytes_per_rank: int, nranks: int, dtype=None,
+           op=None) -> Optional[str]:
+    """The compiled-schedule cache consult. Returns the tuned winner's
+    algorithm name, or None (miss / disabled / unusable winner) — the
+    caller then falls back to the static priors. Emits
+    sched.cache_hit/sched.cache_miss instants and the matching SPC
+    counters; misses are only counted once the cache is active so an
+    untuned fleet doesn't drown monitoring in miss noise."""
+    from . import autotune, cache as _cache
+
+    if not _cache._enable_var.value:
+        return None
+    fp = autotune.fingerprint()
+    _cache.CACHE.ensure_loaded(fp, nranks)
+    if not _cache.CACHE.active():
+        return None
+    from ...core.counters import SPC
+    from ...trace import span as tspan
+
+    key = _cache.cache_key(opname, nbytes_per_rank, nranks, dtype, fp)
+    ent = _cache.CACHE.get(key)
+    if ent is None:
+        SPC.record("sched_cache_misses")
+        tspan.instant("sched.cache_miss", cat="sched", key=key)
+        return None
+    algo = ent.get("algorithm", "")
+    if not _usable(opname, algo, dtype, op):
+        SPC.record("sched_cache_misses")
+        tspan.instant("sched.cache_miss", cat="sched", key=key,
+                      algo=algo, reason="unusable")
+        return None
+    SPC.record("sched_cache_hits")
+    tspan.instant("sched.cache_hit", cat="sched", key=key, algo=algo)
+    return algo
+
+
+def warm(nranks: int, **kw) -> dict:
+    """Offline cache warm: run the autotuner (model mode by default —
+    no devices needed) and persist winners to the default path. The
+    tools/sched CLI front-ends this."""
+    from . import autotune
+
+    return autotune.tune(nranks, **kw)
+
+
+__all__ = [
+    "ALGOS", "Schedule", "ScheduleError", "allreduce_sched_hier",
+    "allreduce_sched_quant", "allreduce_sched_rd",
+    "allreduce_sched_ring", "allreduce_sched_ring_seg",
+    "build_schedule", "clear_schedules", "ir", "lattice", "lookup",
+    "warm",
+]

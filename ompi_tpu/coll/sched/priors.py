@@ -1,0 +1,154 @@
+"""Cold-start priors: the static algorithm tables.
+
+This is where the reference's fixed decision rules
+(coll_tuned_decision_fixed.c) now live — demoted from *the* decision
+to the cold-start prior consulted only when the compiled-schedule
+cache has no tuned winner for the (op, size-bucket, dtype, nranks,
+topology) key. The byte thresholds themselves stay on the coll_tuned
+cvar surface (tuned.py registers them; users override them the same
+way as before) — this module owns the *logic* that turns thresholds
+into picks, and the commlint ``schedcutoff`` rule keeps new hard-coded
+byte cutoffs from growing anywhere in coll/ except here.
+
+Every ``nbytes`` parameter below is BYTES PER RANK (the block size of
+the rank-major payload, tuned._nbytes) — the single byte convention
+shared with Rules bands and sched/cache size buckets.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...ops import Op
+from ...ops.op import _is_joint
+
+
+def _t():
+    # tuned registers the cvars and imports this module lazily from its
+    # decide_* bodies, so by first call the module object exists.
+    from .. import tuned
+
+    return tuned
+
+
+def prior_allreduce(op: Op, nbytes: int, nranks: int, dtype=None,
+                    allow_quant: Optional[bool] = None,
+                    rules=None) -> str:
+    """Reference regime: recursive doubling < 10 KB/rank, ring to
+    1 MiB/rank, segmented ring above — with the TPU-first native
+    preference and the quantized-wire gate ahead of both."""
+    t = _t()
+    from .. import quant
+
+    # Quantized wire: before native — trading representable values for
+    # wire bytes only pays on the wire-bound (large, floating, SUM)
+    # band, and only when the user (cvar/caller) and rules all agree.
+    if allow_quant is None:
+        allow_quant = quant._enable_var.value
+    if (allow_quant
+            and nbytes >= quant._min_bytes_var.value
+            and quant.supports(op, dtype)
+            and (rules is None
+                 or rules.allows_quant("allreduce", nbytes, nranks,
+                                       dtype))):
+        return "quant_ring"
+    if t._prefer_native.value and op.xla_reduce is not None:
+        return "native"
+    if nbytes < t._small.value:
+        return "recursive_doubling"
+    if nbytes <= t._ring_limit.value:
+        return "ring"
+    return "ring_segmented"
+
+
+def prior_alltoall(nbytes_per_dest: int, nranks: int) -> str:
+    t = _t()
+    if nbytes_per_dest <= t._alltoall_small.value and nranks >= 8:
+        return "bruck"
+    if nbytes_per_dest >= t._alltoall_large.value:
+        return "pairwise"
+    return "native"
+
+
+def prior_allgather(nbytes: int, nranks: int) -> str:
+    return "native"
+
+
+def prior_bcast(nbytes: int, nranks: int) -> str:
+    """Reference regime (coll_tuned_decision_fixed.c:250-310): binomial
+    small, binary tree mid-size, segmented pipeline for bulk; native
+    wins when preferred — XLA already emits the ICI-optimal schedule."""
+    t = _t()
+    if t._prefer_native.value:
+        return "native"
+    if nbytes < t._small.value:
+        return "binomial"
+    if nbytes < t._large.value:
+        return "binary"
+    return "pipelined"
+
+
+def prior_scan(op: Op, nbytes: int, nranks: int) -> str:
+    t = _t()
+    if _is_joint(op):
+        return "native"
+    if t._prefer_native.value:
+        return "native"
+    if nbytes < t._small.value:
+        return "recursive_doubling"
+    return "native"
+
+
+def prior_exscan(op: Op, nbytes: int, nranks: int) -> str:
+    return prior_scan(op, nbytes, nranks)
+
+
+def prior_reduce(op: Op, nbytes: int, nranks: int) -> str:
+    """Reference: binomial small, pipelined chains above; the ordered
+    native path for non-commutative ops."""
+    t = _t()
+    if not op.commutative or _is_joint(op):
+        return "native"  # ordered handling lives in the algo fallback
+    if t._prefer_native.value and op.xla_reduce is not None:
+        return "native"
+    if nbytes < t._small.value:
+        return "binomial"
+    if nbytes >= t._large.value:
+        return "pipelined"  # segmented chain (reference pipeline tier)
+    return "native"
+
+
+def prior_reduce_scatter(op: Op, nbytes: int, nranks: int) -> str:
+    """Reference: coll_base_reduce_scatter.c — recursive halving for
+    small commutative power-of-two cases, ring for large."""
+    t = _t()
+    if not op.commutative or _is_joint(op):
+        # ring/halving accumulate out of rank order; the native path's
+        # ordered gather-reduce fallback is the only correct one
+        return "native"
+    if t._prefer_native.value and op.xla_reduce is not None:
+        return "native"
+    pof2 = nranks & (nranks - 1) == 0
+    if op.commutative and pof2 and nbytes < t._small.value:
+        return "recursive_halving"
+    return "ring"
+
+
+def prior_gather(nbytes: int, nranks: int) -> str:
+    t = _t()
+    if nbytes < t._gather_binomial_max.value and nranks >= 4:
+        return "binomial"
+    return "native"
+
+
+def prior_scatter(nbytes: int, nranks: int) -> str:
+    # Always native: on a single controller scatter is a pure reshard;
+    # the tree forms are reachable only by forced var or rules file.
+    return "native"
+
+
+__all__ = [
+    "prior_allgather", "prior_allreduce", "prior_alltoall",
+    "prior_bcast", "prior_exscan", "prior_gather", "prior_reduce",
+    "prior_reduce_scatter", "prior_scan", "prior_scatter",
+]

@@ -166,6 +166,23 @@ def _quant_algos() -> None:
     ALLREDUCE_ALGOS["quant_pallas"] = quant.allreduce_block_quant
 
 
+def _sched_algos() -> None:
+    """Extend the allreduce space with the schedule-compiler tier
+    (coll/sched): IR programs lowered to fused jitted callables. Lazy
+    like _pallas_algos — the names are selectable from rules files,
+    forced vars and the schedule cache before the package is
+    imported."""
+    if "sched_ring" in ALLREDUCE_ALGOS:
+        return
+    from . import sched
+
+    ALLREDUCE_ALGOS["sched_ring"] = sched.allreduce_sched_ring
+    ALLREDUCE_ALGOS["sched_rd"] = sched.allreduce_sched_rd
+    ALLREDUCE_ALGOS["sched_ring_seg"] = sched.allreduce_sched_ring_seg
+    ALLREDUCE_ALGOS["sched_hier"] = sched.allreduce_sched_hier
+    ALLREDUCE_ALGOS["sched_quant"] = sched.allreduce_sched_quant
+
+
 def is_pallas_algo(name: str) -> bool:
     # quant_pallas is a Mosaic kernel too: same check_vma exemption.
     return name.startswith("pallas") or name == "quant_pallas"
@@ -175,6 +192,42 @@ def is_quant_algo(name: str) -> bool:
     return name.startswith("quant")
 
 
+def is_sched_algo(name: str) -> bool:
+    """Schedule-compiler tier names (lowered IR programs)."""
+    return name.startswith("sched_")
+
+
+def _ensure_lazy(algo: str) -> None:
+    """Trigger whichever lazy tier registration ``algo`` needs."""
+    if is_pallas_algo(algo):
+        _pallas_algos()
+    if is_quant_algo(algo):
+        _quant_algos()
+    if is_sched_algo(algo):
+        _sched_algos()
+
+
+def _resolve_algo(opname: str, algo: str):
+    """The callable behind an algorithm name (None if unknown),
+    triggering lazy tier registrations on demand — how the sched
+    autotuner and tools sweeps resolve candidates by name."""
+    _ensure_lazy(algo)
+    spaces = {
+        "allreduce": ALLREDUCE_ALGOS,
+        "alltoall": ALLTOALL_ALGOS,
+        "allgather": ALLGATHER_ALGOS,
+        "bcast": BCAST_ALGOS,
+        "reduce": REDUCE_ALGOS,
+        "scan": SCAN_ALGOS,
+        "exscan": EXSCAN_ALGOS,
+        "reduce_scatter": REDUCE_SCATTER_ALGOS,
+        "gather": GATHER_ALGOS,
+        "scatter": SCATTER_ALGOS,
+    }
+    space = spaces.get(opname)
+    return None if space is None else space.get(algo)
+
+
 #: Algorithm names that exist but are registered lazily (importing
 #: pallas pulls in Mosaic; importing quant is cheap but kept symmetric).
 #: Rules-file validation must know them without forcing the import.
@@ -182,6 +235,8 @@ _LAZY_ALGOS: dict[str, frozenset] = {
     "allreduce": frozenset({
         "pallas_ring", "pallas_bidir", "pallas_rd", "pallas_ring_chunked",
         "pallas_rsag", "quant_ring", "quant_pallas",
+        "sched_ring", "sched_rd", "sched_ring_seg", "sched_hier",
+        "sched_quant",
     }),
     "bcast": frozenset({"pallas_binomial"}),
     "allgather": frozenset({"pallas_ring"}),
@@ -388,13 +443,31 @@ def _nbytes(x) -> int:
     return total
 
 
+def _sched_lookup(opname: str, nbytes: int, nranks: int, dtype=None,
+                  op=None) -> Optional[str]:
+    """Compiled-schedule cache consult (the precedence slot between the
+    correctness guards and the static priors). ``nbytes`` is bytes per
+    rank — the same convention as Rules bands and the cache's size
+    buckets."""
+    from . import sched
+
+    return sched.lookup(opname, nbytes, nranks, dtype=dtype, op=op)
+
+
 def decide_allreduce(op: Op, nbytes: int, nranks: int, dtype=None,
                      allow_quant: Optional[bool] = None) -> str:
     """Pick the allreduce algorithm; precision-aware since the quant
-    tier exists.  ``dtype`` is the payload element type (None = unknown
-    → quant refused).  ``allow_quant`` overrides the coll_quant_enable
-    cvar (True forces consideration, False vetoes); user rules can veto
-    per band via ``"allow_quant": false``."""
+    tier exists.  ``nbytes`` is BYTES PER RANK (the block size of the
+    rank-major payload, see _nbytes) — the one byte convention shared
+    by Rules bands, the schedule cache's size buckets and the priors.
+    ``dtype`` is the payload element type (None = unknown → quant
+    refused).  ``allow_quant`` overrides the coll_quant_enable cvar
+    (True forces consideration, False vetoes); user rules can veto per
+    band via ``"allow_quant": false``.
+
+    Precedence: forced var > rules file > correctness guard
+    (non-commutative/joint → ordered gather_reduce) > tuned
+    compiled-schedule cache > static priors (sched/priors)."""
     forced = _force_allreduce.value
     if forced:
         return forced
@@ -405,27 +478,17 @@ def decide_allreduce(op: Op, nbytes: int, nranks: int, dtype=None,
             return got
     if not op.commutative or _is_joint(op):
         return "gather_reduce"
-    # Quantized wire: before native — trading representable values for
-    # wire bytes only pays on the wire-bound (large, floating, SUM)
-    # band, and only when the user (cvar/caller) and rules all agree.
-    from . import quant
+    tuned_pick = _sched_lookup("allreduce", nbytes, nranks, dtype, op)
+    if tuned_pick:
+        if allow_quant is False and (is_quant_algo(tuned_pick)
+                                     or tuned_pick == "sched_quant"):
+            tuned_pick = None  # caller's explicit lossy-wire veto wins
+        if tuned_pick:
+            return tuned_pick
+    from .sched import priors
 
-    if allow_quant is None:
-        allow_quant = quant._enable_var.value
-    if (allow_quant
-            and nbytes >= quant._min_bytes_var.value
-            and quant.supports(op, dtype)
-            and (rules is None
-                 or rules.allows_quant("allreduce", nbytes, nranks,
-                                       dtype))):
-        return "quant_ring"
-    if _prefer_native.value and op.xla_reduce is not None:
-        return "native"
-    if nbytes < _small.value:
-        return "recursive_doubling"
-    if nbytes <= _ring_limit.value:
-        return "ring"
-    return "ring_segmented"
+    return priors.prior_allreduce(op, nbytes, nranks, dtype,
+                                  allow_quant, rules)
 
 
 def decide_alltoall(nbytes_per_dest: int, nranks: int) -> str:
@@ -437,11 +500,12 @@ def decide_alltoall(nbytes_per_dest: int, nranks: int) -> str:
         got = rules.decide("alltoall", nbytes_per_dest, nranks)
         if got:
             return got
-    if nbytes_per_dest <= _alltoall_small.value and nranks >= 8:
-        return "bruck"
-    if nbytes_per_dest >= _alltoall_large.value:
-        return "pairwise"
-    return "native"
+    got = _sched_lookup("alltoall", nbytes_per_dest, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_alltoall(nbytes_per_dest, nranks)
 
 
 def decide_allgather(nbytes: int, nranks: int) -> str:
@@ -453,7 +517,12 @@ def decide_allgather(nbytes: int, nranks: int) -> str:
         got = rules.decide("allgather", nbytes, nranks)
         if got:
             return got
-    return "native"
+    got = _sched_lookup("allgather", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_allgather(nbytes, nranks)
 
 
 def decide_bcast(nbytes: int, nranks: int) -> str:
@@ -471,13 +540,12 @@ def decide_bcast(nbytes: int, nranks: int) -> str:
         got = rules.decide("bcast", nbytes, nranks)
         if got:
             return got
-    if _prefer_native.value:
-        return "native"
-    if nbytes < _small.value:
-        return "binomial"
-    if nbytes < _large.value:
-        return "binary"
-    return "pipelined"
+    got = _sched_lookup("bcast", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_bcast(nbytes, nranks)
 
 
 def decide_scan(op: Op, nbytes: int, nranks: int) -> str:
@@ -494,11 +562,12 @@ def decide_scan(op: Op, nbytes: int, nranks: int) -> str:
             return got
     if _is_joint(op):
         return "native"
-    if _prefer_native.value:
-        return "native"
-    if nbytes < _small.value:
-        return "recursive_doubling"
-    return "native"
+    got = _sched_lookup("scan", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_scan(op, nbytes, nranks)
 
 
 def decide_exscan(op: Op, nbytes: int, nranks: int) -> str:
@@ -512,11 +581,12 @@ def decide_exscan(op: Op, nbytes: int, nranks: int) -> str:
             return got
     if _is_joint(op):
         return "native"
-    if _prefer_native.value:
-        return "native"
-    if nbytes < _small.value:
-        return "recursive_doubling"
-    return "native"
+    got = _sched_lookup("exscan", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_exscan(op, nbytes, nranks)
 
 
 def decide_reduce(op: Op, nbytes: int, nranks: int) -> str:
@@ -535,13 +605,12 @@ def decide_reduce(op: Op, nbytes: int, nranks: int) -> str:
             return got
     if not op.commutative or _is_joint(op):
         return "native"  # ordered handling lives in the algo fallback
-    if _prefer_native.value and op.xla_reduce is not None:
-        return "native"
-    if nbytes < _small.value:
-        return "binomial"
-    if nbytes >= _large.value:
-        return "pipelined"  # segmented chain (reference pipeline tier)
-    return "native"
+    got = _sched_lookup("reduce", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_reduce(op, nbytes, nranks)
 
 
 def decide_reduce_scatter(op: Op, nbytes: int, nranks: int) -> str:
@@ -559,12 +628,12 @@ def decide_reduce_scatter(op: Op, nbytes: int, nranks: int) -> str:
         # ring/halving accumulate out of rank order; the native path's
         # ordered gather-reduce fallback is the only correct one
         return "native"
-    if _prefer_native.value and op.xla_reduce is not None:
-        return "native"
-    pof2 = nranks & (nranks - 1) == 0
-    if op.commutative and pof2 and nbytes < _small.value:
-        return "recursive_halving"
-    return "ring"
+    got = _sched_lookup("reduce_scatter", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_reduce_scatter(op, nbytes, nranks)
 
 
 def decide_gather(nbytes: int, nranks: int) -> str:
@@ -576,9 +645,12 @@ def decide_gather(nbytes: int, nranks: int) -> str:
         got = rules.decide("gather", nbytes, nranks)
         if got:
             return got
-    if nbytes < _gather_binomial_max.value and nranks >= 4:
-        return "binomial"
-    return "native"
+    got = _sched_lookup("gather", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_gather(nbytes, nranks)
 
 
 def decide_scatter(nbytes: int, nranks: int) -> str:
@@ -597,7 +669,12 @@ def decide_scatter(nbytes: int, nranks: int) -> str:
         got = rules.decide("scatter", nbytes, nranks)
         if got:
             return got
-    return "native"
+    got = _sched_lookup("scatter", nbytes, nranks)
+    if got:
+        return got
+    from .sched import priors
+
+    return priors.prior_scatter(nbytes, nranks)
 
 
 def allreduce_by_decision(x: jax.Array, axis_name: str, op,
@@ -623,10 +700,7 @@ def allreduce_by_decision(x: jax.Array, axis_name: str, op,
     from . import breaker
 
     algo = breaker.route("allreduce", algo)
-    if is_pallas_algo(algo):
-        _pallas_algos()
-    if is_quant_algo(algo):
-        _quant_algos()
+    _ensure_lazy(algo)
     fn = ALLREDUCE_ALGOS.get(algo)
     if fn is None:
         raise ArgumentError(
@@ -642,7 +716,7 @@ def allreduce_by_decision(x: jax.Array, axis_name: str, op,
 
     tspan.instant("tuned.tier", cat="coll", op="allreduce",
                   algo=algo, nbytes=nbytes)
-    if is_quant_algo(algo):
+    if is_quant_algo(algo) or algo == "sched_quant":
         from . import quant
 
         quant.record_wire_stats(nbytes, x.dtype.itemsize)
@@ -681,10 +755,7 @@ class TunedColl(XlaColl):
 
         algo = breaker.route("allreduce", algo, deny=deny,
                              scope=str(comm.cid))
-        if is_pallas_algo(algo):
-            _pallas_algos()
-        if is_quant_algo(algo):
-            _quant_algos()
+        _ensure_lazy(algo)
         fn = ALLREDUCE_ALGOS.get(algo)
         if fn is None:
             raise ArgumentError(
@@ -699,7 +770,7 @@ class TunedColl(XlaColl):
             fn = ALLREDUCE_ALGOS["gather_reduce"]
             algo = "gather_reduce"
         key = ("allreduce", algo, op.cache_key, _dtype_key(x))
-        if is_quant_algo(algo):
+        if is_quant_algo(algo) or algo == "sched_quant":
             from . import quant
 
             wire = quant._wire_var.value
@@ -753,8 +824,11 @@ class TunedColl(XlaColl):
         from ..health import ledger as health
         from . import breaker
 
+        from .sched import cache as sched_cache
+
         stamp = (config.generation(), breaker.generation(),
-                 health.LEDGER.generation())
+                 health.LEDGER.generation(),
+                 sched_cache.CACHE.generation())
         cache = comm.__dict__.setdefault("_tuned_fast", {})
         key = (x.shape, x.dtype.name, op.cache_key)
         ent = cache.get(key)

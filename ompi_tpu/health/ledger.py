@@ -95,17 +95,23 @@ TIERS = ("device", "fastpath", "shm", "dcn", "fabric", "host")
 
 GLOBAL_SCOPE = "global"
 
-#: Collective algorithm -> the transport tier it rides. Everything
-#: that launches an XLA/pallas program reduces over the device fabric;
-#: gather_reduce is the host tier.
+#: Fallback algorithm -> tier map, used only if the schedule lattice
+#: (coll/sched/lattice.py — the authoritative source) is unimportable.
 _ALGO_TIER = {
     "gather_reduce": "host",
 }
 
 
 def tier_of_algo(algo: str) -> str:
-    """The transport tier a collective algorithm executes on."""
-    return _ALGO_TIER.get(algo, "device")
+    """The transport tier a collective algorithm executes on.
+    Delegates to the schedule lattice — the single declarative
+    algorithm -> (tier, fallback) map that coll/breaker also derives
+    its degradation chain from."""
+    try:
+        from ..coll.sched import lattice
+    except ImportError:
+        return _ALGO_TIER.get(algo, "device")
+    return lattice.tier_of(algo)
 
 
 class _Entry:

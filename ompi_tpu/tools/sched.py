@@ -1,0 +1,143 @@
+"""Schedule-cache CLI: list, warm, and dump compiled schedules.
+
+Front-end for coll/sched — the schedule compiler's operational
+surface:
+
+    # what winners does the cache hold (and for which topology)?
+    python -m ompi_tpu.tools.sched list
+
+    # warm the cache offline (model mode: no devices needed) so the
+    # fleet's first collective dispatches a tuned winner instead of
+    # paying first-call tune cost
+    python -m ompi_tpu.tools.sched warm --nranks 8
+
+    # print a schedule's step program (the IR the lowering compiles)
+    python -m ompi_tpu.tools.sched dump --name ring --nranks 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def _cmd_list(args) -> int:
+    from ..coll.sched import cache
+
+    if args.file:
+        n = cache.CACHE.load(args.file)
+        print(f"loaded {n} entr{'y' if n == 1 else 'ies'} from "
+              f"{args.file}")
+    else:
+        d = cache.cache_dir()
+        if not os.path.isdir(d):
+            print(f"no schedule cache at {d}")
+            return 0
+        for name in sorted(os.listdir(d)):
+            if name.endswith(".json"):
+                cache.CACHE.load(os.path.join(d, name))
+    entries = cache.CACHE.entries()
+    if not entries:
+        print("schedule cache is empty")
+        return 0
+    print(f"{len(entries)} cached schedule(s) "
+          f"(digest {cache.CACHE.digest()[:16]}):")
+    for key in sorted(entries):
+        e = entries[key]
+        extra = f" [{e['schedule']}]" if e.get("schedule") else ""
+        print(f"  {key:<48} -> {e['algorithm']}{extra} "
+              f"({e.get('source', '?')})")
+    return 0
+
+
+def _cmd_warm(args) -> int:
+    from ..coll.sched import autotune
+
+    res = autotune.tune(
+        args.nranks, mode=args.mode,
+        seed=args.seed, save=not args.dry_run,
+        topo_fp=args.topo or None,
+    )
+    print(f"tuned {len(res['winners'])} key(s) in "
+          f"{res['tune_ms']:.1f} ms (mode={res['mode']})")
+    if res["skipped"]:
+        print(f"skipped (quarantined tier): {', '.join(res['skipped'])}")
+    if res["path"]:
+        print(f"saved {res['path']}")
+    print(f"digest {res['digest']}")
+    if args.json:
+        print(json.dumps({k: v for k, v in res.items()
+                          if k != "times"}, indent=2, sort_keys=True))
+    return 0
+
+
+def _cmd_dump(args) -> int:
+    from ..coll.sched import ir
+
+    params = {}
+    if args.segments is not None:
+        params["segments"] = args.segments
+    if args.wire:
+        params["wire"] = args.wire
+    sched = ir.generate(args.name, args.nranks, **params)
+    print(sched.render())
+    print(f"# digest {sched.digest()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu.tools.sched")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    ls = sub.add_parser("list", help="show cached schedule winners")
+    ls.add_argument("--file", default="",
+                    help="load one cache file instead of scanning the "
+                         "cache directory")
+    ls.set_defaults(fn=_cmd_list)
+
+    wm = sub.add_parser("warm", help="run the autotuner, persist "
+                                     "winners (offline-capable)")
+    wm.add_argument("--nranks", type=int, required=True)
+    wm.add_argument("--mode", choices=("model", "measure"),
+                    default="model")
+    wm.add_argument("--seed", type=int, default=None)
+    wm.add_argument("--topo", default="",
+                    help="topology fingerprint override (default: "
+                         "this machine's)")
+    wm.add_argument("--dry-run", action="store_true",
+                    help="tune but do not write the cache file")
+    wm.add_argument("--json", action="store_true",
+                    help="also print the full result as JSON")
+    wm.set_defaults(fn=_cmd_warm)
+
+    dp = sub.add_parser("dump", help="print a schedule's step program")
+    dp.add_argument("--name", required=True,
+                    help="generator name (ring, recursive_doubling, "
+                         "segmented_ring, hierarchical, quantized_wire)")
+    dp.add_argument("--nranks", type=int, required=True)
+    dp.add_argument("--segments", type=int, default=None)
+    dp.add_argument("--wire", default="")
+    dp.set_defaults(fn=_cmd_dump)
+
+    args = ap.parse_args(argv)
+    if args.cmd == "warm" and args.mode == "measure":
+        import ompi_tpu
+
+        comm = ompi_tpu.init()
+        from ..coll.sched import autotune
+
+        res = autotune.tune(args.nranks, comm=comm, mode="measure",
+                            save=not args.dry_run,
+                            topo_fp=args.topo or None)
+        print(f"tuned {len(res['winners'])} key(s) in "
+              f"{res['tune_ms']:.1f} ms (mode=measure)")
+        if res["path"]:
+            print(f"saved {res['path']}")
+        print(f"digest {res['digest']}")
+        return 0
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

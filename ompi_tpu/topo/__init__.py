@@ -8,6 +8,7 @@ from .topology import (
     dims_create,
     dist_graph_create,
     graph_create,
+    hardware_fingerprint,
     neighbor_allgather,
     neighbor_alltoall,
 )
@@ -15,5 +16,5 @@ from .topology import (
 __all__ = [
     "CartTopology", "DistGraphTopology", "GraphTopology", "cart_create",
     "dims_create", "dist_graph_create", "graph_create",
-    "neighbor_allgather", "neighbor_alltoall",
+    "hardware_fingerprint", "neighbor_allgather", "neighbor_alltoall",
 ]

@@ -345,3 +345,31 @@ def neighbor_alltoall(comm, sendblocks: dict):
             got.append(q.pop(0))
         out[r] = jnp.stack(got) if got else None
     return out
+
+
+def hardware_fingerprint(procs=None) -> str:
+    """Stable digest of the hardware topology a schedule was tuned on.
+
+    Canonicalizes what changes a collective schedule's cost surface —
+    rank count, the host-group and slice-group partition shapes, and
+    the device kinds — and hashes it, so the tuned schedule cache
+    (coll/sched/cache.py) is keyed to "machines shaped like this" and a
+    cache warmed on one v5e-16 pod slice is valid on every identically
+    shaped slice, while a reshape (different host fan-out, different
+    chip) re-tunes instead of replaying stale winners.
+    """
+    import hashlib
+    import json
+
+    from ..runtime import mesh
+
+    if procs is None:
+        procs = mesh.discover()
+    canon = {
+        "nranks": len(procs),
+        "hosts": sorted(len(g) for g in mesh.hosts_of(procs).values()),
+        "slices": sorted(len(g) for g in mesh.slices_of(procs).values()),
+        "kinds": sorted({p.platform for p in procs}),
+    }
+    blob = json.dumps(canon, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]

@@ -230,6 +230,79 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
     assert fuse["max_abs_diff_vs_exact"] == 0.0
 
 
+def test_sched_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR9 satellite 4: the sched_autotune and
+    schedule_cache_warm_start rows run end-to-end (real 8-rank
+    subprocess workers, shrunk sweep via env) inside the probe-failed
+    host-only path — the autotune row carrying the tuned>=static
+    verdict and cache hit rate, the warm-start row proving a second
+    process dispatches from the persisted cache without tuning at
+    <=5% p50 overhead."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the measure-mode sweep so the schema check stays fast
+        os.environ["OMPI_TPU_BENCH_SCHED_SIZES"] = "1024,16384"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    tune = rows["sched_autotune"]
+    assert "error" not in tune, tune
+    for key in ("mode", "tune_ms", "keys_tuned", "cache_hits",
+                "cache_misses", "cache_hit_rate", "tuned_ge_static_all",
+                "sweep", "digest"):
+        assert key in tune, key
+    assert tune["mode"] == "measure"
+    assert tune["keys_tuned"] == len(tune["sweep"]) == 2
+    assert tune["cache_hit_rate"] == 1.0 and tune["cache_misses"] == 0
+    # the winner is min over candidates including the static pick:
+    # tuned >= static at every sweep point, by construction
+    assert tune["tuned_ge_static_all"] is True
+    for pt in tune["sweep"]:
+        assert pt["tuned_p50_us"] > 0 and pt["tuned_gbps"] > 0
+        if "static_p50_us" in pt:
+            assert pt["tuned_p50_us"] <= pt["static_p50_us"]
+
+    warm = rows["schedule_cache_warm_start"]
+    assert "error" not in warm, warm
+    assert warm["warm"]["keys"] > 0 and warm["warm"]["path"]
+    second = warm["second_process"]
+    assert second["warm_entries_loaded"] == warm["warm"]["keys"]
+    assert second["tuned_in_this_process"] is False
+    assert second["cache_hits"] > 0
+    # the <=5% acceptance bound lives in the row's own "pass" verdict
+    # (the recorded bench run ratchets it); the schema check runs on a
+    # loaded CI box where paired-median dispatch noise is ~+-5%, so
+    # assert with the same generous margin the trace-overhead check
+    # uses rather than re-litigating the ratchet here
+    assert second["overhead_pct"] <= 10.0, second
+    assert isinstance(second["pass"], bool)
+
+
 def test_trace_rows_emit_schema_complete_on_probe_fail():
     """ISSUE PR7 satellite 5: the trace_overhead and
     latency_histograms rows run end-to-end inside the probe-failed
