@@ -1641,6 +1641,164 @@ def _health_overhead_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def _telemetry_overhead_row() -> dict:
+    """Telemetry-sampler cost on the latency-critical lane: p50 of the
+    fastpath 64 B RTT with the sampler thread running (interval forced
+    down to 5 ms and the blocks stretched so ticks actually land
+    inside them) vs stopped, interleaved blocks, min-of-blocks each
+    side. The telescope always-on claim is overhead_pct < 1 — same
+    harness and ratchet as health_overhead."""
+    try:
+        from ompi_tpu.native import build as _build
+
+        if not _build.available():
+            return {"error": "native library unavailable"}
+        import threading
+        import uuid
+
+        from ompi_tpu.btl.sm import ShmEndpoint
+        from ompi_tpu.core import config as _config
+        from ompi_tpu.core.counters import SPC
+        from ompi_tpu.telemetry import sampler as tsampler
+
+        warm, iters, blocks = 100, 8000, 4
+        prefix = f"tl{uuid.uuid4().hex[:10]}"
+        a = ShmEndpoint(prefix, 0)
+        b = ShmEndpoint(prefix, 1)
+        a.connect(1)
+        b.connect(0)
+        interval0 = _config.get("telemetry_interval_ms")
+        ticks0 = SPC.snapshot().get("telemetry_ticks", 0)
+        try:
+            _config.set("telemetry_interval_ms", 5)
+            total = 2 * blocks * (warm + iters)
+            echo = threading.Thread(
+                target=b.fp_echo, args=(0, total),
+                kwargs={"timeout": 120.0}, daemon=True)
+            echo.start()
+
+            def block_p50(on: bool) -> float:
+                if on:
+                    tsampler.start(seed=0)
+                else:
+                    tsampler.stop()
+                ts = sorted(a.fp_pingpong(1, 64, warm + iters)[warm:])
+                return ts[len(ts) // 2] * 1e6
+
+            p_off, p_on = [], []
+            for _ in range(blocks):
+                p_off.append(block_p50(False))
+                p_on.append(block_p50(True))
+            echo.join(timeout=30.0)
+        finally:
+            tsampler.stop()
+            _config.set("telemetry_interval_ms", interval0)
+            a.close()
+            b.close()
+        off, on = float(min(p_off)), float(min(p_on))
+        pct = (on - off) / off * 100.0
+        return {
+            "p50_off_us": round(off, 2),
+            "p50_on_us": round(on, 2),
+            "overhead_pct": round(pct, 2),
+            "blocks": blocks,
+            "ticks_sampled": int(
+                SPC.snapshot().get("telemetry_ticks", 0) - ticks0),
+            "pass": pct < 1.0,
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _straggler_detect_row() -> dict:
+    """Straggler drill: faultline delays one emulated rank's pml sends
+    (``delay@pml:op=send``), every rank's real pml_send latency
+    histogram rides a telemetry snapshot over the modex, and rank 0's
+    analyze → pvar-watch → medic chain must flag the delayed rank and
+    mark the fabric tier SUSPECT. Reported: detection latency from
+    snapshots-published to tier-marked, p50/max over cycles."""
+    try:
+        import numpy as np
+
+        import ompi_tpu
+        from ompi_tpu.core import counters as _counters
+        from ompi_tpu.ft import inject as faultline
+        from ompi_tpu.health import ledger as hl
+        from ompi_tpu.runtime import modex
+        from ompi_tpu.telemetry import fleet, straggler
+        from ompi_tpu.tools import mpit
+
+        world = ompi_tpu.init()
+        nranks, cycles, sends, delay_ms = 4, 5, 6, 20
+        payload = np.arange(64, dtype=np.float32)
+        # single-device worlds (probe-fail drills) loop back to self;
+        # the pml send path — where faultline injects — is the same
+        dst = 1 if world.size > 1 else 0
+
+        def send_block(tag: int, delayed: bool) -> dict:
+            """Time `sends` real pml sends into a private histogram
+            (one emulated rank's pml_send view)."""
+            h = _counters.Histogram("pml_send")
+            if delayed:
+                faultline.arm(
+                    [f"delay@pml:op=send,ms={delay_ms},count=inf"],
+                    seed=0)
+            comm = world.dup()  # re-selects pml under the fault plan
+            try:
+                for i in range(sends):
+                    t0 = time.perf_counter()
+                    comm.send(payload, dst, tag, source=0)
+                    h.record(time.perf_counter() - t0)
+                    comm.recv(0, tag, dest=dst)
+            finally:
+                comm.free()
+                if delayed:
+                    faultline.disarm()
+            return h.snapshot()
+
+        detect_ms, zs = [], []
+        try:
+            for c in range(cycles):
+                hl.LEDGER.restore("fabric", cause="bench_straggler")
+                for r in range(nranks):
+                    hist = send_block(700 + c, delayed=(r == 2))
+                    modex.put(f"telemetry/{r}", {
+                        "format": "ompi_tpu.telemetry.v1",
+                        "rank": r,
+                        "counters": {},
+                        "hists": {"pml_send": hist},
+                        "health": {},
+                        "peers": {},
+                    })
+                t0 = time.perf_counter()
+                snaps = fleet.gather(nranks)
+                found = straggler.analyze(snaps)
+                mpit.check_watches()
+                if hl.state("fabric") != hl.SUSPECT:
+                    return {"error":
+                            f"cycle {c}: fabric not SUSPECT "
+                            f"(findings={found})"}
+                detect_ms.append((time.perf_counter() - t0) * 1e3)
+                zs.extend(f["z"] for f in found
+                          if f["rank"] == 2)
+        finally:
+            straggler.reset_for_testing()
+            hl.LEDGER.restore("fabric", cause="bench_straggler_done")
+        detect_ms.sort()
+        return {
+            "cycles": cycles,
+            "delay_ms": delay_ms,
+            "detect_p50_ms": round(detect_ms[len(detect_ms) // 2], 3),
+            "detect_max_ms": round(detect_ms[-1], 3),
+            "straggler_z_min": round(min(zs), 1) if zs else None,
+            "suspect_tier": "fabric",
+            "suspect_marked": True,
+            "ledger_digest": hl.digest()[:16],
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _SCHED_AUTOTUNE_WORKER = r"""
 import os, sys, time, json, tempfile
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -1943,6 +2101,10 @@ def _host_rows() -> dict:
     rows["tier_restore"] = _tier_restore_row()
     _set_phase("health overhead (supervisor on/off, fp 64B RTT)")
     rows["health_overhead"] = _health_overhead_row()
+    _set_phase("telemetry overhead (sampler on/off, fp 64B RTT)")
+    rows["telemetry_overhead"] = _telemetry_overhead_row()
+    _set_phase("straggler detect (faultline delay -> SUSPECT)")
+    rows["straggler_detect"] = _straggler_detect_row()
     _set_phase("latency histograms (pvar percentile snapshots)")
     rows["latency_histograms"] = _latency_hist_row()
     _set_phase("schedule autotune (measure-mode sweep, 8-rank mesh)")

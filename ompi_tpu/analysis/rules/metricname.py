@@ -1,0 +1,125 @@
+"""SPC metric-name hygiene rule.
+
+``metricname``: every SPC registration (``SPC.record`` /
+``record_latency`` / ``counter`` / ``hwm`` / ``timer`` /
+``histogram``) mints a pvar name that outlives the code — it becomes
+an MPI_T handle (``tools/mpit.pvar_read``), a Prometheus series
+(``telemetry/export`` sanitizes but cannot rename), a fleet-view
+column the straggler detector maps to a tier by *prefix*
+(``telemetry/straggler._METRIC_TIERS``), and a key operators grep in
+dashboards. A name that is not ``snake_case`` or whose first segment
+is not a known subsystem prefix silently falls out of all of that:
+``categories()`` files it under a phantom framework and the skew
+detector can never attribute it to a tier.
+
+Checked: calls whose receiver is ``SPC`` (bare or as the tail of an
+attribute chain, e.g. ``counters.SPC``) with a literal name argument.
+f-string names count when they start with a literal prefix that
+reaches at least one ``_`` (``f"coll_{op}_algo"``); fully dynamic
+names are invisible to static checking and pass.
+
+Suppression: ``# commlint: allow(metricname)`` on the call line, for
+deliberately out-of-band names (scratch counters in tests/bench).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterable, Optional
+
+from ..report import Severity
+from . import COMMLINT, LintRule
+
+#: SPC methods whose first argument mints/records a metric name.
+_SPC_METHODS = frozenset({
+    "record", "record_latency", "counter", "hwm", "timer", "histogram",
+})
+
+#: First name segment -> the subsystem it files under. Grown with the
+#: tree: grep `SPC\.` registrations before trimming this set.
+KNOWN_PREFIXES = frozenset({
+    "btl", "coll", "convertor", "dcn", "fabric", "faultline", "fp",
+    "ft", "health", "hier", "init", "io", "memchecker", "monitoring",
+    "mpit", "mtl", "nbc", "op", "osc", "parallel", "part", "pml",
+    "pmpi", "quant", "sanitizer", "sched", "shmem", "sm", "telemetry",
+    "topo", "trace", "vprotocol",
+})
+
+_SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")
+
+
+def _is_spc_receiver(node: ast.AST) -> bool:
+    """True for ``SPC`` and for any attribute chain ending in ``SPC``."""
+    if isinstance(node, ast.Name):
+        return node.id == "SPC"
+    if isinstance(node, ast.Attribute):
+        return node.attr == "SPC"
+    return False
+
+
+def _literal_prefix(node: Optional[ast.AST]) -> tuple[Optional[str], bool]:
+    """(checkable name text, is_partial). Constant strings check whole;
+    f-strings check their leading literal when it spans a ``_``."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.JoinedStr) and node.values:
+        head = node.values[0]
+        if isinstance(head, ast.Constant) and isinstance(head.value, str) \
+                and "_" in head.value:
+            return head.value, True
+    return None, False
+
+
+def _name_arg(call: ast.Call) -> Optional[ast.AST]:
+    for k in call.keywords:
+        if k.arg == "name":
+            return k.value
+    if call.args:
+        return call.args[0]
+    return None
+
+
+@COMMLINT.register
+class MetricNameRule(LintRule):
+    NAME = "metricname"
+    PRIORITY = 15
+    DESCRIPTION = ("SPC metric names must be snake_case with a known "
+                   "subsystem prefix")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in _SPC_METHODS
+                    and _is_spc_receiver(fn.value)):
+                continue
+            text, partial = _literal_prefix(_name_arg(node))
+            if text is None:
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            probe = text.rstrip("_") if partial else text
+            problem = None
+            if not probe or not _SNAKE.match(probe):
+                problem = "is not snake_case"
+            else:
+                prefix = probe.split("_", 1)[0]
+                if prefix not in KNOWN_PREFIXES:
+                    problem = (f"prefix {prefix!r} is not a known "
+                               "subsystem")
+            if problem is None:
+                continue
+            shown = text + ("..." if partial else "")
+            yield self.finding(
+                ctx, node,
+                f"SPC metric name {shown!r} {problem} — pvar listing, "
+                "Prometheus export, and straggler tier attribution all "
+                "key on snake_case <subsystem>_<metric> names (known "
+                "prefixes live in analysis/rules/metricname.py; extend "
+                "the set for a new subsystem, or allow() a deliberate "
+                "one-off)",
+            )

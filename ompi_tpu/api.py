@@ -91,6 +91,15 @@ def init(
         from . import health as _health
 
         _health.at_init()
+        from . import telemetry as _telemetry
+
+        try:
+            import jax
+
+            _fleet_n = int(jax.process_count())
+        except Exception:  # commlint: allow(broadexcept)
+            _fleet_n = 1
+        _telemetry.at_init(fleet_size=_fleet_n)
         from .hook import run_hooks
 
         run_hooks("at_init_bottom", comm_world)
@@ -136,6 +145,12 @@ def finalize() -> None:
             from . import health as _health
 
             _health.at_finalize()
+        except ImportError:
+            pass
+        try:
+            from . import telemetry as _telemetry
+
+            _telemetry.at_finalize()
         except ImportError:
             pass
         try:

@@ -15,7 +15,24 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
-from typing import Iterator
+from typing import Iterator, Optional
+
+#: MPI_T pvar classes (reference: mca_base_pvar.h MCA_BASE_PVAR_CLASS_*).
+#: Scalar counters carry their class in the unit field; histograms are
+#: their own class.
+PVAR_COUNTER = "counter"
+PVAR_WATERMARK = "watermark"
+PVAR_TIMER = "timer"
+PVAR_HISTOGRAM = "histogram"
+
+#: unit -> scalar pvar class (hwm() registers unit="max", timer()
+#: registers unit="seconds"; everything else is an event counter).
+_UNIT_CLASS = {"max": PVAR_WATERMARK, "seconds": PVAR_TIMER}
+
+
+def pvar_class_of(unit: str) -> str:
+    """The MPI_T class tag for a scalar counter's unit."""
+    return _UNIT_CLASS.get(unit, PVAR_COUNTER)
 
 
 class Counter:
@@ -116,6 +133,24 @@ class Histogram:
                 "p99": p99,
             }
 
+    def cumulative_buckets(self) -> list[tuple[float, int]]:
+        """(upper_bound_seconds, cumulative_count) per occupied prefix
+        of the bucket array — the Prometheus histogram exposition shape
+        (``le`` labels are inclusive upper bounds; bucket ``b`` spans
+        [2^b, 2^(b+1)) ns, so its bound is 2^(b+1) ns). Trailing empty
+        buckets are dropped; the exporter appends the +Inf bucket."""
+        with self._lock:
+            counts = list(self.counts)
+            total = self.count
+        out: list[tuple[float, int]] = []
+        seen = 0
+        for b, n in enumerate(counts):
+            seen += n
+            out.append((float(1 << (b + 1)) * 1e-9, seen))
+            if seen >= total:
+                break
+        return out
+
 
 class CounterRegistry:
     def __init__(self) -> None:
@@ -196,6 +231,28 @@ class CounterRegistry:
         return {h.name: h.snapshot() for h in sorted(hists,
                                                      key=lambda h: h.name)}
 
+    def get_histogram(self, name: str) -> Optional[Histogram]:
+        """The registered histogram, or None — read-side accessor for
+        the MPI_T surface and the Prometheus exporter (which needs the
+        raw buckets, not just the percentile snapshot)."""
+        return self._histograms.get(name)
+
+    def histogram_dump(self) -> list[dict]:
+        """dump() for the histogram pvar class: one entry per
+        histogram, carrying the percentile snapshot."""
+        with self._lock:
+            hists = sorted(self._histograms.values(),
+                           key=lambda h: h.name)
+        return [
+            {
+                "name": h.name,
+                "unit": h.unit,
+                "description": h.description,
+                "snapshot": h.snapshot(),
+            }
+            for h in hists
+        ]
+
     def snapshot(self) -> dict[str, float]:
         with self._lock:
             return {n: c.value for n, c in self._counters.items()}
@@ -222,11 +279,19 @@ SPC = CounterRegistry()
 
 
 class PvarSession:
-    """MPI_T-style session: snapshot at start, diff on read."""
+    """MPI_T-style session: snapshot at start, diff on read.
+
+    Covers both pvar classes: ``read()`` is the scalar-counter delta
+    view it always was; ``read_histograms()`` is the histogram-class
+    analog — per-histogram sample-count deltas since session start,
+    with the *current* percentile estimates attached (percentiles do
+    not subtract, so the distribution shown is cumulative while the
+    count delta scopes it to this session's window)."""
 
     def __init__(self, registry: CounterRegistry = SPC) -> None:
         self._registry = registry
         self._base = registry.snapshot()
+        self._base_hist = registry.histogram_snapshots()
 
     def read(self) -> dict[str, float]:
         now = self._registry.snapshot()
@@ -236,5 +301,15 @@ class PvarSession:
             if v != self._base.get(k, 0)
         }
 
+    def read_histograms(self) -> dict[str, dict[str, float]]:
+        out = {}
+        for name, snap in self._registry.histogram_snapshots().items():
+            base = self._base_hist.get(name, {})
+            delta = snap["count"] - base.get("count", 0)
+            if delta:
+                out[name] = dict(snap, count=delta)
+        return out
+
     def reset(self) -> None:
         self._base = self._registry.snapshot()
+        self._base_hist = self._registry.histogram_snapshots()

@@ -284,6 +284,22 @@ class Ledger:
                                      "probation_passed")
         self._drain_restored()
 
+    def suspect(self, tier: str, *, scope: str = GLOBAL_SCOPE,
+                cause: str = "") -> None:
+        """Out-of-band suspicion (the telemetry straggler detector):
+        move a HEALTHY tier to SUSPECT *without* charging a
+        consecutive failure. Skew evidence is circumstantial — it puts
+        the tier on the supervisor's SUSPECT sweep so the prober
+        decides, but escalation to QUARANTINED stays reserved for
+        in-band/probe failures (``report_failure``). Repeated skew
+        reports therefore never quarantine a tier by themselves."""
+        if not _enable.value or tier == "host":
+            return
+        with self._mu:
+            e = self._entry(scope, tier)
+            if e.state == HEALTHY:
+                self._transition(scope, tier, e, SUSPECT, cause)
+
     def quarantine(self, tier: str, *, scope: str = GLOBAL_SCOPE,
                    cause: str = "forced") -> None:
         """Operator/supervisor override: straight to QUARANTINED."""
@@ -451,6 +467,11 @@ def report_failure(tier: str, *, scope: str = GLOBAL_SCOPE,
 
 def report_success(tier: str, *, scope: str = GLOBAL_SCOPE) -> None:
     LEDGER.report_success(tier, scope=scope)
+
+
+def suspect(tier: str, *, scope: str = GLOBAL_SCOPE,
+            cause: str = "") -> None:
+    LEDGER.suspect(tier, scope=scope, cause=cause)
 
 
 def is_denied(tier: str, scope: Optional[str] = None) -> bool:

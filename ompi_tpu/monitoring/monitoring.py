@@ -128,6 +128,19 @@ class Monitoring:
                     mat[src][dst] += nbytes
         return mat
 
+    def peer_totals(self) -> dict[str, list[int]]:
+        """Per-link p2p totals collapsed over communicators:
+        ``"src->dst" -> [messages, bytes]``. The fixed small shape the
+        telemetry sampler snapshots every tick (the full cid-keyed
+        matrices stay in ``flush()``)."""
+        out: dict[str, list[int]] = {}
+        with self._lock:
+            for (_, src, dst), (msgs, nbytes) in self.p2p.items():
+                ent = out.setdefault(f"{src}->{dst}", [0, 0])
+                ent[0] += msgs
+                ent[1] += nbytes
+        return out
+
     def flush(self) -> dict:
         with self._lock:
             return {

@@ -174,6 +174,24 @@ def peer_health(rank: int, timeout_s: float = 0.0) -> dict:
     return get(f"health/{rank}", timeout_s=timeout_s)
 
 
+def publish_telemetry(snapshot: dict) -> None:
+    """Publish this controller's telemetry snapshot (the sampler calls
+    this every tick when fleet aggregation is on — same versioned-key
+    pattern as publish_health: each publication overwrites, the ``seq``
+    inside the snapshot orders them; rank 0 merges the fleet view)."""
+    from ..trace import recorder
+
+    put(f"telemetry/{recorder.process_rank()}", snapshot)
+
+
+def peer_telemetry(rank: int, timeout_s: float = 0.0) -> dict:
+    """Read a peer controller's last published telemetry snapshot.
+    timeout_s=0 probes (raises ModexError when the peer has never
+    published — a rank that never started its sampler is simply absent
+    from the fleet view, not a gather failure)."""
+    return get(f"telemetry/{rank}", timeout_s=timeout_s)
+
+
 def clear_local() -> None:
     with _lock:
         _local.clear()

@@ -1,0 +1,56 @@
+"""ompi_tpu.telemetry — the live telemetry plane ("telescope").
+
+Four pieces (see docs/TELEMETRY.md for the operator guide):
+
+- :mod:`.sampler` — seeded, deadline-bounded background thread that
+  every ``telemetry_interval_ms`` snapshots the SPC registry,
+  histogram percentiles, health-ledger tier states, sched-cache hit
+  rates, and per-peer monitoring totals into a lock-free fixed-shape
+  time-series ring (the ``trace/recorder`` discipline).
+- :mod:`.export` — Prometheus text + JSON exporters, file dumps, and
+  the localhost-only HTTP endpoint (``telemetry_port``, off by
+  default). ``python -m ompi_tpu.tools.telemetry`` scrapes/tails/diffs.
+- :mod:`.fleet` — per-rank snapshots gathered over the modex (the
+  trace-gather pattern); rank 0 renders the merged per-rank /
+  per-link fleet view.
+- :mod:`.straggler` — cross-rank robust z-scores over latency
+  histograms and per-tier bandwidth, subscribed through
+  ``mpit.pvar_watch``; findings emit ``telemetry.straggler`` trace
+  instants and mark the implicated tier SUSPECT so medic's prober
+  takes over.
+
+Lifecycle: ``api.init`` calls :func:`at_init` (starts the sampler when
+``telemetry_base_autostart`` is set and the exporter endpoint when
+``telemetry_port`` is nonzero); ``api.finalize`` calls
+:func:`at_finalize`.
+"""
+
+from __future__ import annotations
+
+from . import export, fleet, sampler, straggler  # noqa: F401
+from .sampler import SampleRing, Sampler, schedule_digest  # noqa: F401
+
+
+def at_init(fleet_size: int = 1) -> None:
+    """api.init hook. Cheap and exception-free by construction."""
+    try:
+        if sampler.autostart_enabled():
+            sampler.start(fleet_size=fleet_size)
+        export.start_server()
+    except Exception:  # commlint: allow(broadexcept)
+        from ..core.logging import get_logger
+
+        get_logger("telemetry").exception("telemetry: init hook failed")
+
+
+def at_finalize() -> None:
+    """api.finalize hook: stop the sampler thread and the endpoint."""
+    sampler.stop()
+    export.stop_server()
+
+
+def reset_for_testing() -> None:
+    """Tests: stop everything, forget staged straggler state."""
+    sampler.stop()
+    export.stop_server()
+    straggler.reset_for_testing()

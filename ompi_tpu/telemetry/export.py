@@ -1,0 +1,253 @@
+"""telescope exporters: Prometheus text, JSON, localhost HTTP endpoint.
+
+Two wire formats over the same snapshot:
+
+- **Prometheus text exposition** (``prometheus_text()``): every scalar
+  SPC counter becomes ``ompi_tpu_<name>`` with ``# HELP``/``# TYPE``
+  lines (watermarks export as gauges, event counters and timers as
+  counters), every histogram pvar becomes a native Prometheus
+  histogram (``_bucket{le=...}`` cumulative lines from the raw log2-ns
+  buckets, plus ``_sum``/``_count``), and health-ledger tier states
+  become a labelled gauge. Metric names are sanitized to the
+  ``[a-zA-Z_:][a-zA-Z0-9_:]*`` charset — the commlint ``metricname``
+  rule keeps registrations snake_case so sanitization is normally a
+  no-op.
+- **JSON** (``snapshot_dict()`` / ``fleet`` views): the structured
+  form the CLI diffs and the fleet merge consumes.
+
+The HTTP endpoint binds **127.0.0.1 only** and is **off by default**
+(``telemetry_port`` = 0): telemetry includes peer traffic matrices and
+health state, which is operator data, not public data. Anyone needing
+remote scrape fronts it with their own authenticated proxy.
+"""
+
+from __future__ import annotations
+
+import http.server
+import json
+import re
+import threading
+import time
+from typing import Optional
+
+from ..core import config, counters
+from ..core.counters import SPC
+from ..core.logging import get_logger
+
+logger = get_logger("telemetry")
+
+_port = config.register(
+    "telemetry", "", "port", type=int, default=0,
+    description="Localhost HTTP exporter port (0 = off; binds "
+    "127.0.0.1 only — front with an authenticated proxy for remote "
+    "scrape)",
+)
+
+NAMESPACE = "ompi_tpu"
+SCHEMA = "ompi_tpu.telemetry.v1"
+
+#: Health state -> numeric gauge value (dashboards alert on >= 1).
+STATE_VALUES = {"healthy": 0, "suspect": 1, "probation": 2,
+                "quarantined": 3}
+
+_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def sanitize_name(name: str) -> str:
+    """Prometheus metric-name charset: replace every illegal char with
+    '_' and guard a leading digit."""
+    out = _BAD_CHARS.sub("_", name)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers render bare (no '.0')."""
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def prometheus_text(registry: counters.CounterRegistry = SPC,
+                    *, namespace: str = NAMESPACE,
+                    health: Optional[dict] = None) -> str:
+    """Render the registry (and optionally health tier states) in the
+    Prometheus text exposition format, sorted by metric name."""
+    lines: list[str] = []
+    for d in registry.dump():
+        name = f"{namespace}_{sanitize_name(d['name'])}"
+        kind = "gauge" if counters.pvar_class_of(d["unit"]) \
+            == counters.PVAR_WATERMARK else "counter"
+        help_text = d["description"] or d["name"]
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {_fmt(d['value'])}")
+    for hd in registry.histogram_dump():
+        h = registry.get_histogram(hd["name"])
+        if h is None:
+            continue
+        name = f"{namespace}_{sanitize_name(h.name)}_{h.unit}"
+        help_text = h.description or h.name
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} histogram")
+        cum = 0
+        for le, cum in h.cumulative_buckets():
+            lines.append(f'{name}_bucket{{le="{le:.9g}"}} {cum}')
+        lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
+        lines.append(f"{name}_sum {repr(float(h.total))}")
+        lines.append(f"{name}_count {h.count}")
+    if health is None:
+        health = _health_states()
+    state_name = f"{namespace}_health_tier_state"
+    if health:
+        lines.append(f"# HELP {state_name} health-ledger tier state "
+                     "(0=healthy 1=suspect 2=probation 3=quarantined)")
+        lines.append(f"# TYPE {state_name} gauge")
+        for key, state in sorted(health.items()):
+            scope, _, tier = key.partition("/")
+            lines.append(
+                f'{state_name}{{scope="{scope}",tier="{tier}"}} '
+                f"{STATE_VALUES.get(state, -1)}"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def _health_states() -> dict[str, str]:
+    try:
+        from ..health import ledger
+
+        return {k: v["state"]
+                for k, v in ledger.snapshot().get("entries", {}).items()}
+    except ImportError:
+        return {}
+
+
+def snapshot_dict(rank: Optional[int] = None) -> dict:
+    """The canonical JSON snapshot of this process's live registries
+    (the shape the CLI diffs and peers publish over the modex)."""
+    from . import sampler as _sampler
+    from ..monitoring.monitoring import MONITOR
+
+    if rank is None:
+        from ..trace import recorder
+
+        rank = recorder.process_rank()
+    counters_snap = SPC.snapshot()
+    return {
+        "format": SCHEMA,
+        "rank": rank,
+        "t_unix_ns": time.time_ns(),
+        "counters": counters_snap,
+        "hists": SPC.histogram_snapshots(),
+        "health": _health_states(),
+        "sched": _sampler._sched_stats(counters_snap),
+        "peers": MONITOR.peer_totals(),
+    }
+
+
+def write_json(path: str, snapshot: Optional[dict] = None) -> str:
+    with open(path, "w") as f:
+        json.dump(snapshot if snapshot is not None else snapshot_dict(),
+                  f, indent=2, sort_keys=True, default=str)
+    return path
+
+
+def write_prometheus(path: str) -> str:
+    with open(path, "w") as f:
+        f.write(prometheus_text())
+    return path
+
+
+# -- localhost HTTP endpoint -------------------------------------------------
+
+class _Handler(http.server.BaseHTTPRequestHandler):
+    def do_GET(self) -> None:  # noqa: N802 - stdlib handler name
+        path = self.path.split("?", 1)[0]
+        try:
+            if path in ("/metrics", "/"):
+                body = prometheus_text().encode()
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
+            elif path == "/json":
+                body = json.dumps(snapshot_dict(), default=str).encode()
+                ctype = "application/json"
+            elif path == "/fleet":
+                from . import fleet
+
+                body = json.dumps(fleet.fleet_json(),
+                                  default=str).encode()
+                ctype = "application/json"
+            else:
+                self.send_error(404, "unknown endpoint")
+                return
+        except Exception as exc:  # commlint: allow(broadexcept)
+            # the exporter must never take a scrape down with a 500-less
+            # hang: render the error and keep serving
+            self.send_error(500, f"{type(exc).__name__}: {exc}")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args) -> None:
+        logger.debug("telemetry http: " + fmt, *args)
+
+
+class TelemetryServer:
+    """ThreadingHTTPServer pinned to 127.0.0.1 (see the security note
+    in the module doc). ``port=0`` binds an ephemeral port; the bound
+    port is ``self.port``."""
+
+    def __init__(self, port: int) -> None:
+        self._httpd = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            name="ompi-tpu-telemetry-http", daemon=True)
+        self._thread.start()
+        logger.info("telemetry: exporter on http://127.0.0.1:%d"
+                    " (/metrics /json /fleet)", self.port)
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+_SERVER: Optional[TelemetryServer] = None
+_mu = threading.Lock()
+
+
+def start_server(port: Optional[int] = None) -> Optional[TelemetryServer]:
+    """Start the exporter endpoint. With no argument, reads
+    ``telemetry_port`` (default 0 = stay off). Returns the server (or
+    the already-running one)."""
+    global _SERVER
+    with _mu:
+        if _SERVER is not None:
+            return _SERVER
+        p = _port.value if port is None else port
+        if port is None and not p:
+            return None
+        try:
+            _SERVER = TelemetryServer(p)
+        except OSError as exc:
+            logger.warning("telemetry: exporter bind failed: %s", exc)
+            return None
+        return _SERVER
+
+
+def stop_server() -> None:
+    global _SERVER
+    with _mu:
+        s = _SERVER
+        _SERVER = None
+    if s is not None:
+        s.close()
+
+
+def server() -> Optional[TelemetryServer]:
+    return _SERVER

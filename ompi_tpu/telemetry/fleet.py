@@ -1,0 +1,132 @@
+"""telescope fleet aggregation: per-rank snapshots merged on rank 0.
+
+The gather rides the modex (the PR 7 trace-gather pattern —
+``trace._gather_and_merge``): every rank's sampler publishes its latest
+sample under ``telemetry/<rank>`` each tick (versioned key, the
+``seq`` inside orders publications), and rank 0 probes every peer key
+with ``timeout_s=0`` — a rank that never published is simply absent
+from the view, not a gather failure (ranks opt into telemetry
+independently).
+
+``merge()`` renders the fleet view with **per-rank columns** (one
+column per rank for every latency histogram p50 and per-tier byte
+total) and **per-link columns** (the union of every rank's per-peer
+monitoring totals). The straggler detector consumes the same merged
+table (``straggler.analyze``); ``render_text`` is the human form the
+CLI prints.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+#: Counter-name prefix -> transport tier, for per-tier byte totals
+#: (the health ledger's tier lattice; metric names carry their
+#: subsystem prefix — the invariant the commlint metricname rule
+#: ratchets).
+TIER_PREFIXES = {
+    "fp": "fastpath",
+    "sm": "shm",
+    "dcn": "dcn",
+    "pml": "fabric",
+}
+
+
+def publish(sample: dict) -> None:
+    """Publish this rank's latest sample (modex versioned key)."""
+    from ..runtime import modex
+
+    modex.publish_telemetry(sample)
+
+
+def gather(nproc: int, timeout_s: float = 0.0) -> dict[int, dict]:
+    """Collect every published per-rank sample; missing ranks are
+    skipped (see module doc)."""
+    from ..runtime import modex
+
+    out: dict[int, dict] = {}
+    for r in range(nproc):
+        try:
+            out[r] = modex.peer_telemetry(r, timeout_s=timeout_s)
+        except modex.ModexError:
+            continue
+    return out
+
+
+def tier_bytes(counters_snap: dict) -> dict[str, float]:
+    """Per-tier byte totals from the ``<prefix>_*_bytes`` counters."""
+    out: dict[str, float] = {}
+    for name, value in counters_snap.items():
+        if not name.endswith("_bytes"):
+            continue
+        tier = TIER_PREFIXES.get(name.split("_", 1)[0])
+        if tier is not None:
+            out[tier] = out.get(tier, 0) + value
+    return out
+
+
+def merge(snaps: dict[int, dict]) -> dict:
+    """The rank-0 fleet view: per-rank metric columns + per-link
+    totals (see module doc for the column families)."""
+    ranks = sorted(snaps)
+    metrics: dict[str, dict[int, float]] = {}
+    links: dict[str, dict[int, list]] = {}
+    health: dict[int, dict] = {}
+    for r in ranks:
+        snap = snaps[r]
+        for hname, hsnap in (snap.get("hists") or {}).items():
+            metrics.setdefault(f"{hname}_p50_us", {})[r] = \
+                round(hsnap.get("p50", 0.0) * 1e6, 3)
+        for tier, nbytes in tier_bytes(
+                snap.get("counters") or {}).items():
+            metrics.setdefault(f"tier_{tier}_bytes", {})[r] = nbytes
+        for link, totals in (snap.get("peers") or {}).items():
+            links.setdefault(link, {})[r] = list(totals)
+        health[r] = snap.get("health") or {}
+    return {
+        "format": "ompi_tpu.telemetry.fleet.v1",
+        "ranks": ranks,
+        "metrics": metrics,
+        "links": links,
+        "health": health,
+    }
+
+
+def fleet_json(nproc: Optional[int] = None) -> dict:
+    """Gather + merge in one step (the ``/fleet`` endpoint). With no
+    size hint, uses the running sampler's fleet size (falling back to
+    just this rank's own published sample)."""
+    from . import sampler as _sampler
+
+    if nproc is None:
+        s = _sampler.get()
+        nproc = (s.fleet_size if s is not None and s.fleet_size
+                 else 1)
+    return merge(gather(nproc))
+
+
+def render_text(view: dict) -> str:
+    """The merged view as aligned per-rank columns (metric rows) plus
+    the per-link totals table."""
+    ranks = view.get("ranks", [])
+    lines = []
+    header = ["metric".ljust(28)] + [f"r{r}".rjust(12) for r in ranks]
+    lines.append(" ".join(header))
+    for metric in sorted(view.get("metrics", {})):
+        cols = view["metrics"][metric]
+        row = [metric.ljust(28)]
+        for r in ranks:
+            v = cols.get(r)
+            row.append(("-" if v is None else f"{v:g}").rjust(12))
+        lines.append(" ".join(row))
+    links = view.get("links", {})
+    if links:
+        lines.append("")
+        lines.append("link".ljust(28) + " " + "msgs".rjust(10)
+                     + " " + "bytes".rjust(14))
+        for link in sorted(links):
+            msgs = sum(v[0] for v in links[link].values())
+            nbytes = sum(v[1] for v in links[link].values())
+            lines.append(link.ljust(28) + " " + str(msgs).rjust(10)
+                         + " " + str(nbytes).rjust(14))
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,357 @@
+"""telescope sampler: the periodic snapshot thread + time-series ring.
+
+The sampler is a background thread that every ``telemetry_interval_ms``
+captures one fixed-shape sample of the process's observability state —
+the SPC scalar registry, histogram percentile snapshots, health-ledger
+tier states, sched-cache hit rates, and the per-peer monitoring totals
+— into a lock-free ring (same ``itertools.count`` + slot-store
+discipline as ``trace/recorder.FlightRecorder``: writers never block,
+readers snapshot, old samples are overwritten once the ring laps).
+
+Determinism: the tick schedule is drawn from a *seeded*
+``core/backoff.Backoff`` (constant base = the interval, jittered so a
+fleet of controllers never scrapes in lockstep), so a given
+(seed, interval) reproduces the exact delay sequence —
+``schedule_digest()`` is byte-identical across controllers with the
+same seed, the same reproducibility contract the health ledger's
+``digest()`` and faultline's plan digest carry.
+
+Deadline-bounding: each tick runs under ``telemetry_deadline_ms``.
+Section collection checks the deadline between sections and skips the
+rest once it passes (counted in ``telemetry_deadline_skips``) — a
+wedged subsystem can cost the sampler one truncated sample, never a
+stuck sampler thread.
+
+Sample shape (one tuple per slot, fixed field order)::
+
+    (seq, t_ns, rank, counters, hists, health, sched, peers)
+
+``tick()`` is synchronous and test-drivable without the thread (the
+health ``Supervisor.tick()`` idiom). Each tick also publishes the
+sample over the modex when fleet aggregation is on, runs the straggler
+detector on rank 0, and evaluates mpit pvar watches.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import threading
+import time
+from typing import Optional
+
+from ..core import config
+from ..core.backoff import Backoff
+from ..core.counters import SPC
+from ..core.logging import get_logger
+
+logger = get_logger("telemetry")
+
+_interval = config.register(
+    "telemetry", "", "interval_ms", type=int, default=1000,
+    description="Sampler tick interval in ms (jittered per tick from a "
+    "seeded backoff so fleet controllers never scrape in lockstep)",
+)
+_ring_entries = config.register(
+    "telemetry", "base", "ring_entries", type=int, default=512,
+    description="Telemetry time-series ring capacity (rounded up to a "
+    "power of two; oldest samples are overwritten)",
+)
+_deadline = config.register(
+    "telemetry", "base", "deadline_ms", type=int, default=50,
+    description="Per-tick snapshot budget; sections not collected "
+    "before it passes are skipped (telemetry_deadline_skips counts)",
+)
+_autostart = config.register(
+    "telemetry", "base", "autostart", type=bool, default=False,
+    description="Start the sampler thread from api.init",
+)
+_fleet = config.register(
+    "telemetry", "base", "fleet", type=bool, default=False,
+    description="Publish per-rank samples over the modex every tick "
+    "and aggregate the fleet view on rank 0",
+)
+_seed_var = config.register(
+    "telemetry", "base", "seed", type=int, default=0,
+    description="Sampler schedule jitter seed (same seed => "
+    "byte-identical schedule digest across controllers)",
+)
+
+#: Fixed sample field order (the ring's record shape).
+FIELDS = ("seq", "t_ns", "rank", "counters", "hists", "health",
+          "sched", "peers")
+
+
+class SampleRing:
+    """Lock-free ring of fixed-shape samples (see module doc)."""
+
+    def __init__(self, capacity: Optional[int] = None) -> None:
+        cap = int(capacity or _ring_entries.value or 512)
+        cap = 1 << max(3, (cap - 1).bit_length())
+        self._slots: list = [None] * cap
+        self._mask = cap - 1
+        self._seq = itertools.count()
+
+    @property
+    def capacity(self) -> int:
+        return self._mask + 1
+
+    def push(self, t_ns: int, rank: int, counters: dict, hists: dict,
+             health: dict, sched: dict, peers: dict) -> tuple:
+        """Append one sample: one counter bump, one tuple, one slot
+        store — no locks (wrap is modular slot reuse)."""
+        n = next(self._seq)
+        rec = (n, t_ns, rank, counters, hists, health, sched, peers)
+        self._slots[n & self._mask] = rec
+        return rec
+
+    def records(self) -> list[tuple]:
+        """Snapshot, oldest first (the recorder's torn-slot reasoning:
+        slot assignment is atomic under the GIL)."""
+        out = [r for r in self._slots if r is not None]
+        out.sort(key=lambda r: r[0])
+        return out
+
+    def latest(self) -> Optional[tuple]:
+        recs = self.records()
+        return recs[-1] if recs else None
+
+    def clear(self) -> None:
+        self._slots = [None] * (self._mask + 1)
+        self._seq = itertools.count()
+
+
+def sample_to_dict(rec: tuple) -> dict:
+    """One ring tuple as the JSON-facing dict (fixed key order)."""
+    return dict(zip(FIELDS, rec))
+
+
+# -- collection --------------------------------------------------------------
+
+def _health_states() -> dict[str, str]:
+    from ..health import ledger
+
+    snap = ledger.snapshot()
+    return {k: v["state"] for k, v in snap.get("entries", {}).items()}
+
+
+def _sched_stats(counters_snap: dict) -> dict:
+    hits = counters_snap.get("sched_cache_hits", 0)
+    misses = counters_snap.get("sched_cache_misses", 0)
+    total = hits + misses
+    return {
+        "hits": hits,
+        "misses": misses,
+        "hit_rate": round(hits / total, 4) if total else 0.0,
+    }
+
+
+def collect_sample(ring: SampleRing, rank: int,
+                   deadline: Optional[float] = None) -> tuple:
+    """Capture one sample into ``ring``, each section gated on the
+    deadline (monotonic seconds; None = unbounded)."""
+    def due() -> bool:
+        if deadline is not None and time.monotonic() >= deadline:
+            SPC.record("telemetry_deadline_skips")
+            return False
+        return True
+
+    t_ns = time.time_ns()
+    counters_snap: dict = {}
+    hists: dict = {}
+    health: dict = {}
+    sched: dict = {}
+    peers: dict = {}
+    if due():
+        counters_snap = SPC.snapshot()
+        sched = _sched_stats(counters_snap)
+    if due():
+        hists = SPC.histogram_snapshots()
+    if due():
+        try:
+            health = _health_states()
+        except ImportError:
+            health = {}
+    if due():
+        from ..monitoring.monitoring import MONITOR
+
+        peers = MONITOR.peer_totals()
+    return ring.push(t_ns, rank, counters_snap, hists, health, sched,
+                     peers)
+
+
+# -- deterministic schedule --------------------------------------------------
+
+#: Jitter fraction of the interval (schedule contract: part of the
+#: digest, so a change here is a schedule version change).
+JITTER = 0.25
+
+
+def _schedule_backoff(seed: int, interval_ms: int) -> Backoff:
+    # factor=1.0 pins the un-jittered delay to the interval; the seeded
+    # jitter RNG is the only variation source, so the delay sequence is
+    # a pure function of (seed, interval).
+    period = max(0.001, interval_ms / 1000.0)
+    return Backoff(initial=period, maximum=period, factor=1.0,
+                   jitter=JITTER, seed=seed)
+
+
+def planned_delays(seed: int, interval_ms: int, n: int) -> list[float]:
+    """The first ``n`` tick delays (seconds) for this (seed, interval)
+    — pure, thread-free reconstruction of the sampler's schedule."""
+    bo = _schedule_backoff(seed, interval_ms)
+    return [bo.next_delay() for _ in range(n)]
+
+
+def schedule_digest(seed: int, interval_ms: int, n: int = 64) -> str:
+    """sha256 over the first ``n`` planned delays (ns-quantized) —
+    byte-identical across controllers for the same seed/interval (the
+    acceptance contract; same idea as ledger.digest())."""
+    text = ",".join(
+        f"{round(d * 1e9)}" for d in planned_delays(seed, interval_ms, n)
+    )
+    return hashlib.sha256(text.encode()).hexdigest()
+
+
+# -- the sampler -------------------------------------------------------------
+
+class Sampler:
+    """Owns the ring and the (optional) tick thread."""
+
+    def __init__(self, *, seed: Optional[int] = None,
+                 interval_ms: Optional[int] = None,
+                 fleet_size: Optional[int] = None,
+                 ring: Optional[SampleRing] = None) -> None:
+        self.seed = _seed_var.value if seed is None else int(seed)
+        self.interval_ms = int(interval_ms or _interval.value or 1000)
+        self.fleet_size = fleet_size
+        self.ring = ring if ring is not None else SampleRing()
+        self.ticks = 0
+        self._bo = _schedule_backoff(self.seed, self.interval_ms)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- identity ------------------------------------------------------
+
+    def rank(self) -> int:
+        from ..trace import recorder
+
+        return recorder.process_rank()
+
+    def schedule_digest(self, n: int = 64) -> str:
+        return schedule_digest(self.seed, self.interval_ms, n)
+
+    # -- one synchronous quantum ---------------------------------------
+
+    def tick(self) -> tuple:
+        """Collect one sample, publish/aggregate the fleet view, run
+        the straggler detector (rank 0), evaluate pvar watches. Every
+        stage is deadline-bounded and failure-isolated: a broken
+        section costs this tick its data, never the thread."""
+        self.ticks += 1
+        SPC.record("telemetry_ticks")
+        deadline = time.monotonic() + max(1, _deadline.value) / 1000.0
+        rank = self.rank()
+        rec = collect_sample(self.ring, rank, deadline)
+        if _fleet.value:
+            from . import fleet, straggler
+
+            try:
+                fleet.publish(sample_to_dict(rec))
+            except Exception:  # commlint: allow(broadexcept)
+                SPC.record("telemetry_publish_errors")
+            # fleet.gather is a modex KV sweep (non-collective, pure
+            # polling), not a comm collective — rank gating is the point
+            if rank == 0 and self.fleet_size and self.fleet_size > 1:  # commlint: allow(colldiv)
+                try:
+                    snaps = fleet.gather(self.fleet_size)
+                    straggler.analyze(snaps)
+                except Exception:  # commlint: allow(broadexcept)
+                    SPC.record("telemetry_fleet_errors")
+        from ..tools import mpit
+
+        mpit.check_watches()
+        return rec
+
+    # -- thread lifecycle ----------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._run, name="ompi-tpu-telemetry", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            # the seeded schedule decides the wait; the stop event
+            # breaks it early so stop() never waits a full interval
+            if self._stop.wait(self._bo.next_delay()):
+                break
+            try:
+                self.tick()
+            except Exception:  # commlint: allow(broadexcept)
+                logger.exception("telemetry: tick failed")
+                SPC.record("telemetry_tick_errors")
+
+    def stop(self) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout=5.0)
+            if t.is_alive():  # never hang finalize on a stuck tick
+                logger.warning("telemetry: sampler did not stop in 5s")
+        self._thread = None
+
+    def running(self) -> bool:
+        t = self._thread
+        return t is not None and t.is_alive()
+
+
+# -- module-level singleton (the prober start/stop idiom) --------------------
+
+_SAMPLER: Optional[Sampler] = None
+_mu = threading.Lock()
+
+
+def get() -> Optional[Sampler]:
+    return _SAMPLER
+
+
+def start(*, seed: Optional[int] = None,
+          interval_ms: Optional[int] = None,
+          fleet_size: Optional[int] = None) -> Sampler:
+    """Start (or return) the process sampler thread."""
+    global _SAMPLER
+    with _mu:
+        if _SAMPLER is None or not _SAMPLER.running():
+            _SAMPLER = Sampler(seed=seed, interval_ms=interval_ms,
+                               fleet_size=fleet_size)
+            _SAMPLER.start()
+        return _SAMPLER
+
+
+def stop() -> None:
+    global _SAMPLER
+    with _mu:
+        s = _SAMPLER
+        _SAMPLER = None
+    if s is not None:
+        s.stop()
+
+
+def running() -> bool:
+    s = _SAMPLER
+    return s is not None and s.running()
+
+
+def autostart_enabled() -> bool:
+    return bool(_autostart.value)
+
+
+def ring() -> Optional[SampleRing]:
+    """The live sampler's ring (None when no sampler was ever
+    started) — the exporter's data source for ``tail``."""
+    s = _SAMPLER
+    return s.ring if s is not None else None

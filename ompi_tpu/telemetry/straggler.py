@@ -1,0 +1,222 @@
+"""telescope straggler/skew detector: cross-rank z-scores -> medic.
+
+Detection runs on rank 0 over the merged fleet view (``fleet.merge``):
+for every ``coll_<op>`` / ``pml_send`` latency-histogram p50 column
+and every per-tier byte-total column, compute a **robust z-score** per
+rank (Iglewicz-Hoaglin modified z: median/MAD instead of mean/std —
+one wedged rank inflates a mean-based std enough to hide itself; with
+one outlier among n ranks a classic z can never exceed sqrt(n-1), so
+it would be structurally blind at small fleet sizes). A rank whose
+latency z exceeds ``telemetry_straggler_zscore`` (or whose tier byte
+total falls below -z) is a straggler candidate.
+
+The hand-off to medic rides the generic MPI_T watch mechanism, not a
+bespoke path: ``analyze()`` only *stages* findings and bumps the
+``telemetry_straggler_candidates`` pvar; the registered
+``mpit.pvar_watch`` on that counter fires on the rise (the sampler
+calls ``check_watches()`` every tick) and its callback drains the
+staged findings — emitting one ``telemetry.straggler`` trace instant
+per finding, counting ``telemetry_stragglers``, and marking each
+implicated tier SUSPECT in the health ledger (``ledger.suspect``:
+no consecutive-failure charge, so skew alone never escalates to
+QUARANTINED — the supervisor's SUSPECT sweep probes the tier and the
+probe evidence decides: detection -> quarantine-or-recover -> restore,
+fully automatic).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.logging import get_logger
+
+logger = get_logger("telemetry")
+
+_zscore = config.register(
+    "telemetry", "straggler", "zscore", type=float, default=3.5,
+    description="Robust (median/MAD) z-score above which a rank's "
+    "latency column flags it as a straggler (3.5 is the standard "
+    "Iglewicz-Hoaglin outlier cut)",
+)
+_min_ranks = config.register(
+    "telemetry", "straggler", "min_ranks", type=int, default=3,
+    description="Minimum ranks reporting a metric before skew is "
+    "computed (z-scores over fewer points are noise)",
+)
+_min_rel = config.register(
+    "telemetry", "straggler", "min_rel", type=float, default=0.5,
+    description="Minimum relative excess over the fleet median "
+    "((x - median)/median) a latency column needs before it can flag "
+    "— keeps ns-scale jitter from tripping the z test",
+)
+_enable = config.register(
+    "telemetry", "straggler", "enable", type=bool, default=True,
+    description="Run the cross-rank skew detector on rank 0's fleet "
+    "ticks",
+)
+
+#: Metric-name prefix -> implicated transport tier. coll_* histograms
+#: time the device-collective dispatch; pml_* rides the fabric engine.
+_METRIC_TIERS = (
+    ("pml_", "fabric"),
+    ("coll_", "device"),
+    ("fp_", "fastpath"),
+    ("sm_", "shm"),
+    ("dcn_", "dcn"),
+)
+
+_pending: list[dict] = []
+_findings_log: list[dict] = []
+_watch = None
+_mu = threading.Lock()
+
+
+def metric_tier(metric: str) -> Optional[str]:
+    """The tier a fleet-view metric column implicates."""
+    if metric.startswith("tier_") and metric.endswith("_bytes"):
+        return metric[len("tier_"):-len("_bytes")]
+    for prefix, tier in _METRIC_TIERS:
+        if metric.startswith(prefix):
+            return tier
+    return None
+
+
+def robust_z(values: dict[int, float]) -> dict[int, float]:
+    """Iglewicz-Hoaglin modified z-score per rank. MAD of zero (every
+    other rank identical) falls back to a floor of 1% of the median
+    magnitude, so a lone outlier over a flat baseline still scores —
+    the exact straggler shape."""
+    xs = sorted(values.values())
+    n = len(xs)
+    med = xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2.0
+    devs = sorted(abs(v - med) for v in values.values())
+    mad = devs[n // 2] if n % 2 else (devs[n // 2 - 1]
+                                      + devs[n // 2]) / 2.0
+    scale = 1.4826 * mad
+    if scale <= 0:
+        scale = max(abs(med) * 0.01, 1e-12)
+    return {r: (v - med) / scale for r, v in values.items()}
+
+
+def _median(values: dict[int, float]) -> float:
+    xs = sorted(values.values())
+    n = len(xs)
+    return xs[n // 2] if n % 2 else (xs[n // 2 - 1] + xs[n // 2]) / 2.0
+
+
+def detect(view: dict) -> list[dict]:
+    """Pure skew computation over a merged fleet view: one finding per
+    (rank, metric) whose robust z crosses the threshold — high-side
+    for latency columns, low-side for byte-total (bandwidth) columns."""
+    threshold = float(_zscore.value)
+    min_ranks = int(_min_ranks.value)
+    min_rel = float(_min_rel.value)
+    findings = []
+    for metric, cols in sorted((view.get("metrics") or {}).items()):
+        tier = metric_tier(metric)
+        if tier is None or len(cols) < min_ranks:
+            continue
+        low_side = metric.endswith("_bytes")
+        zs = robust_z(cols)
+        med = _median(cols)
+        for rank, z in sorted(zs.items()):
+            if low_side:
+                if z > -threshold:
+                    continue
+            else:
+                if z < threshold:
+                    continue
+                if med > 0 and (cols[rank] - med) / med < min_rel:
+                    continue
+            findings.append({
+                "rank": rank,
+                "metric": metric,
+                "z": round(z, 2),
+                "value": cols[rank],
+                "median": med,
+                "tier": tier,
+            })
+    return findings
+
+
+def analyze(snaps: dict[int, dict]) -> list[dict]:
+    """Rank 0's per-tick entry point: merge -> detect -> stage. Only
+    stages findings and bumps the candidates pvar; action happens in
+    the watch callback (see module doc)."""
+    if not _enable.value or len(snaps) < int(_min_ranks.value):
+        return []
+    from . import fleet
+
+    ensure_watch()
+    findings = detect(fleet.merge(snaps))
+    if findings:
+        with _mu:
+            _pending.extend(findings)
+        SPC.record("telemetry_straggler_candidates", len(findings))
+    return findings
+
+
+def ensure_watch() -> None:
+    """Install the candidates watch once (idempotent)."""
+    global _watch
+    with _mu:
+        if _watch is not None and _watch._active:
+            return
+    from ..tools import mpit
+
+    w = mpit.pvar_watch("telemetry_straggler_candidates", 1.0,
+                        _on_candidates)
+    with _mu:
+        _watch = w
+
+
+def _on_candidates(name: str, value: float) -> None:
+    """The watch callback: drain staged findings, emit trace instants,
+    and mark each implicated tier SUSPECT (once per tier per drain —
+    the prober takes it from there)."""
+    with _mu:
+        items = list(_pending)
+        _pending.clear()
+        _findings_log.extend(items)
+        del _findings_log[:-256]
+    if not items:
+        return
+    from ..health import ledger
+    from ..trace import span as tspan
+
+    tiers_marked = set()
+    for f in items:
+        SPC.record("telemetry_stragglers")
+        tspan.instant("telemetry.straggler", cat="telemetry",
+                      rank=f["rank"], metric=f["metric"], z=f["z"],
+                      tier=f["tier"])
+        logger.warning(
+            "telemetry: straggler rank %d on %s (z=%.1f, value=%g vs "
+            "fleet median %g) — tier %r marked SUSPECT",
+            f["rank"], f["metric"], f["z"], f["value"], f["median"],
+            f["tier"])
+        if f["tier"] not in tiers_marked:
+            tiers_marked.add(f["tier"])
+            ledger.suspect(
+                f["tier"],
+                cause=f"straggler:rank{f['rank']}:{f['metric']}",
+            )
+
+
+def findings() -> list[dict]:
+    """Recent drained findings, newest last (bounded window)."""
+    with _mu:
+        return list(_findings_log)
+
+
+def reset_for_testing() -> None:
+    global _watch
+    with _mu:
+        _pending.clear()
+        _findings_log.clear()
+        w, _watch = _watch, None
+    if w is not None:
+        w.cancel()

@@ -1,4 +1,4 @@
-"""MPI_T-style tool interface: cvars, pvars, categories.
+"""MPI_T-style tool interface: cvars, pvars, categories, watches.
 
 TPU-native equivalent of ompi/mpi/tool (reference: the MPI_T API over
 the mca_base_var registry (cvars, mca_base_var.c) and SPC/monitoring
@@ -8,12 +8,28 @@ use this module instead of reaching into internals:
     from ompi_tpu.tools import mpit
     for cv in mpit.cvar_list(): ...
     h = mpit.pvar_session(); ...; h.read()
+
+Pvars span the MPI_T classes: scalar **counter** / **watermark** /
+**timer** variables (the SPC counter registry, class derived from the
+unit) and **histogram** variables (the log-bucketed latency
+distributions — ``CounterRegistry.histogram_snapshots``). A histogram
+pvar's scalar value is its sample count; ``pvar_read("name:p50")``
+addresses an individual field.
+
+``pvar_watch`` is the MPI_T event-callback analog
+(MPI_T_event_handle_alloc): register a callback against a pvar and a
+threshold; ``check_watches()`` — called from the telemetry sampler's
+tick, or by any polling tool — fires the callback on every observed
+*rise* while the value sits at/above the threshold. The telemetry
+straggler detector subscribes through this mechanism rather than a
+bespoke path.
 """
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Optional
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
 
 from ..core import config, counters
 
@@ -56,28 +72,150 @@ def cvar_write(name: str, value: Any) -> None:
     config.set(name, value)
 
 
+# -- pvars (both classes) ---------------------------------------------------
+
 def pvar_list(prefix: str = "") -> list[dict]:
-    """Enumerate performance variables (the SPC registry)."""
-    return [
-        d for d in counters.SPC.dump()
-        if not prefix or d["name"].startswith(prefix)
-    ]
+    """Enumerate performance variables: the scalar SPC counters plus
+    the histogram-class pvars, every entry tagged with its MPI_T class
+    (counter / watermark / timer / histogram). Histogram entries carry
+    the percentile snapshot; their scalar ``value`` is the sample
+    count."""
+    out = []
+    for d in counters.SPC.dump():
+        if prefix and not d["name"].startswith(prefix):
+            continue
+        d["class"] = counters.pvar_class_of(d["unit"])
+        out.append(d)
+    for h in counters.SPC.histogram_dump():
+        if prefix and not h["name"].startswith(prefix):
+            continue
+        h["class"] = counters.PVAR_HISTOGRAM
+        h["value"] = h["snapshot"]["count"]
+        out.append(h)
+    return sorted(out, key=lambda d: d["name"])
 
 
-def pvar_read(name: str) -> float:
+def pvar_read(name: str) -> Any:
+    """Read one pvar. Scalar counters return their value; a histogram
+    name returns its snapshot dict; ``"name:field"`` (e.g.
+    ``"pml_send:p99"``) returns one histogram field as a float."""
+    base, _, fieldname = name.partition(":")
+    h = counters.SPC.get_histogram(base)
+    if h is not None:
+        snap = h.snapshot()
+        return snap[fieldname] if fieldname else snap
+    if fieldname:
+        raise KeyError(f"no histogram pvar {base!r}")
     return counters.SPC.snapshot().get(name, 0.0)
 
 
 def pvar_session() -> counters.PvarSession:
     """A pvar session: reads are deltas since session start (MPI_T
-    pvar handle semantics — each tool sees its own baseline)."""
+    pvar handle semantics — each tool sees its own baseline). Scalar
+    deltas via ``read()``, histogram-class deltas via
+    ``read_histograms()``."""
     return counters.PvarSession()
 
 
-def categories() -> dict[str, list[str]]:
-    """Group cvars by framework (MPI_T categories = MCA frameworks)."""
-    cats: dict[str, list[str]] = {}
+def categories() -> dict[str, dict[str, list[str]]]:
+    """Group cvars AND pvars by framework (MPI_T categories = MCA
+    frameworks; a pvar's framework is its subsystem name prefix).
+    Each category maps to ``{"cvars": [...], "pvars": [...]}``."""
+    cats: dict[str, dict[str, list[str]]] = {}
+
+    def bucket(fw: str) -> dict[str, list[str]]:
+        return cats.setdefault(fw, {"cvars": [], "pvars": []})
+
     for cv in cvar_list():
-        fw = cv.name.split("_", 1)[0]
-        cats.setdefault(fw, []).append(cv.name)
+        bucket(cv.name.split("_", 1)[0])["cvars"].append(cv.name)
+    for pv in pvar_list():
+        bucket(pv["name"].split("_", 1)[0])["pvars"].append(pv["name"])
     return cats
+
+
+# -- pvar watches (MPI_T event-callback analog) -----------------------------
+
+@dataclass
+class WatchHandle:
+    """One registered watch. ``fired`` counts callback invocations;
+    ``cancel()`` (or falling out of the registry via
+    ``clear_watches``) retires it."""
+
+    name: str
+    threshold: float
+    cb: Callable[[str, float], None]
+    fired: int = 0
+    last: Optional[float] = field(default=None, repr=False)
+    _active: bool = field(default=True, repr=False)
+
+    def cancel(self) -> None:
+        self._active = False
+        with _watch_lock:
+            if self in _watches:
+                _watches.remove(self)
+
+
+_watches: list[WatchHandle] = []
+_watch_lock = threading.Lock()
+
+
+def pvar_watch(name: str, threshold: float,
+               cb: Callable[[str, float], None]) -> WatchHandle:
+    """Register ``cb(name, value)`` to fire when the pvar rises to (or
+    above) ``threshold``. ``name`` accepts the same forms as
+    ``pvar_read`` — a scalar counter, ``"hist:p99"`` for a histogram
+    field, or a bare histogram name (watched as its sample count, the
+    histogram's scalar value in ``pvar_list``). Evaluation is
+    pull-based: nothing fires until
+    ``check_watches()`` runs (the telemetry sampler calls it every
+    tick). Semantics: fires on every observed increase while the value
+    is at/above the threshold — a counter that keeps climbing past the
+    threshold fires once per check that saw a rise, a gauge parked at
+    a high value fires once."""
+    h = WatchHandle(name=name, threshold=threshold, cb=cb)
+    with _watch_lock:
+        _watches.append(h)
+    return h
+
+
+def check_watches() -> list[str]:
+    """Evaluate every registered watch against current pvar values;
+    returns the names that fired. Callback exceptions are swallowed
+    (a broken tool must not take the sampler down) but counted in the
+    ``mpit_watch_errors`` pvar."""
+    with _watch_lock:
+        active = list(_watches)
+    fired = []
+    for h in active:
+        if not h._active:
+            continue
+        try:
+            raw = pvar_read(h.name)
+            if isinstance(raw, dict):  # bare histogram: watch count
+                raw = raw.get("count", 0)
+            value = float(raw)
+        except (KeyError, TypeError, ValueError):
+            continue
+        rose = h.last is None or value > h.last
+        h.last = value
+        if value >= h.threshold and rose:
+            h.fired += 1
+            fired.append(h.name)
+            try:
+                h.cb(h.name, value)
+            except Exception:  # commlint: allow(broadexcept)
+                counters.SPC.record("mpit_watch_errors")
+    return fired
+
+
+def watches() -> list[WatchHandle]:
+    with _watch_lock:
+        return list(_watches)
+
+
+def clear_watches() -> None:
+    """Retire every watch (tests / teardown)."""
+    with _watch_lock:
+        for h in _watches:
+            h._active = False
+        _watches.clear()

@@ -1,0 +1,177 @@
+"""telemetry — scrape, tail, and diff telescope snapshots.
+
+Offline/operator counterpart of the live telemetry plane
+(``ompi_tpu/telemetry``):
+
+- ``scrape``: GET a running process's localhost exporter (``/metrics``
+  Prometheus text, ``/json`` snapshot, ``/fleet`` merged view) and
+  print or save it.
+- ``tail``: poll the ``/json`` endpoint and print the counters that
+  changed between polls — ``watch`` for pvars.
+- ``diff``: compare two saved JSON snapshots (scalar counter deltas,
+  histogram count/percentile drift, health-state changes).
+- ``dump``: render THIS process's registries to a file (mostly for
+  tests and one-shot captures; live processes use the endpoint).
+
+Usage::
+
+    python -m ompi_tpu.tools.telemetry scrape --port 9464
+    python -m ompi_tpu.tools.telemetry scrape --port 9464 --json
+    python -m ompi_tpu.tools.telemetry tail --port 9464 --count 10
+    python -m ompi_tpu.tools.telemetry diff before.json after.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def _url(args, path: str) -> str:
+    if args.url:
+        return args.url.rstrip("/") + path
+    return f"http://127.0.0.1:{args.port}{path}"
+
+
+def _get(url: str, timeout: float = 5.0) -> bytes:
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def cmd_scrape(args) -> int:
+    path = "/fleet" if args.fleet else ("/json" if args.json
+                                        else "/metrics")
+    body = _get(_url(args, path)).decode()
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(body)
+        print(f"wrote {len(body)} bytes -> {args.output}")
+    else:
+        sys.stdout.write(body)
+    return 0
+
+
+def cmd_tail(args) -> int:
+    prev: dict = {}
+    for i in range(args.count) if args.count else iter(int, 1):
+        snap = json.loads(_get(_url(args, "/json")).decode())
+        now = snap.get("counters", {})
+        changed = {
+            k: now[k] - prev.get(k, 0)
+            for k in sorted(now) if now[k] != prev.get(k, 0)
+        }
+        stamp = snap.get("t_unix_ns", 0) // 1_000_000_000
+        cols = " ".join(f"{k}=+{v:g}" for k, v in changed.items())
+        print(f"[{stamp}] seq-deltas: {cols or '(idle)'}")
+        prev = now
+        if not args.count or i < args.count - 1:
+            time.sleep(args.interval)
+    return 0
+
+
+def _load_snapshot(path: str) -> dict:
+    with open(path) as f:
+        d = json.load(f)
+    if not str(d.get("format", "")).startswith("ompi_tpu.telemetry"):
+        raise SystemExit(f"{path}: not an ompi_tpu telemetry snapshot "
+                         f"(format={d.get('format')!r})")
+    return d
+
+
+def cmd_diff(args) -> int:
+    a = _load_snapshot(args.a)
+    b = _load_snapshot(args.b)
+    rows = []
+    ca, cb = a.get("counters", {}), b.get("counters", {})
+    for name in sorted(set(ca) | set(cb)):
+        d = cb.get(name, 0) - ca.get(name, 0)
+        if d:
+            rows.append((name, f"{ca.get(name, 0):g}",
+                         f"{cb.get(name, 0):g}", f"{d:+g}"))
+    ha, hb = a.get("hists", {}), b.get("hists", {})
+    for name in sorted(set(ha) | set(hb)):
+        sa, sb = ha.get(name, {}), hb.get(name, {})
+        dcount = sb.get("count", 0) - sa.get("count", 0)
+        if not dcount and sa.get("p50") == sb.get("p50"):
+            continue
+        rows.append((
+            f"{name} [hist]",
+            f"n={sa.get('count', 0):g} p50={sa.get('p50', 0):.2e}",
+            f"n={sb.get('count', 0):g} p50={sb.get('p50', 0):.2e}",
+            f"{dcount:+g}",
+        ))
+    for key in sorted(set(a.get("health", {})) | set(b.get("health", {}))):
+        sa_state = a.get("health", {}).get(key, "healthy")
+        sb_state = b.get("health", {}).get(key, "healthy")
+        if sa_state != sb_state:
+            rows.append((f"{key} [health]", sa_state, sb_state, ""))
+    if not rows:
+        print("no differences")
+        return 0
+    w = max(len(r[0]) for r in rows)
+    print(f"{'pvar'.ljust(w)}  {'a'.rjust(24)}  {'b'.rjust(24)}  delta")
+    for name, va, vb, d in rows:
+        print(f"{name.ljust(w)}  {va.rjust(24)}  {vb.rjust(24)}  {d}")
+    return 0
+
+
+def cmd_dump(args) -> int:
+    from ..telemetry import export
+
+    if args.prometheus:
+        path = export.write_prometheus(args.output)
+    else:
+        path = export.write_json(args.output)
+    print(f"wrote {path}")
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.telemetry",
+        description="Scrape, tail, and diff telescope telemetry.",
+    )
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    sc = sub.add_parser("scrape", help="GET a running exporter")
+    sc.add_argument("--url", default=None,
+                    help="full exporter base URL (overrides --port)")
+    sc.add_argument("--port", type=int, default=9464,
+                    help="localhost exporter port (default: %(default)s)")
+    sc.add_argument("--json", action="store_true",
+                    help="scrape /json instead of /metrics")
+    sc.add_argument("--fleet", action="store_true",
+                    help="scrape the rank-0 merged /fleet view")
+    sc.add_argument("-o", "--output", default=None,
+                    help="save to a file instead of stdout")
+    sc.set_defaults(fn=cmd_scrape)
+
+    tl = sub.add_parser("tail", help="poll /json, print counter deltas")
+    tl.add_argument("--url", default=None)
+    tl.add_argument("--port", type=int, default=9464)
+    tl.add_argument("--interval", type=float, default=1.0,
+                    help="poll interval seconds (default: %(default)s)")
+    tl.add_argument("--count", type=int, default=0,
+                    help="stop after N polls (0 = forever)")
+    tl.set_defaults(fn=cmd_tail)
+
+    df = sub.add_parser("diff", help="compare two JSON snapshots")
+    df.add_argument("a")
+    df.add_argument("b")
+    df.set_defaults(fn=cmd_diff)
+
+    dp = sub.add_parser("dump", help="render this process's registries")
+    dp.add_argument("-o", "--output", required=True)
+    dp.add_argument("--prometheus", action="store_true",
+                    help="Prometheus text instead of JSON")
+    dp.set_defaults(fn=cmd_dump)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -314,6 +314,15 @@ def dump_post_mortem(reason: str = "") -> Optional[str]:
         export.write_rank_dump(path, reason=reason)
         logger.warning("trace: dumped %d record(s) to %s (%s)",
                        len(_RECORDER.records()), path, reason or "request")
+        try:
+            # the telemetry snapshot lands next to the trace dump: a
+            # post-mortem needs the counters/health state that led up
+            # to the wedge, not just the event ring
+            from ..telemetry import export as _texport
+
+            _texport.write_json(path[:-5] + "-telemetry.json")
+        except Exception:  # commlint: allow(broadexcept)
+            pass  # telemetry is optional garnish on the trace dump
         return path
     except Exception:  # commlint: allow(broadexcept)
         # last-resort diagnostics must not take the process down

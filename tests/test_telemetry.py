@@ -1,0 +1,599 @@
+"""telescope (PR10): sampler, exporters, fleet merge, straggler -> medic.
+
+Covers: SampleRing wrap + lock-free discipline, deterministic seeded
+tick schedules (byte-identical digests across two controller
+processes), deadline-bounded collection, golden-file Prometheus text
+(sanitization, HELP/TYPE, histogram buckets) and JSON schema
+round-trip (satellite 3), the histogram-class MPI_T pvar surface and
+``pvar_watch`` callbacks (satellites 1-2), fleet merge + robust
+z-score straggler detection, the tier-1 e2e drill (faultline-delayed
+rank flagged within 2 sampling intervals, fabric SUSPECT, live scrape
++ fleet JSON showing the skew), the CLI (scrape/diff/dump), the
+localhost exporter endpoint, and the ``metricname`` commlint rule
+(satellite 5)."""
+
+import json
+import subprocess
+import sys
+import textwrap
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu import telemetry
+from ompi_tpu.analysis.lint import Linter
+from ompi_tpu.core import config, counters
+from ompi_tpu.core.counters import SPC
+from ompi_tpu.ft import inject
+from ompi_tpu.health import ledger
+from ompi_tpu.runtime import modex
+from ompi_tpu.telemetry import export, fleet, sampler, straggler
+from ompi_tpu.tools import mpit
+from ompi_tpu.tools import telemetry as tcli
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    telemetry.reset_for_testing()
+    mpit.clear_watches()
+    inject.disarm()
+    ledger.LEDGER.restore("fabric", cause="test_cleanup")
+
+
+# -- ring mechanics ---------------------------------------------------------
+
+def test_sample_ring_wraps_keeping_newest():
+    ring = sampler.SampleRing(8)
+    assert ring.capacity == 8
+    for i in range(20):
+        ring.push(i, 0, {"n": i}, {}, {}, {}, {})
+    recs = ring.records()
+    assert len(recs) == 8
+    assert [r[0] for r in recs] == list(range(12, 20))
+    assert ring.latest()[3]["n"] == 19
+    d = sampler.sample_to_dict(ring.latest())
+    assert d["seq"] == 19 and d["counters"] == {"n": 19}
+    ring.clear()
+    assert ring.records() == [] and ring.latest() is None
+
+
+def test_collect_sample_shape_and_deadline():
+    ring = sampler.SampleRing(8)
+    SPC.record_latency("pml_send", 0.001)
+    rec = sampler.collect_sample(ring, rank=3)
+    d = sampler.sample_to_dict(rec)
+    assert tuple(d) == sampler.FIELDS
+    assert d["rank"] == 3
+    assert d["counters"] and "pml_send" in d["hists"]
+    assert set(d["sched"]) == {"hits", "misses", "hit_rate"}
+    # an already-expired deadline skips every section but still pushes
+    # a (truncated) sample — the thread never wedges on collection
+    skips0 = SPC.snapshot().get("telemetry_deadline_skips", 0)
+    rec2 = sampler.collect_sample(ring, rank=3,
+                                  deadline=time.monotonic() - 1.0)
+    d2 = sampler.sample_to_dict(rec2)
+    assert d2["counters"] == {} and d2["hists"] == {}
+    assert SPC.snapshot()["telemetry_deadline_skips"] > skips0
+
+
+# -- deterministic schedules ------------------------------------------------
+
+def test_schedule_digest_deterministic_and_seed_sensitive():
+    a = sampler.schedule_digest(7, 100)
+    assert a == sampler.schedule_digest(7, 100)
+    assert a != sampler.schedule_digest(8, 100)
+    assert a != sampler.schedule_digest(7, 200)
+    delays = sampler.planned_delays(7, 100, 16)
+    assert len(delays) == 16
+    # constant base with bounded jitter: every delay in (0.75, 1] x T
+    assert all(0.075 - 1e-9 < d <= 0.100 + 1e-9 for d in delays)
+    s = sampler.Sampler(seed=7, interval_ms=100)
+    assert s.schedule_digest() == a
+
+
+def test_schedule_digest_byte_identical_across_controllers():
+    """The acceptance contract: two separate controller processes with
+    the same seed derive byte-identical sampler schedules."""
+    prog = textwrap.dedent("""
+        import os
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from ompi_tpu.telemetry import sampler
+        print(sampler.schedule_digest(42, 250))
+    """)
+    outs = [
+        subprocess.run([sys.executable, "-c", prog],
+                       capture_output=True, text=True,
+                       timeout=120).stdout.strip()
+        for _ in range(2)
+    ]
+    assert outs[0] and outs[0] == outs[1]
+    assert outs[0] == sampler.schedule_digest(42, 250)
+
+
+# -- Prometheus text exposition (satellite 3: golden file) ------------------
+
+def test_prometheus_text_golden():
+    reg = counters.CounterRegistry()
+    reg.counter("pml_isend_calls", description="isend postings").add(3)
+    reg.hwm("sanitizer_live_requests_hwm", 7)
+    h = reg.histogram("pml_send", description="send latency")
+    h.record_ns(1)     # bucket 0: le = 2 ns
+    h.record_ns(3)     # bucket 1: le = 4 ns
+    h.record_ns(3)
+    golden = "\n".join([
+        "# HELP ompi_tpu_pml_isend_calls isend postings",
+        "# TYPE ompi_tpu_pml_isend_calls counter",
+        "ompi_tpu_pml_isend_calls 3",
+        "# HELP ompi_tpu_sanitizer_live_requests_hwm "
+        "sanitizer_live_requests_hwm",
+        "# TYPE ompi_tpu_sanitizer_live_requests_hwm gauge",
+        "ompi_tpu_sanitizer_live_requests_hwm 7",
+        "# HELP ompi_tpu_pml_send_seconds send latency",
+        "# TYPE ompi_tpu_pml_send_seconds histogram",
+        'ompi_tpu_pml_send_seconds_bucket{le="2e-09"} 1',
+        'ompi_tpu_pml_send_seconds_bucket{le="4e-09"} 3',
+        'ompi_tpu_pml_send_seconds_bucket{le="+Inf"} 3',
+        f"ompi_tpu_pml_send_seconds_sum {float(h.total)!r}",
+        "ompi_tpu_pml_send_seconds_count 3",
+        "# HELP ompi_tpu_health_tier_state health-ledger tier state "
+        "(0=healthy 1=suspect 2=probation 3=quarantined)",
+        "# TYPE ompi_tpu_health_tier_state gauge",
+        'ompi_tpu_health_tier_state{scope="global",tier="dcn"} 3',
+        "",
+    ])
+    text = export.prometheus_text(
+        reg, health={"global/dcn": "quarantined"})
+    assert text == golden
+
+
+def test_prometheus_name_sanitization():
+    assert export.sanitize_name("pml_send") == "pml_send"
+    assert export.sanitize_name("bad-name.q") == "bad_name_q"
+    assert export.sanitize_name("7seconds") == "_7seconds"
+    reg = counters.CounterRegistry()
+    reg.counter("weird-metric.name").add(1)
+    text = export.prometheus_text(reg, health={})
+    assert "ompi_tpu_weird_metric_name 1" in text
+    # the HELP text may carry the raw name; the identifier must not
+    assert "ompi_tpu_weird-" not in text
+
+
+# -- JSON snapshot schema (satellite 3: round-trip) -------------------------
+
+def test_json_snapshot_roundtrip(tmp_path):
+    SPC.record("pml_isend_calls", 2)
+    SPC.record_latency("pml_send", 0.002)
+    snap = export.snapshot_dict(rank=5)
+    assert snap["format"] == "ompi_tpu.telemetry.v1"
+    assert snap["rank"] == 5
+    for key in ("t_unix_ns", "counters", "hists", "health", "sched",
+                "peers"):
+        assert key in snap, key
+    assert snap["hists"]["pml_send"]["count"] >= 1
+    path = str(tmp_path / "snap.json")
+    assert export.write_json(path) == path
+    with open(path) as f:
+        loaded = json.load(f)
+    assert loaded["format"] == snap["format"]
+    assert set(loaded) == set(snap)
+    # the CLI loader accepts it (and rejects non-telemetry JSON)
+    assert tcli._load_snapshot(path)["format"] == snap["format"]
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        json.dump({"format": "something_else"}, f)
+    with pytest.raises(SystemExit):
+        tcli._load_snapshot(bad)
+
+
+# -- MPI_T pvar surface (satellites 1-2) ------------------------------------
+
+def test_pvar_list_carries_class_tags():
+    SPC.record("pml_isend_calls")
+    SPC.hwm("sanitizer_live_requests_hwm", 3)
+    with SPC.timer("sched_tune"):
+        pass
+    SPC.record_latency("pml_send", 0.001)
+    by_name = {d["name"]: d for d in mpit.pvar_list()}
+    assert by_name["pml_isend_calls"]["class"] == "counter"
+    assert by_name["sanitizer_live_requests_hwm"]["class"] == "watermark"
+    assert by_name["sched_tune_seconds"]["class"] == "timer"
+    hist = by_name["pml_send"]
+    assert hist["class"] == "histogram"
+    assert hist["value"] == hist["snapshot"]["count"] >= 1
+    # prefix filtering spans both classes
+    pml = [d["name"] for d in mpit.pvar_list("pml_")]
+    assert "pml_isend_calls" in pml and "pml_send" in pml
+    assert "sched_tune_seconds" not in pml
+
+
+def test_pvar_read_histogram_fields():
+    SPC.record_latency("pml_send", 0.004)
+    snap = mpit.pvar_read("pml_send")
+    assert isinstance(snap, dict) and snap["count"] >= 1
+    p99 = mpit.pvar_read("pml_send:p99")
+    assert isinstance(p99, float) and p99 > 0
+    assert mpit.pvar_read("pml_send:count") == snap["count"]
+    with pytest.raises(KeyError):
+        mpit.pvar_read("no_such_histogram:p50")
+    # scalar reads still work, unknown scalars read as 0
+    assert mpit.pvar_read("definitely_unregistered_pvar") == 0.0
+
+
+def test_pvar_session_histogram_deltas():
+    SPC.record_latency("pml_send", 0.001)
+    sess = mpit.pvar_session()
+    assert sess.read_histograms() == {}  # no new samples yet
+    SPC.record_latency("pml_send", 0.002)
+    SPC.record_latency("pml_send", 0.003)
+    deltas = sess.read_histograms()
+    assert deltas["pml_send"]["count"] == 2  # delta, not total
+    sess.reset()
+    assert sess.read_histograms() == {}
+
+
+def test_categories_group_pvars_by_framework():
+    SPC.record("pml_isend_calls")
+    SPC.record_latency("pml_send", 0.001)
+    cats = mpit.categories()
+    assert "pml" in cats and "telemetry" in cats
+    assert "pml_isend_calls" in cats["pml"]["pvars"]
+    assert "pml_send" in cats["pml"]["pvars"]
+    assert any(cv.startswith("telemetry_")
+               for cv in cats["telemetry"]["cvars"])
+
+
+def test_pvar_watch_fires_on_rise_at_threshold():
+    fired = []
+    h = mpit.pvar_watch("telemetry_test_watch", 3.0,
+                        lambda n, v: fired.append(v))
+    SPC.record("telemetry_test_watch")          # 1 < threshold
+    assert mpit.check_watches() == []
+    SPC.record("telemetry_test_watch", 2)       # 3 >= threshold, rose
+    assert mpit.check_watches() == ["telemetry_test_watch"]
+    assert fired == [3.0] and h.fired == 1
+    assert mpit.check_watches() == []           # no rise: parked gauge
+    SPC.record("telemetry_test_watch")          # rises again above
+    assert mpit.check_watches() == ["telemetry_test_watch"]
+    assert fired == [3.0, 4.0]
+    h.cancel()
+    SPC.record("telemetry_test_watch")
+    assert mpit.check_watches() == []
+    assert h not in mpit.watches()
+
+
+def test_pvar_watch_bare_histogram_watches_count():
+    SPC.record_latency("pml_send", 0.001)
+    seen = []
+    mpit.pvar_watch("pml_send", 1.0, lambda n, v: seen.append(v))
+    assert mpit.check_watches() == ["pml_send"]  # count already >= 1
+    assert seen and seen[0] == float(SPC.get_histogram("pml_send").count)
+
+
+def test_pvar_watch_callback_errors_are_contained():
+    def boom(n, v):
+        raise RuntimeError("tool bug")
+
+    mpit.pvar_watch("telemetry_test_err_watch", 1.0, boom)
+    before = SPC.snapshot().get("mpit_watch_errors", 0)
+    SPC.record("telemetry_test_err_watch")
+    fired = mpit.check_watches()  # must not raise
+    assert fired == ["telemetry_test_err_watch"]
+    assert SPC.snapshot()["mpit_watch_errors"] == before + 1
+
+
+# -- fleet merge ------------------------------------------------------------
+
+def _snap(rank, p50_s, counters_snap=None, peers=None, health=None):
+    h = counters.Histogram("pml_send")
+    for _ in range(8):
+        h.record(p50_s)
+    return {
+        "format": "ompi_tpu.telemetry.v1",
+        "rank": rank,
+        "counters": counters_snap or {},
+        "hists": {"pml_send": h.snapshot()},
+        "health": health or {},
+        "peers": peers or {},
+    }
+
+
+def test_fleet_merge_columns_and_links():
+    snaps = {
+        0: _snap(0, 100e-6, {"sm_send_bytes": 1000, "fp_pad": 1},
+                 peers={"0->1": [4, 256]}),
+        1: _snap(1, 110e-6, {"sm_send_bytes": 900},
+                 peers={"0->1": [1, 64]}, health={"global/shm": "suspect"}),
+    }
+    view = fleet.merge(snaps)
+    assert view["ranks"] == [0, 1]
+    col = view["metrics"]["pml_send_p50_us"]
+    assert col[0] == pytest.approx(100, rel=0.5)
+    assert view["metrics"]["tier_shm_bytes"] == {0: 1000, 1: 900}
+    # non-_bytes counters don't fabricate tier columns
+    assert "tier_fastpath_bytes" not in view["metrics"]
+    assert view["links"]["0->1"] == {0: [4, 256], 1: [1, 64]}
+    assert view["health"][1] == {"global/shm": "suspect"}
+    text = fleet.render_text(view)
+    assert "pml_send_p50_us" in text and "r0" in text and "r1" in text
+
+
+def test_fleet_gather_skips_absent_ranks():
+    modex.put("telemetry/17", _snap(17, 1e-4))
+    got = fleet.gather(19)
+    assert 17 in got and 18 not in got
+
+
+# -- straggler detection ----------------------------------------------------
+
+def test_robust_z_flags_single_outlier_small_fleet():
+    # the classic mean/std z maxes at sqrt(n-1)=1.73 here — the robust
+    # (median/MAD) form must still clear the 3.5 cut
+    zs = straggler.robust_z({0: 100.0, 1: 102.0, 2: 98.0, 3: 5000.0})
+    assert zs[3] > 3.5
+    assert abs(zs[0]) < 1.0
+    # all-identical baseline (MAD = 0) must not divide by zero
+    zs2 = straggler.robust_z({0: 100.0, 1: 100.0, 2: 100.0, 3: 5000.0})
+    assert zs2[3] > 3.5
+
+
+def test_metric_tier_mapping():
+    assert straggler.metric_tier("pml_send_p50_us") == "fabric"
+    assert straggler.metric_tier("coll_allreduce_p50_us") == "device"
+    assert straggler.metric_tier("tier_shm_bytes") == "shm"
+    assert straggler.metric_tier("unrelated_metric") is None
+
+
+def test_detect_high_side_latency_and_low_side_bandwidth():
+    view = {
+        "metrics": {
+            "pml_send_p50_us": {0: 100.0, 1: 105.0, 2: 98.0, 3: 9000.0},
+            "tier_dcn_bytes": {0: 1e9, 1: 1.1e9, 2: 0.9e9, 3: 1e6},
+            # below min_ranks: never considered
+            "coll_allreduce_p50_us": {0: 10.0, 1: 5000.0},
+            # no tier mapping: ignored
+            "mystery_p50_us": {0: 1.0, 1: 1.0, 2: 1.0, 3: 99.0},
+        },
+    }
+    found = straggler.detect(view)
+    by_metric = {f["metric"]: f for f in found}
+    assert set(by_metric) == {"pml_send_p50_us", "tier_dcn_bytes"}
+    assert by_metric["pml_send_p50_us"]["rank"] == 3
+    assert by_metric["pml_send_p50_us"]["tier"] == "fabric"
+    assert by_metric["tier_dcn_bytes"]["rank"] == 3
+    assert by_metric["tier_dcn_bytes"]["z"] < 0  # low-side finding
+
+
+def test_detect_min_rel_gates_ns_jitter():
+    # statistically extreme but only 4% above the median: gated
+    view = {"metrics": {
+        "pml_send_p50_us": {0: 100.0, 1: 100.1, 2: 99.9, 3: 104.0},
+    }}
+    assert straggler.detect(view) == []
+
+
+def test_analyze_stages_then_watch_marks_suspect():
+    assert ledger.state("fabric") == ledger.HEALTHY
+    snaps = {r: _snap(r, 100e-6) for r in range(3)}
+    snaps[3] = _snap(3, 50e-3)
+    found = straggler.analyze(snaps)
+    assert found and found[0]["rank"] == 3
+    # staged, not yet acted on: the pvar-watch hand-off is the seam
+    assert ledger.state("fabric") == ledger.HEALTHY
+    fired = mpit.check_watches()
+    assert "telemetry_straggler_candidates" in fired
+    assert ledger.state("fabric") == ledger.SUSPECT
+    assert straggler.findings()[-1]["rank"] == 3
+    # SUSPECT came from suspect(), not report_failure: no consecutive
+    # failures charged, so skew alone can never reach QUARANTINED
+    entries = ledger.snapshot()["entries"]
+    assert entries["global/fabric"]["failures"] == 0
+    # the trace instant landed
+    from ompi_tpu.trace import recorder
+    names = [r[3] for r in recorder.get().records()]
+    assert "telemetry.straggler" in names
+
+
+def test_ledger_suspect_only_escalates_healthy():
+    ledger.LEDGER.suspect("fabric", cause="unit")
+    assert ledger.state("fabric") == ledger.SUSPECT
+    ledger.LEDGER.quarantine("fabric", cause="unit")
+    ledger.LEDGER.suspect("fabric", cause="unit")  # no demotion
+    assert ledger.state("fabric") == ledger.QUARANTINED
+
+
+# -- the tier-1 e2e drill ---------------------------------------------------
+
+def test_e2e_straggler_drill_two_ticks_to_suspect(tmp_path):
+    """The acceptance drill: a faultline-delayed rank's latency rides
+    per-rank snapshots over the modex; within 2 sampling intervals the
+    straggler detector flags it, fabric lands SUSPECT in the ledger,
+    and both the live Prometheus scrape and the fleet JSON endpoint
+    show the per-rank skew."""
+    world = mt.world()
+    payload = np.arange(64, dtype=np.float32)
+    dst = 1 if world.size > 1 else 0
+
+    def send_block(tag, delayed):
+        h = counters.Histogram("pml_send")
+        if delayed:
+            inject.arm(["delay@pml:op=send,ms=25,count=inf"], seed=0)
+        comm = world.dup()
+        try:
+            for _ in range(5):
+                t0 = time.perf_counter()
+                comm.send(payload, dst, tag, source=0)
+                h.record(time.perf_counter() - t0)
+                comm.recv(0, tag, dest=dst)
+        finally:
+            comm.free()
+            if delayed:
+                inject.disarm()
+        return h.snapshot()
+
+    fleet0 = config.get("telemetry_base_fleet")
+    config.set("telemetry_base_fleet", True)
+    srv = export.start_server(port=0)
+    try:
+        for r in range(4):
+            modex.put(f"telemetry/{r}", {
+                "format": "ompi_tpu.telemetry.v1", "rank": r,
+                "counters": {}, "health": {}, "peers": {},
+                "hists": {"pml_send": send_block(900, delayed=(r == 2))},
+            })
+        s = sampler.Sampler(seed=0, interval_ms=50, fleet_size=4)
+        sampler._SAMPLER = s  # /fleet sizes off the live sampler
+        s.tick()
+        suspect_after = (1 if ledger.state("fabric") == ledger.SUSPECT
+                         else None)
+        s.tick()  # second interval republishes rank 0 post-SUSPECT
+        if suspect_after is None \
+                and ledger.state("fabric") == ledger.SUSPECT:
+            suspect_after = 2
+        assert suspect_after is not None and suspect_after <= 2, \
+            "straggler not flagged within 2 sampling intervals"
+        assert ledger.snapshot()["entries"]["global/fabric"]["state"] \
+            == "suspect"
+
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as rp:
+            metrics = rp.read().decode()
+        assert ('ompi_tpu_health_tier_state{scope="global",'
+                'tier="fabric"} 1') in metrics
+        assert "ompi_tpu_telemetry_ticks" in metrics
+        with urllib.request.urlopen(base + "/fleet", timeout=5) as rp:
+            view = json.load(rp)
+        col = view["metrics"]["pml_send_p50_us"]
+        others = [v for r, v in col.items() if int(r) != 2]
+        assert col["2"] > 10 * max(others)  # the skew is visible
+        # rank 0's column is the live tick's own published snapshot
+        assert view["health"]["0"]["global/fabric"] == "suspect"
+    finally:
+        sampler._SAMPLER = None
+        export.stop_server()
+        config.set("telemetry_base_fleet", fleet0)
+
+
+# -- exporter endpoint + CLI ------------------------------------------------
+
+def test_http_endpoint_serves_metrics_json_and_404():
+    srv = export.start_server(port=0)
+    assert srv is not None and srv.port > 0
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as rp:
+            assert rp.status == 200
+            assert "text/plain" in rp.headers["Content-Type"]
+            assert b"# TYPE" in rp.read()
+        with urllib.request.urlopen(base + "/json", timeout=5) as rp:
+            snap = json.load(rp)
+            assert snap["format"] == "ompi_tpu.telemetry.v1"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/nope", timeout=5)
+        assert ei.value.code == 404
+        # idempotent start returns the running server; off-by-default
+        assert export.start_server(port=0) is srv
+    finally:
+        export.stop_server()
+    assert export.server() is None
+    assert config.get("telemetry_port") == 0  # endpoint is opt-in
+
+
+def test_cli_dump_and_diff(tmp_path, capsys):
+    a = str(tmp_path / "a.json")
+    assert tcli.main(["dump", "-o", a]) == 0
+    SPC.record("pml_isend_calls", 4)
+    SPC.record_latency("pml_send", 0.001)
+    ledger.LEDGER.suspect("fabric", cause="cli_test")
+    b = str(tmp_path / "b.json")
+    assert tcli.main(["dump", "-o", b]) == 0
+    assert tcli.main(["diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "pml_isend_calls" in out and "+4" in out
+    assert "pml_send [hist]" in out
+    assert "global/fabric [health]" in out
+    # prometheus dump renders the text exposition
+    prom = str(tmp_path / "m.prom")
+    assert tcli.main(["dump", "-o", prom, "--prometheus"]) == 0
+    with open(prom) as f:
+        assert "# TYPE ompi_tpu_pml_isend_calls counter" in f.read()
+    # identical files: no differences
+    assert tcli.main(["diff", b, b]) == 0
+    assert "no differences" in capsys.readouterr().out
+
+
+def test_cli_scrape_against_live_endpoint(tmp_path, capsys):
+    srv = export.start_server(port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.port}"
+        assert tcli.main(["scrape", "--url", url]) == 0
+        assert "# TYPE" in capsys.readouterr().out
+        out_file = str(tmp_path / "scraped.json")
+        assert tcli.main(["scrape", "--url", url, "--json",
+                          "-o", out_file]) == 0
+        with open(out_file) as f:
+            assert json.load(f)["format"] == "ompi_tpu.telemetry.v1"
+    finally:
+        export.stop_server()
+
+
+# -- trace post-mortem carries telemetry ------------------------------------
+
+def test_post_mortem_dump_writes_telemetry_sidecar(tmp_path):
+    saved = config.get("trace_base_dir")
+    config.set("trace_base_dir", str(tmp_path))
+    try:
+        from ompi_tpu.trace import recorder
+        path = recorder.dump_post_mortem(reason="test")
+        assert path is not None
+        side = path[:-5] + "-telemetry.json"
+        with open(side) as f:
+            assert json.load(f)["format"] == "ompi_tpu.telemetry.v1"
+    finally:
+        config.set("trace_base_dir", saved)
+
+
+# -- commlint metricname rule (satellite 5) ---------------------------------
+
+def test_metricname_rule_flags_and_passes():
+    lin = Linter()
+    bad = (
+        "from ompi_tpu.core.counters import SPC\n"
+        'SPC.record("pmlSendCalls")\n'          # not snake_case
+        'SPC.record_latency("warp_send", 0.1)\n'  # unknown prefix
+        'SPC.record(f"bogus_{x}_calls")\n'      # f-string, bad prefix
+    )
+    found = [f for f in lin.lint_source(bad) if f.rule == "metricname"]
+    assert len(found) == 3
+    from ompi_tpu.analysis.report import Severity
+    assert all(f.severity is Severity.WARNING for f in found)
+    clean = (
+        "from ompi_tpu.core import counters\n"
+        'counters.SPC.record("pml_isend_calls")\n'
+        'counters.SPC.record_latency(f"coll_{op}_p50", 0.1)\n'
+        'counters.SPC.hwm("telemetry_queue_hwm", 3)\n'
+        "SPC.record(name)\n"                    # dynamic: invisible
+        'other.record("NotASpcCall")\n'         # not an SPC receiver
+    )
+    assert [f for f in lin.lint_source(clean)
+            if f.rule == "metricname"] == []
+
+
+def test_metricname_allow_escape():
+    lin = Linter()
+    src = (
+        "from ompi_tpu.core.counters import SPC\n"
+        'SPC.record("oneOff")  # commlint: allow(metricname)\n'
+    )
+    assert [f for f in lin.lint_source(src)
+            if f.rule == "metricname"] == []
