@@ -1,0 +1,105 @@
+"""Retune-audit rule.
+
+``retuneaudit``: the schedule winner cache is the control plane's only
+mutable decision state — every ``put()`` or version-``bump()`` changes
+which algorithm future collectives run. An install site that emits no
+trace instant and bumps no SPC counter is invisible: the flight
+recorder shows the algorithm switching with no ``sched.retune`` /
+``sched.tune_winner`` event explaining why, and the Prometheus side
+shows ``sched_retunes`` flat while behaviour changed. This rule keeps
+the evidence contract: each cache-install scope must also carry a
+span/instant emission or an SPC record.
+
+Evidence that satisfies the rule, anywhere in the same scope as the
+install call: a call named ``instant``/``span``/``record``/
+``record_latency``.
+
+Suppression: ``# commlint: allow(retuneaudit)`` on or above the call
+line, for deliberately silent installs (test fixtures seeding a cache,
+load paths replaying already-evidenced decisions).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..report import Severity
+from . import COMMLINT, LintRule, call_name, scope_walk, scopes
+
+#: Attribute-call names that install/replace a cache winner.
+_INSTALL_CALLS = frozenset({"put", "bump"})
+
+#: Call names that count as audit evidence inside the same scope.
+_EVIDENCE_CALLS = frozenset({
+    "instant", "span", "record", "record_latency",
+})
+
+
+def _receiver_chain(node: ast.AST) -> Optional[str]:
+    """Dotted receiver of an attribute call: ``_cache.CACHE.bump(...)``
+    -> ``_cache.CACHE``. None for non-dotted shapes."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_cache_receiver(chain: str) -> bool:
+    """True when the dotted receiver names the schedule cache (the
+    ``CACHE`` singleton or a ``*cache`` binding) — modex/osc/pgas
+    ``put()`` surfaces never match."""
+    last = chain.rsplit(".", 1)[-1]
+    return "CACHE" in chain.split(".") or last.lower().endswith("cache")
+
+
+def _install_calls(scope: ast.AST) -> Iterable[ast.Call]:
+    for node in scope_walk(scope):
+        if not isinstance(node, ast.Call) \
+                or not isinstance(node.func, ast.Attribute):
+            continue
+        if node.func.attr not in _INSTALL_CALLS:
+            continue
+        chain = _receiver_chain(node.func.value)
+        if chain is not None and _is_cache_receiver(chain):
+            yield node
+
+
+def _has_evidence(scope: ast.AST) -> bool:
+    for node in scope_walk(scope):
+        if call_name(node) in _EVIDENCE_CALLS:
+            return True
+    return False
+
+
+@COMMLINT.register
+class RetuneAuditRule(LintRule):
+    NAME = "retuneaudit"
+    PRIORITY = 41
+    DESCRIPTION = ("schedule-cache put()/bump() sites must emit trace "
+                   "or SPC evidence in the same scope")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        for scope, _is_module in scopes(ctx.tree):
+            installs = list(_install_calls(scope))
+            if not installs:
+                continue
+            if _has_evidence(scope):
+                continue
+            for call in installs:
+                if ctx.suppressed(call.lineno, self.NAME):
+                    continue
+                yield self.finding(
+                    ctx, call,
+                    f"cache {call.func.attr}() installs a schedule "
+                    "winner with no adjacent trace instant or SPC "
+                    "record — the algorithm switch leaves no audit "
+                    "trail; emit a sched.* instant or count the "
+                    "install (or annotate commlint: allow(retuneaudit))",
+                )

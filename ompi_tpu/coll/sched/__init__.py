@@ -77,8 +77,13 @@ def build_schedule(algo: str, nranks: int, *, segments: int = 2,
     """Build (memoized) the Schedule behind a registered sched_* name,
     enriched with live topology (ring order, host groups) when the
     mesh matches."""
+    from . import retune
+
+    # the straggler-penalty state is part of the program: a reroot or
+    # segment change must rebuild, not hit the memo
     key = (algo, nranks, segments,
-           tuple(map(tuple, groups)) if groups else None)
+           tuple(map(tuple, groups)) if groups else None,
+           retune.penalty_stamp())
     if algo == "sched_quant":
         from .. import quant
 
@@ -99,10 +104,12 @@ def build_schedule(algo: str, nranks: int, *, segments: int = 2,
         else:
             sch = ir.recursive_doubling(nranks)
     elif algo == "sched_ring_seg":
-        sch = ir.segmented_ring(nranks, segments,
+        sch = ir.segmented_ring(nranks,
+                                retune.effective_segments(segments),
                                 order=_topo_order(nranks))
     elif algo == "sched_hier":
-        sch = ir.hierarchical(groups or _host_groups(nranks))
+        sch = ir.hierarchical(
+            retune.reroot_groups(groups or _host_groups(nranks)))
     elif algo == "sched_quant":
         from .. import quant
 
@@ -180,13 +187,15 @@ def _usable(opname: str, algo: str, dtype, op) -> bool:
 
 
 def lookup(opname: str, nbytes_per_rank: int, nranks: int, dtype=None,
-           op=None) -> Optional[str]:
+           op=None, scope: Optional[str] = None) -> Optional[str]:
     """The compiled-schedule cache consult. Returns the tuned winner's
     algorithm name, or None (miss / disabled / unusable winner) — the
     caller then falls back to the static priors. Emits
     sched.cache_hit/sched.cache_miss instants and the matching SPC
     counters; misses are only counted once the cache is active so an
-    untuned fleet doesn't drown monitoring in miss noise."""
+    untuned fleet doesn't drown monitoring in miss noise. With an SLO
+    target in force for ``scope`` the winner is replaced by the
+    cheapest-wire frontier point meeting the target (slo.py)."""
     from . import autotune, cache as _cache
 
     if not _cache._enable_var.value:
@@ -212,6 +221,16 @@ def lookup(opname: str, nbytes_per_rank: int, nranks: int, dtype=None,
         return None
     SPC.record("sched_cache_hits")
     tspan.instant("sched.cache_hit", cat="sched", key=key, algo=algo)
+    from . import slo
+
+    target = slo.target_for(scope)
+    if target > 0:
+        pick = slo.frontier_pick(ent, target)
+        if pick and pick != algo and _usable(opname, pick, dtype, op):
+            SPC.record("sched_slo_frontier_picks")
+            tspan.instant("sched.slo_pick", cat="sched", key=key,
+                          algo=pick, winner=algo, target_us=target)
+            return pick
     return algo
 
 

@@ -260,12 +260,23 @@ def tune(nranks: int, *, comm=None, opname: str = "allreduce",
                 continue
             best = min(times, key=times.get)
             key = _cache.cache_key(opname, size, nranks, dtype, topo_fp)
+            # the latency/bandwidth frontier rides the entry (excluded
+            # from the digest) so SLO selection and retunes can re-rank
+            # candidates without a fresh sweep
+            frontier = [
+                {"algo": a,
+                 "score": float(sc),
+                 "steps": float(_steps_and_wire(a, size, nranks)[0]),
+                 "wire": float(_steps_and_wire(a, size, nranks)[1])}
+                for a, sc in sorted(times.items(), key=lambda kv: kv[1])
+            ]
             _cache.CACHE.put(
                 key, best, schedule=_schedule_id(best, nranks),
                 source=mode,
                 score=times[best] if mode == "model" else None,
                 tune_ms=(times[best] * 1e3 if mode == "measure"
                          else None),
+                frontier=frontier,
             )
             winners[key] = best
             tspan.instant("sched.tune_winner", cat="sched", key=key,

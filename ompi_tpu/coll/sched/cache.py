@@ -106,16 +106,85 @@ class ScheduleCache:
 
     def put(self, key: str, algorithm: str, *, schedule: str = "",
             source: str = "autotune", tune_ms: Optional[float] = None,
-            score: Optional[float] = None) -> None:
+            score: Optional[float] = None,
+            frontier: Optional[list] = None,
+            baseline_p50_us: Optional[float] = None) -> None:
         ent = {"algorithm": algorithm, "schedule": schedule,
-               "source": source}
+               "source": source, "version": 1}
         if tune_ms is not None:
             ent["tune_ms"] = round(float(tune_ms), 3)
         if score is not None:
             ent["score"] = float(score)
+        if frontier is not None:
+            ent["frontier"] = list(frontier)
+        if baseline_p50_us is not None:
+            ent["baseline_p50_us"] = float(baseline_p50_us)
         with self._mu:
             self._entries[key] = ent
             self._generation += 1
+
+    def bump(self, key: str, algorithm: str, *, schedule: str = "",
+             source: str = "retune", tune_ms: Optional[float] = None,
+             score: Optional[float] = None,
+             frontier: Optional[list] = None,
+             baseline_p50_us: Optional[float] = None) -> int:
+        """Install a new winner as a **version-bumped** entry: the
+        prior winner survives one level deep under ``"previous"`` so a
+        bad retune can be rolled back. Never mutates the old entry in
+        place — a memoized dispatch plan stamped with the previous
+        cache generation keeps running its old schedule until its memo
+        invalidates. Returns the new version number."""
+        new = {"algorithm": algorithm, "schedule": schedule,
+               "source": source}
+        if tune_ms is not None:
+            new["tune_ms"] = round(float(tune_ms), 3)
+        if score is not None:
+            new["score"] = float(score)
+        if frontier is not None:
+            new["frontier"] = list(frontier)
+        if baseline_p50_us is not None:
+            new["baseline_p50_us"] = float(baseline_p50_us)
+        with self._mu:
+            old = self._entries.get(key)
+            if old is None:
+                new["version"] = 1
+            else:
+                new["version"] = int(old.get("version", 1)) + 1
+                new["previous"] = {
+                    "algorithm": old.get("algorithm", ""),
+                    "schedule": old.get("schedule", ""),
+                    "version": int(old.get("version", 1)),
+                    "source": old.get("source", ""),
+                }
+            self._entries[key] = new
+            self._generation += 1
+            return new["version"]
+
+    def rollback(self, key: str) -> bool:
+        """Restore the ``"previous"`` winner a ``bump()`` retained.
+        Returns False when there is nothing to roll back to."""
+        with self._mu:
+            ent = self._entries.get(key)
+            prev = (ent or {}).get("previous")
+            if not prev:
+                return False
+            restored = {"algorithm": prev.get("algorithm", ""),
+                        "schedule": prev.get("schedule", ""),
+                        "source": prev.get("source", "") or "rollback",
+                        "version": int(ent.get("version", 1)) + 1}
+            self._entries[key] = restored
+            self._generation += 1
+            return True
+
+    def set_baseline(self, key: str, p50_us: float) -> None:
+        """Stamp the live-measured p50 the watchtower drifts against.
+        Non-semantic (excluded from the digest) so observation never
+        perturbs the byte-identity contract; does not bump the
+        generation for the same reason."""
+        with self._mu:
+            ent = self._entries.get(key)
+            if ent is not None:
+                ent["baseline_p50_us"] = float(p50_us)
 
     def get(self, key: str) -> Optional[dict]:
         return self._entries.get(key)
@@ -149,7 +218,8 @@ class ScheduleCache:
                 "version": VERSION,
                 "entries": {
                     k: {"algorithm": e["algorithm"],
-                        "schedule": e.get("schedule", "")}
+                        "schedule": e.get("schedule", ""),
+                        "version": int(e.get("version", 1))}
                     for k, e in sorted(self._entries.items())
                 },
             }

@@ -444,18 +444,21 @@ def _nbytes(x) -> int:
 
 
 def _sched_lookup(opname: str, nbytes: int, nranks: int, dtype=None,
-                  op=None) -> Optional[str]:
+                  op=None, scope: Optional[str] = None) -> Optional[str]:
     """Compiled-schedule cache consult (the precedence slot between the
     correctness guards and the static priors). ``nbytes`` is bytes per
     rank — the same convention as Rules bands and the cache's size
-    buckets."""
+    buckets. ``scope`` carries the communicator identity for SLO
+    frontier selection."""
     from . import sched
 
-    return sched.lookup(opname, nbytes, nranks, dtype=dtype, op=op)
+    return sched.lookup(opname, nbytes, nranks, dtype=dtype, op=op,
+                        scope=scope)
 
 
 def decide_allreduce(op: Op, nbytes: int, nranks: int, dtype=None,
-                     allow_quant: Optional[bool] = None) -> str:
+                     allow_quant: Optional[bool] = None,
+                     scope: Optional[str] = None) -> str:
     """Pick the allreduce algorithm; precision-aware since the quant
     tier exists.  ``nbytes`` is BYTES PER RANK (the block size of the
     rank-major payload, see _nbytes) — the one byte convention shared
@@ -478,7 +481,8 @@ def decide_allreduce(op: Op, nbytes: int, nranks: int, dtype=None,
             return got
     if not op.commutative or _is_joint(op):
         return "gather_reduce"
-    tuned_pick = _sched_lookup("allreduce", nbytes, nranks, dtype, op)
+    tuned_pick = _sched_lookup("allreduce", nbytes, nranks, dtype, op,
+                               scope=scope)
     if tuned_pick:
         if allow_quant is False and (is_quant_algo(tuned_pick)
                                      or tuned_pick == "sched_quant"):
@@ -750,6 +754,7 @@ class TunedColl(XlaColl):
         algo = decide_allreduce(
             op, nbytes, comm.size,
             dtype=x.dtype if is_plain_array else None,
+            scope=str(comm.cid),
         )
         from . import breaker
 
@@ -824,11 +829,12 @@ class TunedColl(XlaColl):
         from ..health import ledger as health
         from . import breaker
 
-        from .sched import cache as sched_cache
+        from .sched import cache as sched_cache, slo as sched_slo
 
         stamp = (config.generation(), breaker.generation(),
                  health.LEDGER.generation(),
-                 sched_cache.CACHE.generation())
+                 sched_cache.CACHE.generation(),
+                 sched_slo.generation())
         cache = comm.__dict__.setdefault("_tuned_fast", {})
         key = (x.shape, x.dtype.name, op.cache_key)
         ent = cache.get(key)

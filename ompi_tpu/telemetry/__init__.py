@@ -18,6 +18,12 @@ Four pieces (see docs/TELEMETRY.md for the operator guide):
   ``mpit.pvar_watch``; findings emit ``telemetry.straggler`` trace
   instants and mark the implicated tier SUSPECT so medic's prober
   takes over.
+- :mod:`.watchtower` — the closed-loop controller riding the sampler
+  tick (``telemetry_watchtower_enable``, off by default): sustained
+  live-vs-baseline p50 drift version-bump retunes the schedule cache,
+  persistent stragglers become topology penalties that reshape
+  hierarchical/segmented schedules, and SLO violation minutes are
+  accounted per tenant scope.
 
 Lifecycle: ``api.init`` calls :func:`at_init` (starts the sampler when
 ``telemetry_base_autostart`` is set and the exporter endpoint when
@@ -27,7 +33,7 @@ Lifecycle: ``api.init`` calls :func:`at_init` (starts the sampler when
 
 from __future__ import annotations
 
-from . import export, fleet, sampler, straggler  # noqa: F401
+from . import export, fleet, sampler, straggler, watchtower  # noqa: F401
 from .sampler import SampleRing, Sampler, schedule_digest  # noqa: F401
 
 
@@ -54,3 +60,5 @@ def reset_for_testing() -> None:
     sampler.stop()
     export.stop_server()
     straggler.reset_for_testing()
+    watchtower.reset_for_testing()
+    fleet.reset_for_testing()

@@ -96,6 +96,14 @@ def prometheus_text(registry: counters.CounterRegistry = SPC,
         lines.append(f'{name}_bucket{{le="+Inf"}} {h.count}')
         lines.append(f"{name}_sum {repr(float(h.total))}")
         lines.append(f"{name}_count {h.count}")
+    if registry is SPC:
+        # control-plane series (satellite: the sched winner-cache and
+        # retune counters plus ledger transition counts must reach
+        # /metrics even before the first hit/retune — a dashboard that
+        # only sees a series after the first event can't alert on it).
+        # Gated on the process registry so golden-file renders of a
+        # hand-built registry stay byte-stable.
+        lines.extend(_control_plane_lines(registry, namespace))
     if health is None:
         health = _health_states()
     state_name = f"{namespace}_health_tier_state"
@@ -110,6 +118,64 @@ def prometheus_text(registry: counters.CounterRegistry = SPC,
                 f"{STATE_VALUES.get(state, -1)}"
             )
     return "\n".join(lines) + "\n"
+
+
+#: Counters guaranteed a series in /metrics (emitted at 0 when the
+#: registry hasn't seen them yet): the winner-cache consult stats and
+#: the watchtower loop's own decision counters.
+GUARANTEED_COUNTERS = (
+    ("sched_cache_hits", "schedule winner-cache hits"),
+    ("sched_cache_misses", "schedule winner-cache misses"),
+    ("sched_cache_version_mismatch",
+     "schedule cache files ignored for version skew"),
+    ("sched_retunes", "watchtower version-bumped cache retunes"),
+    ("sched_drift_detected",
+     "ticks a cache key's live p50 exceeded drift_ratio x baseline"),
+    ("sched_retune_suppressed",
+     "due retunes suppressed by hysteresis/cooldown/budget"),
+)
+
+
+def _control_plane_lines(registry: counters.CounterRegistry,
+                         namespace: str) -> list[str]:
+    """Extra exposition for the live process registry: guaranteed-zero
+    control-loop counters, health-ledger transition totals, and
+    per-scope SLO violation minutes."""
+    lines: list[str] = []
+    snap = registry.snapshot()
+    for cname, help_text in GUARANTEED_COUNTERS:
+        if cname in snap:
+            continue  # already exported with its registered metadata
+        name = f"{namespace}_{cname}"
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} 0")
+    try:
+        from ..health import ledger
+
+        transitions = int(ledger.snapshot().get("transitions", 0))
+    except ImportError:
+        transitions = None
+    if transitions is not None:
+        name = f"{namespace}_health_ledger_transitions_total"
+        lines.append(f"# HELP {name} health-ledger state transitions")
+        lines.append(f"# TYPE {name} counter")
+        lines.append(f"{name} {transitions}")
+    try:
+        from ..coll.sched import slo
+
+        minutes = slo.violation_minutes()
+    except ImportError:
+        minutes = {}
+    if minutes:
+        name = f"{namespace}_slo_violation_minutes"
+        lines.append(f"# HELP {name} minutes the live p50 spent over "
+                     "the scope's slo_p50_us target")
+        lines.append(f"# TYPE {name} gauge")
+        for scope, v in sorted(minutes.items()):
+            lines.append(
+                f'{name}{{scope="{sanitize_name(scope)}"}} {_fmt(v)}')
+    return lines
 
 
 def _health_states() -> dict[str, str]:

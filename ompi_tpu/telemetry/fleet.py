@@ -39,18 +39,54 @@ def publish(sample: dict) -> None:
     modex.publish_telemetry(sample)
 
 
+#: rank -> last successfully gathered sample (and its ``seq``). A rank
+#: that published before but missed this tick — key vanished (modex
+#: restart) or ``seq`` unchanged (late publisher, paused process) —
+#: degrades to its last-seen sample tagged ``"stale": True`` instead
+#: of leaving a hole or double-counting old data silently; either way
+#: the straggler detector's robust-z columns keep a full rank set. A
+#: rank that NEVER published stays absent (opt-in stays opt-in).
+_LAST_SEEN: dict[int, dict] = {}
+_LAST_SEQ: dict[int, int] = {}
+
+
 def gather(nproc: int, timeout_s: float = 0.0) -> dict[int, dict]:
-    """Collect every published per-rank sample; missing ranks are
+    """Collect every published per-rank sample; ranks that miss this
+    tick fall back to their last-seen sample (counted in
+    ``telemetry_fleet_stale_ranks``), never-published ranks are
     skipped (see module doc)."""
+    from ..core.counters import SPC
     from ..runtime import modex
 
     out: dict[int, dict] = {}
     for r in range(nproc):
         try:
-            out[r] = modex.peer_telemetry(r, timeout_s=timeout_s)
+            got = modex.peer_telemetry(r, timeout_s=timeout_s)
         except modex.ModexError:
+            prev = _LAST_SEEN.get(r)
+            if prev is not None:
+                stale = dict(prev)
+                stale["stale"] = True
+                out[r] = stale
+                SPC.record("telemetry_fleet_stale_ranks")
             continue
+        seq = got.get("seq")
+        if (r in _LAST_SEEN and seq is not None
+                and _LAST_SEQ.get(r) == seq):
+            got = dict(got)
+            got["stale"] = True
+            SPC.record("telemetry_fleet_stale_ranks")
+        else:
+            _LAST_SEEN[r] = got
+            if seq is not None:
+                _LAST_SEQ[r] = seq
+        out[r] = got
     return out
+
+
+def reset_for_testing() -> None:
+    _LAST_SEEN.clear()
+    _LAST_SEQ.clear()
 
 
 def tier_bytes(counters_snap: dict) -> dict[str, float]:

@@ -271,6 +271,11 @@ class Sampler:
         from ..tools import mpit
 
         mpit.check_watches()
+        from . import watchtower
+
+        # after check_watches so this tick's straggler findings are
+        # already drained into the findings log the controller reads
+        watchtower.maybe_tick(sample_to_dict(rec))
         return rec
 
     # -- thread lifecycle ----------------------------------------------

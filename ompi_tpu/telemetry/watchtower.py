@@ -1,0 +1,355 @@
+"""watchtower: the closed-loop control plane over the schedule cache.
+
+Telescope observes (sampler/fleet/straggler); the sched compiler
+predicts (winner cache scores); watchtower closes the loop. Riding
+each sampler tick (``Sampler.tick`` calls ``maybe_tick``; off by
+default — ``telemetry_watchtower_enable``), it:
+
+1. **Drift detection.** Per cache key, compares the live
+   ``coll_<op>`` histogram p50 against the baseline p50 stamped on
+   the entry when the key was first observed. Sustained drift —
+   ``telemetry_watchtower_drift_ratio`` for
+   ``telemetry_watchtower_drift_ticks`` consecutive ticks, the health
+   ledger's both-edges hysteresis shape (``clear_ticks`` ticks below
+   the ratio reset the streak) — triggers ``retune.retune_key``: a
+   fresh deterministic sweep excluding the falsified incumbent,
+   installed as a **version-bumped** cache entry. The bump raises the
+   cache generation so memoized dispatch plans re-consult at their
+   next call; a schedule is never mutated mid-flight. Single-tick
+   noise never retunes; a cooldown and a per-tick budget bound the
+   retune rate (suppressions are counted, not silent).
+
+2. **Straggler reshaping.** Ranks the straggler detector flags in
+   ``telemetry_watchtower_straggler_ticks`` or more ticks become
+   topology penalties (``retune.set_topology_penalties``): the
+   hierarchical generator re-roots its trees away from them and the
+   segmented ring halves its chunk size under skew, and every cached
+   ``sched_hier``/``sched_ring_seg`` key is version-bump retuned so
+   the recorded schedule digest matches the reshaped program.
+
+3. **SLO accounting.** For every scope with an ``slo_p50_us`` target
+   (coll/sched/slo.py), ticks where the live p50 misses the target
+   accumulate violation minutes, exported per tenant scope.
+
+Observability of the loop itself: every decision emits a
+``sched.retune`` trace instant and SPC counters (``sched_retunes``,
+``sched_drift_detected``, ``sched_retune_suppressed``), plus
+watchtower gauges in the Prometheus exposition.
+
+Determinism: the loop keeps a timestamp-free decision log;
+``digest()`` hashes it. Decisions are a pure function of the observed
+sample sequence, the seed, and the cvars — same-seed controllers fed
+the same samples produce byte-identical retune logs and cache digests
+(the acceptance drill runs two subprocesses to prove it). Each tick is
+deadline-bounded like the sampler's sections: keys not evaluated
+before ``telemetry_watchtower_deadline_ms`` wait for the next tick.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from typing import Optional
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.logging import get_logger
+
+logger = get_logger("telemetry")
+
+_enable = config.register(
+    "telemetry", "watchtower", "enable", type=bool, default=False,
+    description="Run the closed-loop drift/retune controller on every "
+    "sampler tick",
+)
+_drift_ratio = config.register(
+    "telemetry", "watchtower", "drift_ratio", type=float, default=2.0,
+    description="Live-p50 / baseline-p50 ratio at or above which a "
+    "cache key counts as drifting this tick",
+)
+_drift_ticks = config.register(
+    "telemetry", "watchtower", "drift_ticks", type=int, default=2,
+    description="Consecutive drifting ticks before a retune fires "
+    "(the down edge of the hysteresis; single-tick noise never "
+    "retunes)",
+)
+_clear_ticks = config.register(
+    "telemetry", "watchtower", "clear_ticks", type=int, default=2,
+    description="Consecutive clean ticks before an accumulated drift "
+    "streak resets (the up edge of the hysteresis)",
+)
+_cooldown_ticks = config.register(
+    "telemetry", "watchtower", "cooldown_ticks", type=int, default=5,
+    description="Ticks after a retune during which the same key is "
+    "suppressed (counted in sched_retune_suppressed)",
+)
+_budget = config.register(
+    "telemetry", "watchtower", "max_retunes_per_tick", type=int,
+    default=1,
+    description="Drift-retune budget per tick; keys over budget are "
+    "suppressed (counted), never dropped — their streak persists",
+)
+_straggler_ticks = config.register(
+    "telemetry", "watchtower", "straggler_ticks", type=int, default=2,
+    description="Ticks a rank must appear in straggler findings "
+    "before it becomes a topology penalty (reroot/chunk-shrink)",
+)
+_deadline_ms = config.register(
+    "telemetry", "watchtower", "deadline_ms", type=int, default=20,
+    description="Per-tick evaluation budget; keys not reached before "
+    "it wait for the next tick (telemetry_watchtower_deadline_skips)",
+)
+
+
+class Watchtower:
+    """The per-process control loop (test-drivable via ``tick``)."""
+
+    def __init__(self, *, seed: Optional[int] = None,
+                 interval_ms: Optional[int] = None) -> None:
+        from ..coll.sched import autotune
+
+        self.seed = (autotune._seed_var.value if seed is None
+                     else int(seed))
+        self.interval_ms = interval_ms
+        self.ticks = 0
+        #: key -> {"version", "baseline", "drift", "clear", "cooldown"}
+        self._keys: dict[str, dict] = {}
+        #: rank -> ticks seen in straggler findings
+        self._rank_ticks: dict[int, int] = {}
+        self._findings_seen = 0
+        #: timestamp-free decision log (the byte-identity contract)
+        self._log: list[dict] = []
+        self._mu = threading.Lock()
+
+    # -- observability -------------------------------------------------
+
+    def digest(self) -> str:
+        """sha256 over the canonical decision log — byte-identical for
+        same-seed controllers fed the same sample sequence."""
+        with self._mu:
+            blob = json.dumps(self._log, sort_keys=True,
+                              separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def log(self) -> list[dict]:
+        with self._mu:
+            return [dict(e) for e in self._log]
+
+    def _note(self, **entry) -> None:
+        with self._mu:
+            self._log.append(entry)
+            del self._log[:-1024]
+
+    # -- live metric lookup --------------------------------------------
+
+    @staticmethod
+    def _live_p50_us(parsed: dict, hists: dict) -> Optional[float]:
+        """The live p50 (µs) a cache key drifts against: the
+        per-bucket histogram when one exists (tests target one key),
+        else the per-op histogram."""
+        for name in (f"coll_{parsed['opname']}_b{parsed['bucket']}",
+                     f"coll_{parsed['opname']}"):
+            snap = hists.get(name)
+            if snap and snap.get("count", 0) > 0:
+                return float(snap.get("p50", 0.0)) * 1e6
+        return None
+
+    # -- one control quantum -------------------------------------------
+
+    def tick(self, sample: Optional[dict] = None) -> list[dict]:
+        """Evaluate every cache key against the live histograms and
+        retune the drifted ones. ``sample`` is a sampler sample dict
+        (None = snapshot the registries directly). Returns this tick's
+        retune results."""
+        from ..coll.sched import cache as scache, retune, slo
+
+        self.ticks += 1
+        deadline = time.monotonic() + max(1, _deadline_ms.value) / 1e3
+        if sample is None:
+            hists = SPC.histogram_snapshots()
+        else:
+            hists = sample.get("hists") or {}
+        retunes: list[dict] = []
+        budget = max(0, int(_budget.value))
+        drifting = 0
+        entries = scache.CACHE.entries()
+        for key in sorted(entries):
+            if time.monotonic() >= deadline:
+                SPC.record("telemetry_watchtower_deadline_skips")
+                break
+            got = self._eval_key(key, entries[key], hists,
+                                 budget - len(retunes), retune)
+            if got == "drift":
+                drifting += 1
+            elif isinstance(got, dict):
+                drifting += 1
+                retunes.append(got)
+        self._straggler_sweep(retune, entries)
+        self._slo_sweep(slo, hists)
+        SPC.hwm("telemetry_watchtower_keys_tracked", len(entries))
+        SPC.hwm("telemetry_watchtower_drifting_keys", drifting)
+        return retunes
+
+    def _eval_key(self, key: str, ent: dict, hists: dict,
+                  budget: int, retune):
+        """One key's hysteresis step. Returns a retune result dict,
+        "drift" (drifting, no retune this tick), or None."""
+        from ..coll.sched import cache as scache
+
+        parsed = retune.parse_key(key)
+        if parsed is None:
+            return None
+        st = self._keys.get(key)
+        version = int(ent.get("version", 1))
+        if st is None or st["version"] != version:
+            # new key, or a retune/rollback installed a new program:
+            # restart observation — the old baseline measured the old
+            # schedule
+            st = self._keys[key] = {"version": version,
+                                    "baseline": None, "drift": 0,
+                                    "clear": 0, "cooldown": 0}
+        if st["cooldown"] > 0:
+            st["cooldown"] -= 1
+        live = self._live_p50_us(parsed, hists)
+        if live is None or live <= 0:
+            return None
+        if st["baseline"] is None:
+            st["baseline"] = live
+            scache.CACHE.set_baseline(key, live)
+            return None
+        ratio = live / st["baseline"]
+        if ratio < float(_drift_ratio.value):
+            st["clear"] += 1
+            if st["clear"] >= max(1, int(_clear_ticks.value)):
+                st["drift"] = 0
+            return None
+        st["clear"] = 0
+        st["drift"] += 1
+        SPC.record("sched_drift_detected")
+        if st["drift"] < max(1, int(_drift_ticks.value)):
+            return "drift"
+        if st["cooldown"] > 0 or budget <= 0:
+            SPC.record("sched_retune_suppressed")
+            self._note(tick=self.ticks, key=key, action="suppressed",
+                       reason="cooldown" if st["cooldown"] > 0
+                       else "budget")
+            return "drift"
+        got = retune.retune_key(
+            key, reason="drift", seed=self.seed,
+            exclude=(ent.get("algorithm", ""),),
+            live_p50_us=round(live, 3),
+        )
+        if got is None:
+            self._note(tick=self.ticks, key=key, action="failed",
+                       reason="drift")
+            return "drift"
+        st["version"] = got["version"]
+        st["baseline"] = None
+        st["drift"] = 0
+        st["cooldown"] = max(0, int(_cooldown_ticks.value))
+        self._note(tick=self.ticks, key=key, action="retune",
+                   reason="drift", prev=got["previous"],
+                   algo=got["algorithm"], version=got["version"])
+        return got
+
+    # -- straggler findings -> topology penalties ----------------------
+
+    def _straggler_sweep(self, retune, entries: dict) -> None:
+        """Promote persistent straggler findings to topology penalties
+        and version-bump the shape-sensitive cached schedules so their
+        recorded digests match the reshaped programs."""
+        from . import straggler
+
+        log = straggler.findings()
+        fresh = log[self._findings_seen:] if \
+            self._findings_seen <= len(log) else log
+        self._findings_seen = len(log)
+        for rank in sorted({f["rank"] for f in fresh}):
+            self._rank_ticks[rank] = self._rank_ticks.get(rank, 0) + 1
+        need = max(1, int(_straggler_ticks.value))
+        slow = frozenset(r for r, n in self._rank_ticks.items()
+                         if n >= need)
+        if not slow or slow <= retune.penalized_ranks():
+            return
+        if not retune.set_topology_penalties(slow, skew=True):
+            return
+        self._note(tick=self.ticks, action="penalty",
+                   slow_ranks=sorted(slow), skew=True)
+        for key in sorted(entries):
+            if entries[key].get("algorithm") in ("sched_hier",
+                                                 "sched_ring_seg"):
+                got = retune.retune_key(key, reason="straggler",
+                                        seed=self.seed)
+                if got is not None:
+                    st = self._keys.get(key)
+                    if st is not None:
+                        st["version"] = got["version"]
+                        st["baseline"] = None
+                        st["drift"] = 0
+                    self._note(tick=self.ticks, key=key,
+                               action="retune", reason="straggler",
+                               prev=got["previous"],
+                               algo=got["algorithm"],
+                               version=got["version"])
+
+    # -- SLO violation accounting --------------------------------------
+
+    def _interval_s(self) -> float:
+        if self.interval_ms:
+            return max(1, int(self.interval_ms)) / 1e3
+        from . import sampler as _sampler
+
+        return max(1, int(_sampler._interval.value or 1000)) / 1e3
+
+    def _slo_sweep(self, slo, hists: dict) -> None:
+        snap = hists.get("coll_allreduce")
+        if not snap or snap.get("count", 0) <= 0:
+            return
+        live_us = float(snap.get("p50", 0.0)) * 1e6
+        for scope, target in sorted(slo.targets().items()):
+            if live_us > target > 0:
+                slo.note_violation(scope, self._interval_s())
+
+
+# -- module singleton ---------------------------------------------------------
+
+_WT: Optional[Watchtower] = None
+_mu = threading.Lock()
+
+
+def enabled() -> bool:
+    return bool(_enable.value)
+
+
+def get() -> Watchtower:
+    """The process watchtower (created on first use)."""
+    global _WT
+    with _mu:
+        if _WT is None:
+            _WT = Watchtower()
+        return _WT
+
+
+def maybe_tick(sample: Optional[dict] = None) -> None:
+    """The sampler-tick hook: run one control quantum when enabled;
+    a broken controller costs this tick its decisions, never the
+    sampler thread."""
+    if not enabled():
+        return
+    try:
+        get().tick(sample)
+    except Exception:  # commlint: allow(broadexcept)
+        logger.exception("telemetry: watchtower tick failed")
+        SPC.record("telemetry_watchtower_errors")
+
+
+def reset_for_testing() -> None:
+    global _WT
+    with _mu:
+        _WT = None
+
+
+__all__ = ["Watchtower", "enabled", "get", "maybe_tick",
+           "reset_for_testing"]
