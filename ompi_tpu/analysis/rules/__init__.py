@@ -267,6 +267,7 @@ def ensure_rules() -> None:
         from . import quantuse  # noqa: F401
         from . import requests  # noqa: F401
         from . import retuneaudit  # noqa: F401
+        from . import revokecheck  # noqa: F401
         from . import schedcutoff  # noqa: F401
         from . import tags  # noqa: F401
         from . import tracespan  # noqa: F401

@@ -1,0 +1,136 @@
+"""Revoke-check rule.
+
+``revokecheck``: ULFM's hang-prevention contract (ft/lifeboat) only
+holds if every retry/progress loop that keeps consuming a communicator
+re-checks revocation between attempts. A ``while True:`` retry loop
+that catches a failure and ``continue``s without consulting the epoch
+fence spins forever against a poisoned communicator — exactly the
+dead-peer hang the revocation machinery exists to break (the tuned
+dispatch loop calls ``lifeboat.check(comm)`` at the top of every
+iteration for this reason). The rule flags comm-consuming retry loops
+under ``coll/`` and ``pml/`` that show no epoch/revocation evidence in
+the loop body.
+
+Loop shape that is flagged: a ``while`` whose body both consumes the
+comm surface (a collective, tagged p2p, or ``progress`` call) and
+contains a ``continue`` (the retry signature — a straight-line
+bounded loop cannot spin on a revoked comm).
+
+Evidence that satisfies the rule, anywhere in the loop body: a call
+named ``check``/``revoked``/``_check_alive``/``_fence_check``, or any
+identifier mentioning ``revok`` or ``epoch``.
+
+Suppression: ``# commlint: allow(revokecheck)`` on or above the loop
+(or the consuming call), for loops whose termination is otherwise
+bounded (drain loops over local state, wall-clock-bounded backoff
+loops).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import (
+    COLL_BASE_OPS, COMMLINT, LintRule, P2P_TAGGED, call_name,
+    scope_walk,
+)
+
+#: Call names that consume the comm surface inside a retry loop.
+_CONSUMING = frozenset(COLL_BASE_OPS | P2P_TAGGED | {"progress"})
+
+#: Call names that count as revocation-fence evidence.
+_EVIDENCE_CALLS = frozenset({
+    "check", "revoked", "_check_alive", "_fence_check",
+})
+
+#: Identifier substrings that count as evidence (``lifeboat.revoked``,
+#: ``comm._revoked``, ``epoch_tag``, ``RevokedError`` handlers...).
+_EVIDENCE_WORDS = ("revok", "epoch")
+
+
+def _loop_walk(loop: ast.While) -> Iterable[ast.AST]:
+    """The loop subtree, excluding nested function bodies and nested
+    while-loops (inner loops are flagged on their own)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(loop))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda, ast.While)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _idents(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+    elif isinstance(node, ast.ExceptHandler) and node.type is not None:
+        for sub in ast.walk(node.type):
+            yield from _idents(sub)
+
+
+def _has_evidence(loop: ast.While) -> bool:
+    for node in _loop_walk(loop):
+        if isinstance(node, ast.Call) \
+                and call_name(node) in _EVIDENCE_CALLS:
+            return True
+        for ident in _idents(node):
+            low = ident.lower()
+            if any(w in low for w in _EVIDENCE_WORDS):
+                return True
+    return False
+
+
+def _consuming_calls(loop: ast.While) -> list[ast.Call]:
+    return [
+        n for n in _loop_walk(loop)
+        if isinstance(n, ast.Call) and call_name(n) in _CONSUMING
+    ]
+
+
+def _is_retry_loop(loop: ast.While) -> bool:
+    return any(
+        isinstance(n, ast.Continue) for n in _loop_walk(loop)
+    )
+
+
+@COMMLINT.register
+class RevokeCheckRule(LintRule):
+    NAME = "revokecheck"
+    PRIORITY = 42
+    DESCRIPTION = ("comm-consuming retry loops under coll//pml/ must "
+                   "re-check revocation between attempts")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        rel = ctx.relpath.replace("\\", "/")
+        if "coll/" not in rel and "pml/" not in rel:
+            return
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, ast.While):
+                continue
+            if not _is_retry_loop(loop):
+                continue
+            consuming = _consuming_calls(loop)
+            if not consuming:
+                continue
+            if _has_evidence(loop):
+                continue
+            if ctx.suppressed(loop.lineno, self.NAME):
+                continue
+            call = consuming[0]
+            if ctx.suppressed(call.lineno, self.NAME):
+                continue
+            yield self.finding(
+                ctx, loop,
+                f"retry loop consumes the comm surface "
+                f"({call_name(call)}) with no epoch/revocation check "
+                "between attempts — a revoked communicator spins here "
+                "forever instead of raising RevokedError; call "
+                "lifeboat.check(comm) per iteration (or annotate "
+                "commlint: allow(revokecheck))",
+            )

@@ -204,7 +204,9 @@ class ShmSlice(FabricSlice):
         # this thread parks on.
         spin_end = now + 0.0002
         probes = 0
-        while True:
+        # deadline-bounded with its own peer-liveness probe: cannot
+        # spin forever on a revoked comm
+        while True:  # commlint: allow(revokecheck)
             if fp_live:
                 hit = self.router.fp_pop(src_proc, fptag)
                 if hit is not None:

@@ -730,6 +730,26 @@ def allreduce_by_decision(x: jax.Array, axis_name: str, op,
     return fn(x, axis_name, op)
 
 
+def _probe_steps(comm, opname: str, algo: str) -> None:
+    """Walk the chosen program's step count and probe faultline at
+    each one (only ever called with a plan armed). sched_* algorithms
+    report their real IR round count; the closed-form tiers use the
+    ring-equivalent 2*(n-1) so ``after_step=`` has a stable meaning
+    everywhere."""
+    from ..ft import inject
+
+    nsteps = 2 * (comm.size - 1)
+    try:
+        from . import sched as _sched
+
+        if algo in _sched.ALGOS:
+            nsteps = _sched.build_schedule(algo, comm.size).rounds()
+    except Exception:  # commlint: allow(broadexcept)
+        pass  # a schedule build error is the dispatch path's to raise
+    for step in range(1, nsteps + 1):
+        inject.coll_step(comm, opname, step)
+
+
 @COLL.register
 class TunedColl(XlaColl):
     """Decision layer over the full algorithm space. Inherits the
@@ -891,22 +911,31 @@ class TunedColl(XlaColl):
         x = _leaf_check(comm, x)
         if comm.size == 1:
             return x
-        from ..ft import inject
+        from ..core.errors import RevokedError
+        from ..ft import inject, lifeboat
         from ..health import ledger as health, sentinel
         from . import breaker
 
         scope = str(comm.cid)
         deny: tuple = ()
         while True:
+            # Epoch/revocation fence at the top of the retry loop: a
+            # comm revoked mid-degradation (a peer died while we were
+            # falling tiers) must surface RevokedError, never keep
+            # consuming tiers on a poisoned communicator.
+            lifeboat.check(comm)
             algo, plan = self._allreduce_choice(comm, x, op, deny)
 
             def _run(algo=algo, plan=plan):
                 # kernel_fault runs inside the bounded closure so an
                 # injected wedge@coll stall is cancellable: the
                 # sentinel abandons the wedged worker and the dispatch
-                # falls to the next tier mid-flight.
+                # falls to the next tier mid-flight. The per-step
+                # probes give rank_kill@coll:after_step=k its
+                # mid-collective firing point.
                 if inject.armed():
                     inject.kernel_fault("allreduce", algo)
+                    _probe_steps(comm, "allreduce", algo)
                 return plan(x)
 
             try:
@@ -914,6 +943,8 @@ class TunedColl(XlaColl):
                     _run, what=f"allreduce[{algo}]")
             except ArgumentError:
                 raise  # caller error, not a tier fault
+            except RevokedError:
+                raise  # recovery-surface error, not a tier fault
             except Exception as exc:  # commlint: allow(broadexcept)
                 # Tier fault (kernel compile/launch failure, injected
                 # FaultInjected, sentinel StallError on a wedged tier,
@@ -930,6 +961,17 @@ class TunedColl(XlaColl):
                 # controllers a rank-local stall leaves ranks on
                 # divergent tiers with an extra in-flight device
                 # collective (hazard documented in DESIGN.md §17).
+                #
+                # On a revoked comm the fault is not a tier problem —
+                # the peer is dead (sentinel StallError, injected
+                # FaultInjected): convert to RevokedError so every
+                # survivor exits the collective the same way instead
+                # of burning tiers against a poisoned communicator.
+                if lifeboat.revoked(comm):
+                    raise RevokedError(
+                        f"{comm.name} revoked during allreduce[{algo}]"
+                        f" ({type(exc).__name__}: {exc})"
+                    ) from exc
                 if not breaker.enabled() \
                         or breaker.next_tier(algo) is None:
                     raise

@@ -33,7 +33,8 @@ import numpy as np
 
 from .core import config
 from .core.attributes import HasAttributes
-from .core.errors import ArgumentError, CommError, HasErrhandler, RankError
+from .core.errors import (ArgumentError, CommError, HasErrhandler,
+                          RankError, RevokedError)
 from .core.info import Info
 from .core.logging import get_logger
 from .group import Group
@@ -73,6 +74,12 @@ class Communicator(HasAttributes, HasErrhandler):
         self.info = info or Info()
         self.parent_cid = parent_cid
         self._freed = False
+        # ULFM state (ft/lifeboat): the epoch is stamped into the wire
+        # tag namespace (trace/span derives ids from (cid, epoch)) and
+        # bumped by recover(); _revoked is the in-band poison flag —
+        # one attribute read on every dispatch, nothing on the wire.
+        self.epoch = 0
+        self._revoked = False
         self._world_procs = world_procs
         self.procs = [world_procs[r] for r in group.world_ranks]
         self.devices = [p.device for p in self.procs]
@@ -170,6 +177,11 @@ class Communicator(HasAttributes, HasErrhandler):
     def _check_alive(self) -> None:
         if self._freed:
             raise CommError(f"{self.name} has been freed")
+        if self._revoked:
+            raise RevokedError(
+                f"{self.name} (cid={self.cid} epoch={self.epoch}) has "
+                f"been revoked; run ft.lifeboat.recover"
+            )
 
     # -- collectives (dispatch through the per-comm vtable) ---------------
 

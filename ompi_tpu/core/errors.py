@@ -40,6 +40,14 @@ class CommError(OmpiTpuError):
     errclass = "ERR_COMM"
 
 
+class RevokedError(CommError):
+    """The communicator was revoked (ULFM MPIX_ERR_REVOKED): a peer
+    died and a survivor poisoned the comm so no operation can hang on
+    the dead rank. Recover with ``ft.lifeboat.recover``."""
+
+    errclass = "ERR_REVOKED"
+
+
 class GroupError(OmpiTpuError):
     errclass = "ERR_GROUP"
 

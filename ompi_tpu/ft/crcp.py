@@ -38,6 +38,7 @@ class Bookmark:
     unexpected: int = 0  # sends no recv has matched yet
     posted: int = 0  # recvs no send has matched yet
     drained_waits: int = 0
+    cancelled: int = 0  # stragglers cancel-and-marked on timeout
     details: list = field(default_factory=list)
 
     @property
@@ -75,6 +76,46 @@ def inspect(comm) -> Bookmark:
     return _inspect(comm)
 
 
+def _base_pml(comm):
+    base = comm.pml
+    while hasattr(base, "host"):
+        base = base.host
+    return base
+
+
+def cancel_stragglers(comm) -> int:
+    """Cancel-and-mark every in-flight p2p operation on ``comm``: the
+    unmatched sends are dropped from the matching lists and the posted
+    receives are cancelled (their waiters observe CANCELLED, never a
+    hang). Run by the quiesce timeout path — and usable directly by
+    recover() — so a follow-up bookmark starts clean instead of
+    inheriting half-drained state. Returns the straggler count."""
+    base = _base_pml(comm)
+    if not hasattr(base, "_state"):
+        return 0
+    mu = getattr(base, "_mu", None)
+    cancelled = 0
+    if mu is not None:
+        mu.acquire()
+    try:
+        s = base._state(comm)
+        for r in list(s.posted):
+            if hasattr(r, "cancel"):
+                r.cancel()
+                cancelled += 1
+        # cancelled recvs self-purge from the posted list on the next
+        # match pass; clear eagerly so the very next inspect is quiet
+        s.posted.clear()
+        cancelled += len(s.unexpected)
+        s.unexpected.clear()
+    finally:
+        if mu is not None:
+            mu.release()
+    if cancelled:
+        SPC.record("ft_quiesce_cancelled", cancelled)
+    return cancelled
+
+
 def quiesce(comm, timeout: float = 5.0,
             require_empty: bool = True) -> Bookmark:
     """Progress until the communicator's p2p channels are quiet.
@@ -101,10 +142,18 @@ def quiesce(comm, timeout: float = 5.0,
         if bo.expired:
             SPC.record("ft_quiesce_timeout")
             if require_empty:
-                raise QuiesceTimeout(
+                # Cancel-and-mark the stragglers before raising: a
+                # QuiesceTimeout must not leave half-drained matching
+                # state behind — a follow-up recover() starts from a
+                # clean bookmark instead of inheriting it.
+                bm.cancelled = cancel_stragglers(comm)
+                exc = QuiesceTimeout(
                     f"{comm.name}: traffic still in flight after "
-                    f"{timeout}s: {bm.details[:8]}"
+                    f"{timeout}s ({bm.cancelled} cancelled): "
+                    f"{bm.details[:8]}"
                 )
+                exc.bookmark = bm  # recover() reads the counts
+                raise exc
             return bm
         progress_mod.progress()
         waits += 1

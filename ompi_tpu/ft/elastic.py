@@ -199,13 +199,20 @@ def shrink(comm, *, dead: Optional[set] = None) -> Any:
     ]
     if not survivors:
         raise CommError(f"{comm.name}: no surviving ranks")
-    if len(survivors) == comm.size:
+    if len(survivors) == comm.size \
+            and not getattr(comm, "_revoked", False):
         return comm.dup()
-    from .. import api
+    # ULFM: shrink stays valid on a REVOKED communicator (it is the
+    # recovery escape hatch), and revocation fans out to every comm
+    # containing the dead rank — WORLD included — so the survivor comm
+    # is constructed directly rather than through world.create()'s
+    # liveness fence.
+    from ..communicator import Communicator
 
-    world = api.world()
-    new = world.create(Group(survivors))
-    new.set_name(f"{comm.name}.shrunk")
+    new = Communicator(
+        Group(survivors), comm._world_procs,
+        name=f"{comm.name}.shrunk", parent_cid=comm.cid,
+    )
     SPC.record("ft_shrinks")
     logger.info(
         "shrink %s: %d -> %d ranks (failed: %s)",
@@ -216,16 +223,16 @@ def shrink(comm, *, dead: Optional[set] = None) -> Any:
 
 def agree(comm, flags) -> bool:
     """MPIX_Comm_agree's role: logical AND over the SURVIVING ranks'
-    flags (failed ranks cannot veto)."""
-    dead = failed_ranks()
-    vals = [
-        bool(flags[r])
-        for r, wr in enumerate(comm.group.world_ranks)
-        if wr not in dead
-    ]
-    if not vals:
-        raise CommError(f"{comm.name}: no survivors to agree")
-    return all(vals)
+    flags (failed ranks cannot veto). Delegates to lifeboat's
+    two-phase, failure-masking agreement (tree vote + confirm,
+    re-rooted around the known-dead set) — this bool wrapper is the
+    back-compat surface; new code should call ``lifeboat.agree``
+    directly for the int flags."""
+    from . import lifeboat
+
+    return bool(lifeboat.agree(
+        comm, [1 if bool(f) else 0 for f in flags]
+    ))
 
 
 def respawn(comm, manager, *, like: Any = None) -> tuple[Any, Any, dict]:

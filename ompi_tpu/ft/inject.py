@@ -23,6 +23,8 @@ A plan is ``;``-separated specs, each ``action@layer[:key=val,...]``::
     disconnect@coll:op=allreduce,algo=quant_ring,count=1
     rank_kill@coll:op=allreduce,after=2
     rank_kill@coll:op=allreduce,after=1,exit=17
+    rank_kill@coll:op=allreduce,after_step=2,peer=3
+    rank_kill@modex:op=get,peer=1
     drop@modex:key=dcn/3,count=1,prob=0.5
     wedge@coll:op=allreduce,algo=native,count=1
     wedge@btl_dcn:op=send,ms=500,count=1
@@ -46,7 +48,11 @@ pml/btl, get/put at modex, the collective name at coll), ``peer``
 rank for ``rank_kill``), ``tag=N`` or ``tag=LO-HI`` (inclusive range),
 ``count`` (fire
 at most N times, default 1; ``count=inf`` = every match), ``after``
-(alias ``skip``: let the first N matching occurrences pass), ``prob``
+(alias ``skip``: let the first N matching occurrences pass),
+``after_step`` (coll only: fire once the chosen schedule reaches IR
+step N — tuned probes ``coll_step`` per step of the dispatched
+program, so ``rank_kill@coll:after_step=k`` kills a rank
+mid-collective at step granularity), ``prob``
 (fire with this probability, drawn from the plan's seeded RNG),
 ``ms`` (delay milliseconds), ``link`` (DCN link index), ``algo``
 (collective algorithm tier), ``key`` (modex key substring), ``exit``
@@ -104,7 +110,7 @@ _VALID = {
     "btl_dcn": {"drop", "delay", "duplicate", "corrupt", "disconnect",
                 "wedge"},
     "pml": {"drop", "delay", "duplicate", "corrupt", "wedge"},
-    "modex": {"drop", "delay", "wedge"},
+    "modex": {"drop", "delay", "wedge", "rank_kill"},
     "coll": {"delay", "disconnect", "rank_kill", "wedge"},
 }
 
@@ -143,6 +149,7 @@ class FaultSpec:
     tag_hi: Optional[int] = None
     count: float = 1          # max firings (inf = unlimited)
     skip: int = 0             # matching occurrences to let pass first
+    after_step: Optional[int] = None  # coll schedule step to fire at
     prob: Optional[float] = None
     ms: float = 0.0           # delay milliseconds
     link: int = 0             # DCN link index for disconnect
@@ -166,18 +173,27 @@ class FaultSpec:
                 f"fault; {self.layer} supports "
                 f"{sorted(_VALID[self.layer])}"
             )
+        if self.after_step is not None and self.layer != "coll":
+            raise PlanError(
+                f"after_step only scopes coll-layer specs "
+                f"(got {self.action}@{self.layer})"
+            )
 
     def scope_matches(self, layer: str, op: Optional[str],
                       peer: Optional[int], tag: Optional[int],
-                      algo: Optional[str], key: Optional[str]) -> bool:
+                      algo: Optional[str], key: Optional[str],
+                      step: Optional[int] = None) -> bool:
         if layer != self.layer:
             return False
         if self.op is not None and op != self.op:
             return False
-        # At the coll layer `peer=` is not a scope filter: collective
-        # probes carry no peer; the key instead names the victim world
-        # rank for rank_kill (driver mode hosts every rank in-process).
+        # For rank_kill (and all coll-layer specs) `peer=` is not a
+        # scope filter: those probes carry no peer; the key instead
+        # names the victim world rank (driver mode hosts every rank
+        # in-process, so rank_kill@modex:peer=N kills rank N when the
+        # modex op fires, it does not filter on a wire peer).
         if self.peer is not None and self.layer != "coll" \
+                and self.action != "rank_kill" \
                 and peer != self.peer:
             return False
         if self.tag_lo is not None:
@@ -192,6 +208,14 @@ class FaultSpec:
             return False
         if self.key is not None and (key is None or self.key not in key):
             return False
+        # step scoping is strict both ways like algo: the per-step
+        # probe (coll_step) only advances after_step specs and the
+        # dispatch probe (on_coll) never does — occurrence counts
+        # would otherwise step once per IR step, not per collective.
+        if (self.after_step is None) != (step is None):
+            return False
+        if self.after_step is not None and step != self.after_step:
+            return False
         return True
 
     def describe(self) -> str:
@@ -203,6 +227,8 @@ class FaultSpec:
                 kv.append(f"{name}={val}")
         if self.tag_lo is not None:
             kv.append(f"tag={self.tag_lo}-{self.tag_hi}")
+        if self.after_step is not None:
+            kv.append(f"after_step={self.after_step}")
         if kv:
             parts.append(":" + ",".join(kv))
         return "".join(parts)
@@ -235,6 +261,8 @@ def _parse_spec(text: str) -> FaultSpec:
             spec.count = math.inf if v == "inf" else int(v)
         elif k in ("after", "skip"):
             spec.skip = int(v)
+        elif k == "after_step":
+            spec.after_step = int(v)
         elif k == "prob":
             spec.prob = float(v)
             if not 0.0 <= spec.prob <= 1.0:
@@ -251,6 +279,10 @@ def _parse_spec(text: str) -> FaultSpec:
             spec.exit_code = int(v)
         else:
             raise PlanError(f"spec {text!r}: unknown key {k!r}")
+    if spec.after_step is not None and spec.layer != "coll":
+        raise PlanError(
+            f"spec {text!r}: after_step only scopes coll-layer specs"
+        )
     return spec
 
 
@@ -273,8 +305,8 @@ class FaultPlan:
 
     def decide(self, layer: str, op: Optional[str] = None, *,
                peer: Optional[int] = None, tag: Optional[int] = None,
-               algo: Optional[str] = None, key: Optional[str] = None
-               ) -> list[FaultSpec]:
+               algo: Optional[str] = None, key: Optional[str] = None,
+               step: Optional[int] = None) -> list[FaultSpec]:
         """All specs firing for this occurrence, in plan order. Each
         scope match advances the spec's occurrence counter (and the
         seeded RNG when ``prob`` is set) whether or not it fires, so
@@ -283,7 +315,7 @@ class FaultPlan:
         with self._mu:
             for spec in self.specs:
                 if not spec.scope_matches(layer, op, peer, tag, algo,
-                                          key):
+                                          key, step):
                     continue
                 spec.seen += 1
                 if spec.seen <= spec.skip or spec.fired >= spec.count:
@@ -306,7 +338,7 @@ class FaultPlan:
                 tspan.instant(f"fault.{spec.action}", cat="fault",
                               injected=True, layer=layer, op=op,
                               peer=peer, tag=tag, algo=algo, key=key,
-                              occ=spec.seen)
+                              step=step, occ=spec.seen)
                 logger.warning("faultline: %s fired (op=%s peer=%s "
                                "tag=%s occ=%d)", spec.describe(), op,
                                peer, tag, spec.seen)
@@ -658,6 +690,10 @@ def on_modex(op: str, key: str) -> None:
             _apply_delay(spec)
         elif spec.action == "wedge":
             _apply_wedge(spec)
+        elif spec.action == "rank_kill":
+            # a controller dying inside the business-card exchange —
+            # the worst-moment variant drills arm for recover()
+            _rank_kill(spec, f"modex {op} {key}")
         elif spec.action == "drop":
             from ..runtime.modex import ModexError
 
@@ -701,6 +737,28 @@ def on_coll(comm, opname: str) -> None:
             _apply_wedge(spec)
         elif spec.action == "rank_kill":
             _rank_kill(spec, f"{opname} on {comm.name}")
+
+
+def coll_step(comm, opname: str, step: int) -> None:
+    """Per-IR-step probe: tuned walks the chosen schedule's steps
+    (when a plan is armed — zero cost otherwise) and probes each, so
+    ``rank_kill@coll:after_step=k`` fires mid-collective at step
+    granularity. Driver-model honesty: the fused XLA program cannot be
+    interrupted between device steps, so the kill lands between the
+    dispatch-time step probes — the program for the remaining steps is
+    never launched, which is exactly what a controller death after
+    step k means for every rank it hosts."""
+    p = _PLAN
+    if p is None:
+        return
+    for spec in p.decide("coll", opname, step=step):
+        if spec.action == "rank_kill":
+            _rank_kill(spec,
+                       f"{opname} step {step} on {comm.name}")
+        elif spec.action == "delay":
+            _apply_delay(spec)
+        elif spec.action == "wedge":
+            _apply_wedge(spec)
 
 
 def kernel_fault(opname: str, algo: str) -> None:

@@ -1,0 +1,551 @@
+"""lifeboat — ULFM-grade elastic recovery: epochs, revoke, agree,
+and the deterministic shrink→respawn pipeline.
+
+The reference Open MPI ships ULFM (ompi/mpiext/ftmpi:
+MPI_Comm_revoke / MPIX_Comm_agree / MPI_Comm_shrink) as a first-class
+capability; this module is its driver-model port, built over the
+``ft/elastic`` skeleton and wired into everything PRs 8-11 added
+(health ledger scopes, sched winner cache, telemetry fleet merge,
+watchtower baselines):
+
+**Epoch fence.** Every communicator carries ``epoch`` (bumped by
+``recover``) and ``_revoked``. The stamp rides the wire tag namespace
+exactly like commtrace span ids — ``epoch_tag`` packs (cid, epoch)
+into the same ``(cid+1) << 20`` id space ``trace/span.py`` uses — so
+fencing costs zero extra wire traffic. The in-band check is ONE
+attribute read (``Communicator._check_alive``), which is what keeps
+the fp 64 B RTT ratchet under 1%: every dispatch raises
+``RevokedError`` instead of hanging on a dead peer.
+
+**Revoke.** ``revoke(comm)`` poisons the comm locally (the in-band
+flag every dispatch piggybacks on) AND publishes a modex marker
+(``revoke/<cid>``), the out-of-band path other controllers' rate-
+limited ``check`` probes observe within a bounded window. Where
+sentinel's ``run_bounded`` used to convert a dead-peer stall into a
+tier fault, the tuned dispatch now converts it into a revocation when
+the comm is poisoned — all survivors exit the collective the same way.
+
+**Agree.** ``agree(comm, flags)`` is the two-phase, failure-masking
+agreement (MPIX_Comm_agree semantics: bitwise AND over survivor
+flags). Phase one combines votes up a binomial tree re-rooted around
+the known-dead set; phase two confirms the dead set did not move while
+voting — if it did, the round re-roots and retries. Every survivor
+gets the same flags or every survivor gets the raise; never
+split-brain.
+
+**Recover.** ``recover(comm)`` runs the deterministic pipeline:
+quiesce (crcp bookmark; a timeout cancel-and-marks stragglers) →
+agree → shrink → epoch bump → state re-admission — sched cache keys
+migrate to the new ``r<nranks>``/topology fingerprint through the
+existing retune sweep (warm, not cold-start), the health ledger's
+comm-scoped entries are GC'd and the new scope re-seeded from the
+global scope, telemetry/fleet drops the dead ranks permanently, and
+watchtower baselines reset so post-shrink p50s aren't judged against
+pre-shrink predictions. ``respawn`` re-admits a rank through
+PROBATION with a canary probe before it carries real traffic.
+
+Determinism: the recovery decision log is timestamp-free (numbered
+lines, ledger idiom) and ``digest()`` hashes it — byte-identical
+across same-seed controllers. Wall-clock phase timings live in
+``last_report()``, outside the log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.errors import CommError, RevokedError
+from ..core.logging import get_logger
+from . import elastic, events
+
+logger = get_logger("ft.lifeboat")
+
+__all__ = [
+    "AgreeError", "RevokedError", "agree", "check", "digest",
+    "disable", "enable", "epoch_tag", "last_report", "log",
+    "maybe_wrap_pml", "readmit", "recover", "reset", "respawn",
+    "revoke", "revoked",
+]
+
+_probe_every = config.register(
+    "ft", "lifeboat", "probe_every", type=int, default=64,
+    description="Out-of-band revocation probe rate: every Nth "
+    "lifeboat.check consults the modex poison marker (0 disables the "
+    "probe; the in-band epoch fence always runs)",
+)
+_agree_rounds = config.register(
+    "ft", "lifeboat", "agree_rounds", type=int, default=3,
+    description="Re-root retries the two-phase agreement masks "
+    "mid-vote failures with before raising on every survivor",
+)
+
+
+class AgreeError(CommError):
+    """The fault-tolerant agreement could not conclude (no survivors,
+    or the failure set kept moving for ``agree_rounds`` rounds). Raised
+    identically on every survivor — never split-brain."""
+
+    errclass = "ERR_COMM"
+
+
+# -- module state --------------------------------------------------------
+
+_mu = threading.RLock()
+#: cid -> minimum live epoch: operations on that cid below the fence
+#: raise RevokedError (the structural half — a shrunk comm has a new
+#: cid, so old-epoch traffic can never match the new comm's tags).
+_fence: dict[int, int] = {}
+#: timestamp-free decision log (ledger idiom: numbered lines).
+_log: list[str] = []
+_handler_id: Optional[int] = None
+_probe_tick = 0
+_last_report: dict = {}
+
+
+def _note(line: str) -> None:
+    with _mu:
+        _log.append(f"{len(_log)} {line}")
+
+
+def log() -> list[str]:
+    with _mu:
+        return list(_log)
+
+
+def digest() -> str:
+    """sha256 of the recovery decision log — byte-identical across
+    same-seed controllers (ledger/watchtower contract)."""
+    with _mu:
+        return hashlib.sha256("\n".join(_log).encode()).hexdigest()
+
+
+def last_report() -> dict:
+    """Wall-clock phase breakdown of the most recent recover() —
+    deliberately OUTSIDE the decision log so timings never perturb the
+    byte-identity contract."""
+    with _mu:
+        return dict(_last_report)
+
+
+def reset() -> None:
+    """Forget fences, the log, and tracking (test teardown)."""
+    global _probe_tick
+    disable()
+    with _mu:
+        _fence.clear()
+        _log.clear()
+        _last_report.clear()
+        _probe_tick = 0
+
+
+# -- epoch fence ---------------------------------------------------------
+
+def epoch_tag(comm) -> int:
+    """The (cid, epoch) stamp in the wire tag namespace — the same
+    ``(cid+1) << 20`` id space commtrace span ids ride, so the fence
+    costs zero extra wire traffic. Epochs occupy the bits below the
+    cid field; tags/sequence numbers stay beneath them."""
+    return ((comm.cid + 1) << 20) | ((comm.epoch & 0xFF) << 12)
+
+
+def revoked(comm) -> bool:
+    """In-band poison state: the comm's own flag, or an epoch below
+    the cid's fence."""
+    return bool(comm._revoked) or \
+        comm.epoch < _fence.get(comm.cid, 0)
+
+
+def check(comm) -> None:
+    """The dispatch fence. The in-band half is one attribute read; the
+    out-of-band half (only while lifeboat is enabled, and only every
+    ``probe_every``-th call) probes the modex poison marker so a
+    revocation published by another controller lands within a bounded
+    window even mid-collective."""
+    global _probe_tick
+    if revoked(comm):
+        raise RevokedError(
+            f"{comm.name} (cid={comm.cid} epoch={comm.epoch}) has "
+            f"been revoked; run ft.lifeboat.recover"
+        )
+    if _handler_id is None:
+        return
+    every = _probe_every.value
+    if every <= 0:
+        return
+    _probe_tick += 1
+    if _probe_tick % every:
+        return
+    from ..runtime import modex
+
+    try:
+        marker = modex.peer_revoke(comm.cid, timeout_s=0)
+    except modex.ModexError:
+        return  # nobody revoked this cid — the healthy common case
+    if int(marker.get("epoch", 0)) > comm.epoch:
+        comm._revoked = True
+        _note(f"absorb cid={comm.cid} epoch={comm.epoch} "
+              f"marker_epoch={marker.get('epoch')}")
+        SPC.record("ft_revokes_absorbed")
+        raise RevokedError(
+            f"{comm.name} (cid={comm.cid}): revocation marker "
+            f"observed via modex"
+        )
+
+
+# -- revoke --------------------------------------------------------------
+
+def revoke(comm, *, cause: str = "user") -> None:
+    """MPI_Comm_revoke: poison the communicator so every pending and
+    future operation on it raises RevokedError instead of hanging on a
+    dead peer. Idempotent. Propagates in-band (the flag every dispatch
+    reads) and out-of-band (a modex marker peers' ``check`` probes)."""
+    with _mu:
+        already = comm._revoked
+        comm._revoked = True
+        _fence[comm.cid] = max(_fence.get(comm.cid, 0),
+                               comm.epoch + 1)
+    if already:
+        return
+    _note(f"revoke cid={comm.cid} epoch={comm.epoch} cause={cause}")
+    SPC.record("ft_revokes")
+    from ..trace import span as tspan
+
+    tspan.instant("ft.revoke", cat="ft", cid=comm.cid,
+                  epoch=comm.epoch, cause=cause)
+    from ..runtime import modex
+
+    try:
+        modex.publish_revoke(comm.cid, {
+            "cid": comm.cid, "epoch": comm.epoch + 1, "cause": cause,
+        })
+    except Exception:  # commlint: allow(broadexcept)
+        # out-of-band propagation is best-effort: the in-band fence
+        # (and the PROC_FAILED event fan-out) still poisons survivors
+        logger.exception("lifeboat: revoke marker publish failed")
+    logger.warning("lifeboat: %s revoked (cause=%s)", comm.name, cause)
+
+
+def _on_failure(ev: events.Event) -> None:
+    """PROC_FAILED fan-out: revoke every live communicator containing
+    the dead world rank (the in-band piggyback — survivors observe the
+    poison at their very next dispatch on any affected comm)."""
+    wr = ev.info.get("world_rank")
+    if wr is None:
+        return
+    from ..communicator import live_comms
+
+    # cid order, not WeakSet order: the decision log must be
+    # byte-identical across same-seed controllers
+    for comm in sorted(live_comms, key=lambda c: c.cid):
+        if not comm._revoked and not comm._freed \
+                and wr in comm.group:
+            revoke(comm, cause=f"proc_failed:{wr}")
+
+
+def enable() -> None:
+    """Arm auto-revocation: PROC_FAILED events (probes, faultline
+    rank_kill, DCN liveness) revoke every comm containing the dead
+    rank. Also enables elastic's failure tracking (the known-dead set
+    agree/recover re-root around). Idempotent."""
+    global _handler_id
+    elastic.enable()
+    with _mu:
+        if _handler_id is None:
+            _handler_id = events.register(
+                events.EventClass.PROC_FAILED, _on_failure
+            )
+
+
+def disable() -> None:
+    global _handler_id
+    with _mu:
+        if _handler_id is not None:
+            events.deregister(_handler_id)
+            _handler_id = None
+
+
+# -- fault-tolerant agreement -------------------------------------------
+
+def _vote_tree(survivors: list[int]) -> list[tuple[int, int]]:
+    """Binomial combine edges (child, parent) over the survivor list,
+    re-rooted at survivors[0]: round k merges position i+2^k into
+    position i. Pure function of the list — the logged tree shape is
+    deterministic."""
+    edges = []
+    n = len(survivors)
+    span = 1
+    while span < n:
+        for i in range(0, n - span, span * 2):
+            edges.append((survivors[i + span], survivors[i]))
+        span *= 2
+    return edges
+
+
+def agree(comm, flags) -> int:
+    """MPIX_Comm_agree: bitwise AND of the surviving ranks' flags,
+    masking failures. Two phases per round: (1) combine votes up a
+    binomial tree re-rooted around the known-dead set; (2) confirm the
+    dead set did not move while voting — a mid-vote death re-roots and
+    retries (``ft_lifeboat_agree_rounds`` rounds). Returns the agreed
+    flags on every survivor, or raises AgreeError on every survivor —
+    never split-brain. ``flags`` is a per-rank sequence (bools coerce
+    to 0/1); dead ranks' entries are ignored."""
+    rounds = max(1, int(_agree_rounds.value))
+    for attempt in range(rounds):
+        dead = elastic.failed_ranks()
+        survivors = [
+            r for r, wr in enumerate(comm.group.world_ranks)
+            if wr not in dead
+        ]
+        if not survivors:
+            _note(f"agree cid={comm.cid} epoch={comm.epoch} "
+                  f"attempt={attempt} result=no-survivors")
+            SPC.record("ft_agree_failures")
+            raise AgreeError(f"{comm.name}: no survivors to agree")
+        # phase 1: tree vote (the controller holds every survivor's
+        # flag; the combine order is the logged binomial tree)
+        votes = {r: int(flags[r]) for r in survivors}
+        result = None
+        for child, parent in _vote_tree(survivors):
+            votes[parent] &= votes[child]
+        result = votes[survivors[0]]
+        # phase 2: confirm — a death during the vote invalidates the
+        # tree (its edges may have combined a dead rank's stale flag)
+        if elastic.failed_ranks() != dead:
+            _note(f"agree cid={comm.cid} epoch={comm.epoch} "
+                  f"attempt={attempt} result=re-root")
+            SPC.record("ft_agree_reroots")
+            continue
+        _note(f"agree cid={comm.cid} epoch={comm.epoch} "
+              f"attempt={attempt} root={survivors[0]} "
+              f"survivors={len(survivors)} flags={result}")
+        SPC.record("ft_agrees")
+        return result
+    _note(f"agree cid={comm.cid} epoch={comm.epoch} "
+          f"result=unstable after {rounds} rounds")
+    SPC.record("ft_agree_failures")
+    raise AgreeError(
+        f"{comm.name}: failure set still moving after {rounds} "
+        f"agreement rounds"
+    )
+
+
+# -- the recovery pipeline ----------------------------------------------
+
+def _migrate_sched_cache(old_n: int, new_n: int,
+                         seed: Optional[int] = None) -> int:
+    """Move the winner cache to the shrunk world: every key tuned for
+    ``r<old_n>`` gets a ``r<new_n>`` counterpart installed through the
+    existing retune sweep (warm re-tune, not cold-start). The old keys
+    stay — a respawn back to old_n re-uses them. Returns the number of
+    keys migrated."""
+    from ..coll.sched import autotune, cache as scache, retune
+
+    fp = autotune.fingerprint()
+    entries = scache.CACHE.entries()
+    migrated = 0
+    for key in sorted(entries):
+        parsed = retune.parse_key(key)
+        if parsed is None or parsed["nranks"] != old_n:
+            continue
+        new_key = scache.cache_key(
+            parsed["opname"], scache.bucket_bytes(parsed["bucket"]),
+            new_n,
+            None if parsed["dtype"] == "any" else parsed["dtype"],
+            fp,
+        )
+        if new_key in entries:
+            continue
+        if retune.retune_key(new_key, reason="recover",
+                             seed=seed) is not None:
+            migrated += 1
+    return migrated
+
+
+def recover(comm, *, quiesce_timeout: float = 1.0,
+            seed: Optional[int] = None,
+            migrate_cache: bool = True) -> Any:
+    """The deterministic recovery pipeline: revoke (idempotent) →
+    quiesce → agree → shrink → epoch bump → state re-admission.
+    Returns the shrunk communicator, whose collectives are
+    bit-identical to a survivor-only reference. Phase timings land in
+    ``last_report()``; the decision log stays timestamp-free."""
+    from ..coll.sched import cache as scache
+    from ..health import ledger as health
+    from ..telemetry import fleet, watchtower
+    from . import crcp
+
+    phases: dict[str, float] = {}
+    t0 = time.perf_counter()
+
+    def _mark(phase: str) -> None:
+        nonlocal t0
+        now = time.perf_counter()
+        phases[f"{phase}_ms"] = round((now - t0) * 1e3, 3)
+        t0 = now
+
+    revoke(comm, cause="recover")
+    _mark("revoke")
+    # quiesce: drain what can drain; a timeout cancel-and-marks the
+    # stragglers (crcp's bkmrk fix), so either way the bookmark is
+    # clean when shrink runs.
+    cancelled = drained = 0
+    try:
+        bm = crcp.quiesce(comm, timeout=quiesce_timeout)
+        drained = bm.drained_waits
+    except crcp.QuiesceTimeout as exc:
+        bm = getattr(exc, "bookmark", None)
+        cancelled = bm.cancelled if bm is not None else 0
+    _mark("quiesce")
+    dead = elastic.failed_ranks()
+    # agree on the shrink: every survivor votes 1 — the agreement's
+    # job here is masking mid-pipeline failures (a second death during
+    # recovery re-roots instead of splitting the survivor set).
+    agree(comm, [1] * comm.size)
+    _mark("agree")
+    new = elastic.shrink(comm, dead=dead)
+    new.epoch = comm.epoch + 1
+    _mark("shrink")
+    migrated = _migrate_sched_cache(comm.size, new.size,
+                                    seed=seed) if migrate_cache else 0
+    gcd = health.LEDGER.gc_scope(str(comm.cid))
+    seeded = health.LEDGER.seed_scope(str(new.cid))
+    dead_sorted = sorted(dead)
+    fleet.mark_dead(dead_sorted)
+    baselines = watchtower.reset_baselines(reason="recover")
+    _mark("readmit")
+    _note(
+        f"recover cid={comm.cid}->{new.cid} "
+        f"epoch={comm.epoch}->{new.epoch} dead={dead_sorted} "
+        f"survivors={new.size} cache_migrated={migrated} "
+        f"ledger_gc={gcd} ledger_seeded={seeded} "
+        f"baselines_reset={baselines}"
+    )
+    SPC.record("ft_recovers")
+    from ..trace import span as tspan
+
+    tspan.instant("ft.recover", cat="ft", cid=comm.cid,
+                  new_cid=new.cid, epoch=new.epoch,
+                  dead=dead_sorted, survivors=new.size)
+    with _mu:
+        _last_report.clear()
+        _last_report.update({
+            "phases": phases, "dead": dead_sorted,
+            "survivors": new.size, "cache_migrated": migrated,
+            "ledger_gc": gcd, "quiesce_cancelled": cancelled,
+            "quiesce_drained": drained,
+        })
+    logger.info("lifeboat: recovered %s -> %s (%d survivors, dead=%s)",
+                comm.name, new.name, new.size, dead_sorted)
+    return new
+
+
+# -- respawn / re-admission ---------------------------------------------
+
+def readmit(comm, *, canary: Optional[Callable[[], bool]] = None
+            ) -> bool:
+    """Admit a (re)spawned rank's communicator through PROBATION: the
+    comm-scope device tier starts QUARANTINED, the canary probe (a
+    device liveness sweep by default) must pass, and its successes
+    walk the ledger QUARANTINED → PROBATION → HEALTHY before the comm
+    carries real traffic. Returns True when the tier reached HEALTHY;
+    a failed canary leaves it QUARANTINED (and returns False)."""
+    from ..health import ledger as health
+
+    scope = str(comm.cid)
+    health.LEDGER.quarantine("device", scope=scope, cause="readmit")
+
+    def _default_canary() -> bool:
+        return not events.check_devices(comm)
+
+    probe = canary or _default_canary
+    # the +1 covers the QUARANTINED->PROBATION probe itself
+    needed = int(config.get("health_ledger_probation_successes", 2)) + 1
+    for _ in range(needed):
+        try:
+            ok = bool(probe())
+        except Exception:  # commlint: allow(broadexcept)
+            ok = False
+        if not ok:
+            health.LEDGER.report_failure("device", scope=scope,
+                                         cause="canary")
+            _note(f"readmit cid={comm.cid} result=canary-failed")
+            SPC.record("ft_readmit_failures")
+            return False
+        health.LEDGER.report_success("device", scope=scope)
+    healthy = health.LEDGER.state("device", scope) == health.HEALTHY
+    _note(f"readmit cid={comm.cid} "
+          f"result={'healthy' if healthy else 'probation'}")
+    SPC.record("ft_readmits")
+    return healthy
+
+
+def respawn(comm, manager, *, like: Any = None,
+            canary: Optional[Callable[[], bool]] = None
+            ) -> tuple[Any, Any, dict]:
+    """elastic.respawn + lifeboat hardening: the restored comm gets
+    the bumped epoch and is re-admitted through PROBATION with a
+    canary probe before it carries real traffic."""
+    new_comm, state, meta = elastic.respawn(comm, manager, like=like)
+    new_comm.epoch = comm.epoch + 1
+    readmit(new_comm, canary=canary)
+    return new_comm, state, meta
+
+
+# -- pml guard (pml/framework.select_for_comm interposition) ------------
+
+class LifeboatPml:
+    """Always-on pass-through PML raising RevokedError on any p2p
+    against a revoked comm — the pml/ half of the dispatch fence (the
+    coll/ half lives in tuned's retry loop). One attribute read per
+    call; unknown attributes — including NAME — delegate (sanitizer
+    wrapper idiom), so `comm.pml.NAME` still reports the selection."""
+
+    def __init__(self, host) -> None:
+        self.host = host
+
+    def __getattr__(self, name):
+        return getattr(self.host, name)
+
+    @staticmethod
+    def _fence_check(comm) -> None:
+        if comm._revoked:
+            raise RevokedError(
+                f"{comm.name} (cid={comm.cid}) has been revoked; "
+                f"run ft.lifeboat.recover"
+            )
+
+    def send(self, comm, value, dest, tag, source=None):
+        self._fence_check(comm)
+        return self.host.send(comm, value, dest, tag, source=source)
+
+    def isend(self, comm, value, dest, tag, source=None):
+        self._fence_check(comm)
+        return self.host.isend(comm, value, dest, tag, source=source)
+
+    def recv(self, comm, source, tag, *, dest):
+        self._fence_check(comm)
+        return self.host.recv(comm, source, tag, dest=dest)
+
+    def irecv(self, comm, source, tag, *, dest):
+        self._fence_check(comm)
+        return self.host.irecv(comm, source, tag, dest=dest)
+
+    def probe(self, comm, source, tag, *, dest, blocking=False):
+        self._fence_check(comm)
+        return self.host.probe(comm, source, tag, dest=dest,
+                               blocking=blocking)
+
+
+def maybe_wrap_pml(selected):
+    """pml/framework hook: the revocation fence wraps outermost so a
+    poisoned comm raises before the sanitizer accounts (or faultline
+    perturbs) an operation that will never run."""
+    if selected is None:
+        return selected
+    return LifeboatPml(selected)

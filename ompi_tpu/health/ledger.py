@@ -403,6 +403,52 @@ class Ledger:
             return [k for k, e in self._entries.items()
                     if e.state == SUSPECT]
 
+    # -- recovery (ft/lifeboat) ------------------------------------------
+
+    def gc_scope(self, scope: str, *, cause: str = "recover") -> int:
+        """Drop every entry in ``scope`` (a revoked communicator's
+        cid): the comm is gone, so its quarantines must not leak into
+        the process forever. Each collection is a timestamp-free log
+        line (``<state>->gc``) so same-seed recoveries keep the digest
+        byte-identical. Returns the number of entries collected."""
+        if scope == GLOBAL_SCOPE:
+            return 0  # the global scope outlives every comm
+        with self._mu:
+            keys = sorted(k for k in self._entries if k[0] == scope)
+            for k in keys:
+                e = self._entries.pop(k)
+                self._log.append(
+                    f"{len(self._log)} {k[0]} {k[1]} {e.state}->gc "
+                    f"{cause}"
+                )
+            if keys:
+                self._generation += 1
+                self._any_tracked = bool(self._entries)
+                self._any_unhealthy = any(
+                    x.state != HEALTHY for x in self._entries.values()
+                )
+        return len(keys)
+
+    def seed_scope(self, scope: str, *, cause: str = "recover") -> int:
+        """Seed a fresh comm scope (the shrunk communicator's cid)
+        from the global scope's non-HEALTHY entries, so a process-wide
+        quarantine observed before the shrink keeps denying the new
+        comm without waiting to re-learn it. Returns the number of
+        entries seeded."""
+        seeded = 0
+        with self._mu:
+            for (s, tier) in sorted(self._entries):
+                e = self._entries[(s, tier)]
+                if s != GLOBAL_SCOPE or e.state == HEALTHY:
+                    continue
+                ne = self._entry(scope, tier)
+                ne.failures = e.failures
+                ne.successes = e.successes
+                if ne.state != e.state:
+                    self._transition(scope, tier, ne, e.state, cause)
+                seeded += 1
+        return seeded
+
     # -- introspection ---------------------------------------------------
 
     def snapshot(self) -> dict:
@@ -496,6 +542,14 @@ def snapshot() -> dict:
 
 def digest() -> str:
     return LEDGER.digest()
+
+
+def gc_scope(scope: str, *, cause: str = "recover") -> int:
+    return LEDGER.gc_scope(scope, cause=cause)
+
+
+def seed_scope(scope: str, *, cause: str = "recover") -> int:
+    return LEDGER.seed_scope(scope, cause=cause)
 
 
 def reset() -> None:

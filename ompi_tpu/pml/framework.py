@@ -55,6 +55,12 @@ def select_for_comm(comm) -> PmlComponent:
         from ..trace import span as tspan
 
         _selected = tspan.maybe_wrap_pml(_selected)
+        # The lifeboat revocation fence wraps outermost: a revoked comm
+        # raises RevokedError before the tracer records — or the
+        # sanitizer accounts — an operation that will never run.
+        from ..ft import lifeboat
+
+        _selected = lifeboat.maybe_wrap_pml(_selected)
     return _selected
 
 

@@ -192,6 +192,21 @@ def peer_telemetry(rank: int, timeout_s: float = 0.0) -> dict:
     return get(f"telemetry/{rank}", timeout_s=timeout_s)
 
 
+def publish_revoke(cid: int, marker: dict) -> None:
+    """Publish a communicator revocation poison marker (lifeboat's
+    out-of-band propagation path — the in-band path is the epoch fence
+    every dispatch checks). Versioned key per cid: the ``epoch`` inside
+    the marker orders re-publications."""
+    put(f"revoke/{cid}", marker)
+
+
+def peer_revoke(cid: int, timeout_s: float = 0.0) -> dict:
+    """Probe for a revocation marker on ``cid``. timeout_s=0 probes
+    (raises ModexError when no survivor has revoked — the common,
+    healthy case)."""
+    return get(f"revoke/{cid}", timeout_s=timeout_s)
+
+
 def clear_local() -> None:
     with _lock:
         _local.clear()

@@ -49,6 +49,26 @@ def publish(sample: dict) -> None:
 _LAST_SEEN: dict[int, dict] = {}
 _LAST_SEQ: dict[int, int] = {}
 
+#: Ranks confirmed dead by a failure event (ft/lifeboat's recover
+#: pipeline calls ``mark_dead``). Dead is not stale: a stale rank may
+#: publish again, so it degrades to its last-seen sample; a dead rank
+#: never will, so it leaves the merge permanently and stops inflating
+#: ``telemetry_fleet_stale_ranks``.
+_DEAD: set[int] = set()
+
+
+def mark_dead(ranks) -> None:
+    """Permanently drop ``ranks`` from the fleet view (failure event,
+    not a missed tick). Idempotent."""
+    for r in ranks:
+        _DEAD.add(int(r))
+        _LAST_SEEN.pop(int(r), None)
+        _LAST_SEQ.pop(int(r), None)
+
+
+def dead_ranks() -> set[int]:
+    return set(_DEAD)
+
 
 def gather(nproc: int, timeout_s: float = 0.0) -> dict[int, dict]:
     """Collect every published per-rank sample; ranks that miss this
@@ -60,6 +80,8 @@ def gather(nproc: int, timeout_s: float = 0.0) -> dict[int, dict]:
 
     out: dict[int, dict] = {}
     for r in range(nproc):
+        if r in _DEAD:
+            continue
         try:
             got = modex.peer_telemetry(r, timeout_s=timeout_s)
         except modex.ModexError:
@@ -87,6 +109,7 @@ def gather(nproc: int, timeout_s: float = 0.0) -> dict[int, dict]:
 def reset_for_testing() -> None:
     _LAST_SEEN.clear()
     _LAST_SEQ.clear()
+    _DEAD.clear()
 
 
 def tier_bytes(counters_snap: dict) -> dict[str, float]:

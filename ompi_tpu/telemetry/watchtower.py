@@ -254,6 +254,25 @@ class Watchtower:
                    algo=got["algorithm"], version=got["version"])
         return got
 
+    def reset_baselines(self, *, reason: str = "recover") -> int:
+        """Forget every key's observed baseline (drift/clear streaks
+        included) so post-recovery p50s are not judged against
+        pre-shrink predictions — the next tick re-observes each key
+        fresh. Logged as one deterministic decision entry. Returns the
+        number of keys reset."""
+        with self._mu:
+            n = 0
+            for st in self._keys.values():
+                if st["baseline"] is not None or st["drift"] \
+                        or st["clear"]:
+                    n += 1
+                st["baseline"] = None
+                st["drift"] = 0
+                st["clear"] = 0
+        self._note(tick=self.ticks, action="baseline_reset",
+                   reason=reason, keys=n)
+        return n
+
     # -- straggler findings -> topology penalties ----------------------
 
     def _straggler_sweep(self, retune, entries: dict) -> None:
@@ -345,6 +364,17 @@ def maybe_tick(sample: Optional[dict] = None) -> None:
         SPC.record("telemetry_watchtower_errors")
 
 
+def reset_baselines(*, reason: str = "recover") -> int:
+    """Reset the running watchtower's baselines (lifeboat's recovery
+    hook). A no-op when no watchtower was ever created — recovery must
+    not instantiate a controller just to clear it."""
+    with _mu:
+        wt = _WT
+    if wt is None:
+        return 0
+    return wt.reset_baselines(reason=reason)
+
+
 def reset_for_testing() -> None:
     global _WT
     with _mu:
@@ -352,4 +382,4 @@ def reset_for_testing() -> None:
 
 
 __all__ = ["Watchtower", "enabled", "get", "maybe_tick",
-           "reset_for_testing"]
+           "reset_baselines", "reset_for_testing"]

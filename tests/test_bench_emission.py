@@ -200,6 +200,7 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -265,6 +266,7 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._health_overhead_row = lambda: {"stub": True}
         bench._telemetry_overhead_row = lambda: {"stub": True}
         bench._straggler_detect_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -332,6 +334,7 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._fault_drill_row = lambda: {"stub": True}
         bench._telemetry_overhead_row = lambda: {"stub": True}
         bench._straggler_detect_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -396,6 +399,7 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._health_overhead_row = lambda: {"stub": True}
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -431,3 +435,61 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
     # robust z of a 20 ms delay over a ~us-scale baseline is enormous;
     # anything past the 3.5 cut proves the detector saw the skew
     assert st["straggler_z_min"] >= 3.5
+
+
+def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
+    """ISSUE PR12 satellite 4: the elastic_recovery row runs
+    end-to-end (real 8-rank subprocess drill: rank_kill mid-allreduce
+    -> RevokedError -> revoke/agree/shrink -> first survivor
+    allreduce) inside the probe-failed host-only path and emits
+    schema-complete JSON — p50 ms end-to-end plus the per-phase
+    breakdown, every key *_ms so the benchgate ratchet direction is
+    lower-is-better automatically."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        os.environ["OMPI_TPU_BENCH_ELASTIC_TRIALS"] = "3"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new row
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench._telemetry_overhead_row = lambda: {"stub": True}
+        bench._watchtower_overhead_row = lambda: {"stub": True}
+        bench._straggler_detect_row = lambda: {"stub": True}
+        bench._sched_autotune_row = lambda: {"stub": True}
+        bench._sched_warm_start_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    row = out["detail"]["partial"]["elastic_recovery"]
+    assert "error" not in row, row
+    for key in ("trials", "ranks", "survivors", "recovery_p50_ms",
+                "detect_ms", "revoke_ms", "quiesce_ms", "agree_ms",
+                "shrink_ms", "readmit_ms", "first_allreduce_ms"):
+        assert key in row, key
+    assert row["ranks"] == 8 and row["survivors"] == 7
+    assert row["recovery_p50_ms"] > 0
+    # phases nest inside the total
+    assert row["recovery_p50_ms"] >= row["shrink_ms"]
+    # every ratcheted key auto-maps to lower-is-better in benchgate
+    from ompi_tpu.tools import benchgate
+    for key in ("recovery_p50_ms", "detect_ms", "shrink_ms"):
+        assert benchgate.direction(key) == "lower"

@@ -7,7 +7,7 @@ import ompi_tpu as mt
 from ompi_tpu import ft
 from ompi_tpu.core import config
 from ompi_tpu.core.errors import ERRORS_RETURN, Errhandler
-from ompi_tpu.ft import crcp, crs, events, vprotocol
+from ompi_tpu.ft import crcp, crs, events, lifeboat, vprotocol
 from ompi_tpu.ft.manager import CheckpointManager
 
 
@@ -170,9 +170,8 @@ def test_quiesce_detects_inflight_and_drains(comm):
     r0.isend(np.float32(3.0), dest=1, tag=9)
     bm = crcp.inspect(c)
     assert not bm.quiet and bm.unexpected == 1
-    with pytest.raises(crcp.QuiesceTimeout):
-        crcp.quiesce(c, timeout=0.05)
-    # residual bookmark mode returns instead of raising
+    # residual bookmark mode returns instead of raising (and does NOT
+    # cancel: the caller may persist-and-replay it)
     bm2 = crcp.quiesce(c, timeout=0.05, require_empty=False)
     assert bm2.unexpected == 1
     # drain by matching, then quiesce succeeds
@@ -181,13 +180,37 @@ def test_quiesce_detects_inflight_and_drains(comm):
     assert crcp.quiesce(c, timeout=0.5).quiet
 
 
+def test_quiesce_timeout_cancels_stragglers(comm):
+    """The QuiesceTimeout branch cancel-and-marks the in-flight
+    stragglers: the raise reports the count, and the matching state is
+    clean afterwards so a follow-up recover()/quiesce() starts from an
+    empty bookmark instead of inheriting half-drained traffic."""
+    c = comm.dup()
+    c.rank(0).isend(np.float32(3.0), dest=1, tag=9)
+    req = c.rank(1).irecv(source=0, tag=77)  # never matched
+    assert not crcp.inspect(c).quiet
+    with pytest.raises(crcp.QuiesceTimeout) as ei:
+        crcp.quiesce(c, timeout=0.05)
+    bm = ei.value.bookmark
+    assert bm.cancelled == 2
+    assert "2 cancelled" in str(ei.value)
+    # post-timeout the bookmark is clean: recover() starts from quiet
+    assert crcp.inspect(c).quiet
+    assert crcp.quiesce(c, timeout=0.5).quiet
+    # the cancelled recv's waiter observes CANCELLED, never a hang
+    from ompi_tpu.core.request import RequestState
+
+    assert req.state is RequestState.CANCELLED
+
+
 def test_manager_refuses_checkpoint_with_inflight(tmp_path, comm):
     c = comm.dup()
     c.rank(0).isend(np.float32(1.0), dest=1, tag=3)
     m = CheckpointManager(str(tmp_path / "ck3"))
     with pytest.raises(crcp.QuiesceTimeout):
         m.save(1, {"x": np.zeros(1)}, comm=c, quiesce_timeout=0.05)
-    c.rank(1).recv(source=0, tag=3)
+    # the refused save cancel-and-marked the straggler: state is clean
+    assert crcp.inspect(c).quiet
 
 
 # -- vprotocol message logging ---------------------------------------------
@@ -211,6 +234,10 @@ def test_pessimist_logs_and_replays(comm):
     c = _with_logging_comm(comm)
     try:
         pml = c.pml
+        # the lifeboat revocation fence wraps outermost; unwrap it to
+        # reach the pessimist logger underneath
+        assert isinstance(pml, lifeboat.LifeboatPml)
+        pml = pml.host
         assert isinstance(pml, vprotocol.PessimistPml)
         pml.log.clear()
         # nondeterministic-looking pattern: two sends, wildcard recvs

@@ -1,0 +1,406 @@
+"""lifeboat — ULFM-grade elastic recovery: epochs, revoke/agree,
+the deterministic shrink→respawn pipeline, and the satellites that
+ride with it (faultline after_step/rank_kill@modex, fleet dead-rank
+drop, ledger scope GC/seed, watchtower baseline reset)."""
+
+import json
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu.core.errors import RevokedError
+from ompi_tpu.ft import crcp, elastic, events, inject, lifeboat
+from ompi_tpu.health import ledger
+from ompi_tpu.telemetry import fleet, watchtower
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture
+def comm():
+    return mt.world()
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    inject.disarm()
+    lifeboat.reset()
+    elastic.reset()
+    events.clear()
+    fleet.reset_for_testing()
+    ledger.reset()
+    # auto-revoke poisons every comm containing the injected dead rank
+    # — WORLD included. The singleton must come back for the next test.
+    w = mt.world()
+    w._revoked = False
+    w.epoch = 0
+
+
+# -- epoch fence and revoke -------------------------------------------------
+
+def test_epoch_fence_one_attribute_read(comm):
+    c = comm.dup()
+    assert c.epoch == 0 and not c._revoked
+    lifeboat.check(c)  # healthy: no raise
+    c._revoked = True
+    with pytest.raises(RevokedError):
+        lifeboat.check(c)
+    with pytest.raises(RevokedError):
+        c.allreduce(np.ones((c.size, 2), np.float32))
+    with pytest.raises(RevokedError):
+        c.send(1.0, dest=1, tag=0)
+
+
+def test_epoch_tag_rides_span_id_namespace(comm):
+    c = comm.dup()
+    t0 = lifeboat.epoch_tag(c)
+    c.epoch = 1
+    assert lifeboat.epoch_tag(c) != t0
+    # the cid field dominates: two comms never share a tag namespace
+    d = comm.dup()
+    d.epoch = 1
+    assert (lifeboat.epoch_tag(d) >> 20) != (lifeboat.epoch_tag(c) >> 20)
+
+
+def test_revoke_is_idempotent_and_fences_cid(comm):
+    c = comm.dup()
+    lifeboat.revoke(c, cause="test")
+    lines = lifeboat.log()
+    lifeboat.revoke(c, cause="test")  # second call: no new log line
+    assert lifeboat.log() == lines
+    assert lifeboat.revoked(c)
+    # the fence is structural too: same cid below the epoch is revoked
+    assert c.cid in [int(ln.split("cid=")[1].split(" ")[0])
+                     for ln in lines if "revoke" in ln]
+
+
+def test_revoke_publishes_modex_marker(comm):
+    from ompi_tpu.runtime import modex
+
+    c = comm.dup()
+    lifeboat.revoke(c, cause="test")
+    marker = modex.peer_revoke(c.cid)
+    assert marker["epoch"] == c.epoch + 1 and marker["cause"] == "test"
+
+
+def test_check_absorbs_peer_marker(comm):
+    """The out-of-band path: a marker published by another controller
+    poisons this comm within the bounded probe window."""
+    from ompi_tpu.core import config
+    from ompi_tpu.runtime import modex
+
+    c = comm.dup()
+    lifeboat.enable()
+    config.set("ft_lifeboat_probe_every", 1)  # probe every check
+    try:
+        modex.publish_revoke(c.cid, {"cid": c.cid, "epoch": 1,
+                                     "cause": "peer"})
+        with pytest.raises(RevokedError):
+            lifeboat.check(c)
+        assert c._revoked
+    finally:
+        config.set("ft_lifeboat_probe_every", 64)
+
+
+def test_proc_failed_auto_revokes_containing_comms(comm):
+    lifeboat.enable()
+    c = comm.dup()
+    sub = comm.create(mt.Group([0, 1]))  # does NOT contain rank 3
+    events.inject(world_rank=3)
+    assert c._revoked and comm._revoked
+    assert not sub._revoked  # dead rank outside the group: untouched
+
+
+# -- agreement --------------------------------------------------------------
+
+def test_agree_masks_dead_rank_votes(comm):
+    elastic.enable()
+    flags = [1] * comm.size
+    flags[2] = 0  # healthy dissenter: vetoes
+    assert lifeboat.agree(comm, flags) == 0
+    events.inject(world_rank=2)
+    # now the 0 belongs to a dead rank: masked, survivors agree on 1
+    assert lifeboat.agree(comm, flags) == 1
+
+
+def test_agree_identical_flags_and_bool_delegate(comm):
+    elastic.enable()
+    events.inject(world_rank=1)
+    flags = [1] * comm.size
+    flags[1] = 0
+    # repeated calls return the same flags (never split-brain)
+    results = {lifeboat.agree(comm, flags) for _ in range(4)}
+    assert results == {1}
+    # elastic.agree keeps its bool surface through the delegation
+    assert elastic.agree(comm, flags) is True
+    flags[0] = 0
+    assert elastic.agree(comm, flags) is False
+
+
+def test_agree_raises_on_no_survivors(comm):
+    elastic.enable()
+    for r in range(comm.size):
+        events.inject(world_rank=r)
+    with pytest.raises(lifeboat.AgreeError):
+        lifeboat.agree(comm, [1] * comm.size)
+
+
+# -- the recovery drill (the ISSUE's tier-1 acceptance flow) ---------------
+
+def _seed_cache_for(nranks):
+    from ompi_tpu.coll.sched import autotune
+    from ompi_tpu.coll.sched import cache as scache
+
+    fp = autotune.fingerprint()
+    key = scache.cache_key("allreduce", 4096, nranks, "float32", fp)
+    scache.CACHE.put(  # commlint: allow(retuneaudit)
+        key, "sched_ring", source="test", score=10.0)
+    return key, fp
+
+
+def test_rank_kill_mid_allreduce_recovery_drill(comm):
+    """rank_kill mid-collective on the mesh: survivors raise
+    RevokedError (no hang), recover() yields a shrunk comm whose
+    allreduce is bit-identical to the survivor-only reference, the
+    sched cache re-keys to r<new>, the dead rank leaves the fleet
+    view, and the comm-scoped ledger entries are GC'd."""
+    from ompi_tpu.coll.sched import cache as scache
+    from ompi_tpu.coll.sched import retune
+
+    c = comm.dup()
+    lifeboat.enable()
+    old_key, fp = _seed_cache_for(c.size)
+    ledger.LEDGER.quarantine("fastpath", scope=str(c.cid), cause="t")
+
+    inject.arm("rank_kill@coll:op=allreduce,after_step=2,peer=3")
+    x = np.arange(c.size * 4, dtype=np.float32).reshape(c.size, 4)
+    with pytest.raises(RevokedError):
+        c.allreduce(x)
+    plan = inject.disarm()
+    assert elastic.failed_ranks() == {3}
+    # mid-collective events carry the injected tag
+    assert "rank_kill" in plan.schedule()
+
+    new = lifeboat.recover(c, seed=11)
+    assert new.size == c.size - 1 and new.epoch == c.epoch + 1
+    assert new.cid != c.cid
+
+    # bit-identical vs the survivor-only reference (dead rank's block
+    # is gone, not zeroed)
+    survivors = [r for r in range(c.size) if r != 3]
+    y = x[survivors]
+    got = np.asarray(new.allreduce(new.put_rank_major(y)))
+    ref = np.broadcast_to(y.sum(axis=0), y.shape)
+    np.testing.assert_array_equal(got, ref)
+
+    # sched cache migrated to r<new>, old key retained
+    entries = scache.CACHE.entries()
+    assert old_key in entries
+    new_keys = [k for k in entries
+                if (retune.parse_key(k) or {}).get("nranks") == new.size]
+    assert new_keys, entries.keys()
+    assert lifeboat.last_report()["cache_migrated"] >= 1
+
+    # dead rank permanently out of the fleet view
+    assert fleet.dead_ranks() == {3}
+    assert 3 not in fleet.gather(c.size)
+
+    # comm-scoped ledger entries GC'd
+    snap = ledger.snapshot()
+    assert not [k for k in snap["entries"]
+                if k.split("/")[0] == str(c.cid)]
+
+
+def test_recover_reseeds_ledger_and_resets_watchtower(comm):
+    c = comm.dup()
+    lifeboat.enable()
+    ledger.LEDGER.quarantine("shm", cause="global-wedge")  # global
+    events.inject(world_rank=2)
+    new = lifeboat.recover(c, migrate_cache=False)
+    # the new comm scope inherits the global quarantine
+    assert ledger.LEDGER.state("shm", str(new.cid)) == ledger.QUARANTINED
+    rep = lifeboat.last_report()
+    assert rep["dead"] == [2] and rep["survivors"] == c.size - 1
+    assert set(rep["phases"]) == {
+        "revoke_ms", "quiesce_ms", "agree_ms", "shrink_ms",
+        "readmit_ms",
+    }
+
+
+def test_recover_quiesce_timeout_cancels_and_proceeds(comm):
+    c = comm.dup()
+    lifeboat.enable()
+    c.rank(0).isend(np.float32(1.0), dest=1, tag=7)  # straggler
+    events.inject(world_rank=1)
+    new = lifeboat.recover(c, quiesce_timeout=0.05, migrate_cache=False)
+    assert new.size == c.size - 1
+    assert lifeboat.last_report()["quiesce_cancelled"] == 1
+    assert crcp.inspect(c).quiet
+
+
+def test_readmit_walks_probation(comm):
+    c = comm.dup()
+    assert lifeboat.readmit(c) is True
+    assert ledger.LEDGER.state("device", str(c.cid)) == ledger.HEALTHY
+    d = comm.dup()
+    assert lifeboat.readmit(d, canary=lambda: False) is False
+    assert ledger.LEDGER.state("device", str(d.cid)) \
+        == ledger.QUARANTINED
+
+
+# -- determinism ------------------------------------------------------------
+
+_DIGEST_PROG = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu as mt
+    from ompi_tpu.core.errors import RevokedError
+    from ompi_tpu.ft import inject, lifeboat
+
+    world = mt.init()
+    comm = world.dup()
+    lifeboat.enable()
+    inject.arm("rank_kill@coll:op=allreduce,after_step=2,peer=3")
+    try:
+        comm.allreduce(np.ones((8, 4), np.float32))
+    except RevokedError:
+        pass
+    inject.disarm()
+    new = lifeboat.recover(comm, seed=5)
+    new.allreduce(np.ones((new.size, 4), np.float32))
+    print("DIGEST " + lifeboat.digest())
+""")
+
+
+@pytest.mark.slow
+def test_recovery_digest_byte_identical_across_controllers():
+    """Two same-seed controller processes running the same drill must
+    produce byte-identical recovery decision-log digests (the log is
+    timestamp-free by construction)."""
+    outs = []
+    for _ in range(2):
+        p = subprocess.run(
+            [sys.executable, "-c", _DIGEST_PROG],
+            capture_output=True, text=True, timeout=240,
+        )
+        assert p.returncode == 0, p.stderr[-1500:]
+        line = [l for l in p.stdout.splitlines()
+                if l.startswith("DIGEST ")][0]
+        outs.append(line.split(" ", 1)[1])
+    assert outs[0] == outs[1]
+
+
+# -- satellites -------------------------------------------------------------
+
+def test_rank_kill_at_modex(comm):
+    from ompi_tpu.runtime import modex
+
+    elastic.enable()
+    inject.arm("rank_kill@modex:op=get,peer=5")
+    with pytest.raises(inject.FaultInjected):
+        modex.get("lifeboat-test-key", timeout_s=0)
+    assert elastic.failed_ranks() == {5}
+    # the fired log carries the injected tag for the drill suite
+    assert "rank_kill@modex" in inject.plan().schedule()
+
+
+def test_after_step_scoping_is_strict_both_ways(comm):
+    plan = inject.arm("rank_kill@coll:op=allreduce,after_step=3,peer=2")
+    # the dispatch probe (no step) never advances an after_step spec,
+    # and a non-matching step does not either
+    assert plan.decide("coll", "allreduce") == []
+    assert plan.decide("coll", "allreduce", step=1) == []
+    assert plan.specs[0].seen == 0
+    with pytest.raises(inject.FaultInjected):
+        inject.coll_step(comm, "allreduce", 3)
+    assert plan.specs[0].fired == 1
+
+
+def test_after_step_rejected_off_coll():
+    with pytest.raises(inject.PlanError):
+        inject.FaultSpec(action="drop", layer="pml", after_step=2)
+    with pytest.raises(inject.PlanError):
+        inject.arm("drop@pml:op=send,after_step=2")
+
+
+def test_fleet_dead_is_not_stale():
+    fleet.reset_for_testing()
+    from ompi_tpu.core.counters import SPC
+
+    from ompi_tpu.runtime import modex
+    modex.publish_telemetry({"seq": 1, "rank": 0})
+    view = fleet.gather(1)
+    assert 0 in view
+    before = SPC.snapshot().get("telemetry_fleet_stale_ranks", 0)
+    fleet.mark_dead([0])
+    view = fleet.gather(1)
+    assert 0 not in view
+    # a dead rank never degrades to stale, so the counter stays flat
+    after = SPC.snapshot().get("telemetry_fleet_stale_ranks", 0)
+    assert after == before
+
+
+def test_watchtower_reset_baselines_without_instance():
+    assert watchtower.reset_baselines() == 0  # no tower running: no-op
+
+
+def test_ledger_gc_and_seed_scope():
+    ledger.LEDGER.quarantine("fastpath", scope="9", cause="t")
+    ledger.LEDGER.suspect("dcn", scope="9", cause="t")
+    ledger.LEDGER.quarantine("shm", cause="t")  # global
+    assert ledger.gc_scope("9") == 2
+    snap = ledger.snapshot()
+    assert not [k for k in snap["entries"] if k.startswith("9/")]
+    # global scope is never GC'd
+    assert ledger.gc_scope(ledger.GLOBAL_SCOPE) == 0
+    # the new scope inherits the global unhealthy tiers
+    assert ledger.seed_scope("10") == 1
+    assert ledger.LEDGER.state("shm", "10") == ledger.QUARANTINED
+
+
+def test_revokecheck_rule_fires_and_suppresses(tmp_path):
+    from ompi_tpu.analysis import lint
+
+    coll = tmp_path / "coll"
+    coll.mkdir()
+    (coll / "bad.py").write_text(textwrap.dedent("""
+        while True:
+            try:
+                comm.allreduce(x)
+            except Exception:
+                continue
+    """))
+    (coll / "good.py").write_text(textwrap.dedent("""
+        while True:
+            lifeboat.check(comm)
+            try:
+                comm.allreduce(x)
+            except Exception:
+                continue
+    """))
+    (coll / "allowed.py").write_text(textwrap.dedent("""
+        while True:  # commlint: allow(revokecheck)
+            try:
+                comm.allreduce(x)
+            except Exception:
+                continue
+    """))
+    rep = lint.lint_tree(str(tmp_path), select="revokecheck")
+    paths = [f.path for f in rep.findings]
+    assert any("bad.py" in p for p in paths)
+    assert not any("good.py" in p for p in paths)
+    assert not any("allowed.py" in p for p in paths)
