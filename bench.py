@@ -2222,6 +2222,210 @@ def _elastic_recovery_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_TENANT_ISOLATION_WORKER = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.daemon import protocol, service
+
+world = ompi_tpu.init()
+assert world.size == 8
+iters = int(os.environ.get("OMPI_TPU_BENCH_TENANT_ITERS", "30"))
+d = service.Daemon(world, seed=0, lane="local")
+rg = d.handle(protocol.Message(protocol.ATTACH, tenant="guaranteed-a",
+                               body={"qos": "guaranteed"}))
+rs = d.handle(protocol.Message(protocol.ATTACH, tenant="scavenger-z",
+                               body={"qos": "scavenger"}))
+x = np.ones((8, 256), dtype=np.float32)
+
+def g_roundtrip():
+    t0 = time.perf_counter()
+    adm = d.handle(protocol.Message(
+        protocol.SUBMIT, tenant="guaranteed-a", session=rg.session,
+        body={"op": "allreduce", "payload": x}))
+    assert adm.kind == protocol.ADMIT, adm.body
+    while True:
+        d.pump()
+        rep = d.fetch(rg.session, adm.seq)
+        if rep is not None:
+            assert rep.body["ok"], rep.body
+            return (time.perf_counter() - t0) * 1e6
+
+def scavenger_flood(n):
+    for _ in range(n):
+        d.handle(protocol.Message(
+            protocol.SUBMIT, tenant="scavenger-z", session=rs.session,
+            body={"op": "nop"}))
+
+for _ in range(3):
+    g_roundtrip()   # warm the dispatch plan before measuring
+base, flood = [], []
+# interleave baseline/flooded iterations so machine drift hits both
+for _ in range(iters):
+    base.append(g_roundtrip())
+    scavenger_flood(12)   # refills its bounded queue + burns tokens
+    flood.append(g_roundtrip())
+base.sort(); flood.sort()
+b50 = base[len(base) // 2]
+f50 = flood[len(flood) // 2]
+deg = (f50 - b50) / b50 * 100.0
+m = d.metering()["scavenger-z"]
+out = {
+    "iters": iters,
+    "baseline_p50_us": round(b50, 2),
+    "flood_p50_us": round(f50, 2),
+    "degradation_pct": round(deg, 2),
+    "scavenger_rejects": m["rejected"],
+    "scavenger_served": m["dispatched"],
+    "pass": deg <= 10.0 and m["rejected"] > 0,
+}
+print("TENANTISO " + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def _tenant_isolation_row() -> dict:
+    """Adversarial-tenant QoS drill on the 8-rank mesh: a guaranteed
+    tenant's allreduce p50 measured clean vs under a scavenger flood
+    pushing 12 submits per iteration through the same daemon. The
+    weighted dispatcher (guaranteed 8 quanta/round, scavenger 1) plus
+    bounded scavenger queues must hold degradation <= 10% — and every
+    flood reject is counted, never silent."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, "-c", _TENANT_ISOLATION_WORKER],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=420,
+        )
+        if p.returncode != 0:
+            return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+        for line in p.stdout.splitlines():
+            if line.startswith("TENANTISO "):
+                return json.loads(line[len("TENANTISO "):])
+        return {"error": "no TENANTISO line"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+_ADMISSION_EVICTION_WORKER = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.daemon import protocol, service
+
+world = ompi_tpu.init()
+trials = int(os.environ.get("OMPI_TPU_BENCH_ADMIT_TRIALS", "10"))
+d = service.Daemon(world, seed=0, lane="local")
+rb = d.handle(protocol.Message(protocol.ATTACH, tenant="bursty",
+                               body={"qos": "scavenger"}))
+
+def submit_nop():
+    return d.handle(protocol.Message(
+        protocol.SUBMIT, tenant="bursty", session=rb.session,
+        body={"op": "nop"}))
+
+# reject -> retry-after -> admit cycle, timed end to end
+retry_ms, cycle_ms, admit_us = [], [], []
+for t in range(trials):
+    # exhaust the token bucket (scavenger: 8 tokens, queue depth 16 —
+    # the bucket binds before the queue)
+    rej = None
+    for _ in range(32):
+        t0 = time.perf_counter()
+        r = submit_nop()
+        dt_us = (time.perf_counter() - t0) * 1e6
+        if r.kind == protocol.REJECT:
+            rej = r
+            break
+        admit_us.append(dt_us)
+    assert rej is not None, "token bucket never bound"
+    retry_ms.append(rej.body["retry_after_ms"])
+    t1 = time.perf_counter()
+    while True:
+        d.pump()   # each pump refills tokens and serves the queue
+        r = submit_nop()
+        if r.kind == protocol.ADMIT:
+            cycle_ms.append((time.perf_counter() - t1) * 1e3)
+            break
+    d.drain()
+
+rejected_total = d.metering()["bursty"]["rejected"]
+
+# evict-to-detach: a tenant with a full queue of admitted work
+rv = d.handle(protocol.Message(protocol.ATTACH, tenant="victim",
+                               body={"qos": "burst"}))
+queued = 0
+for _ in range(16):
+    r = d.handle(protocol.Message(
+        protocol.SUBMIT, tenant="victim", session=rv.session,
+        body={"op": "nop"}))
+    if r.kind == protocol.ADMIT:
+        queued += 1
+t2 = time.perf_counter()
+rep = d.evict("victim")
+evict_ms = (time.perf_counter() - t2) * 1e3
+
+retry_ms.sort(); cycle_ms.sort(); admit_us.sort()
+out = {
+    "trials": trials,
+    "admit_p50_us": round(admit_us[len(admit_us) // 2], 2),
+    "retry_after_p50_ms": round(retry_ms[len(retry_ms) // 2], 3),
+    "reject_to_admit_p50_ms": round(cycle_ms[len(cycle_ms) // 2], 3),
+    "evict_to_detach_ms": round(evict_ms, 3),
+    "evict_answered": rep["answered"],
+    "rejects_counted": rejected_total,
+    "pass": rep["answered"] == queued and rejected_total >= trials,
+}
+print("ADMITEVICT " + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def _admission_eviction_row() -> dict:
+    """Admission-control round trip on the daemon: fill a burst
+    tenant's token bucket to rejection (seeded retry-after captured),
+    pump until the refill admits the retry, and time the cycle; then
+    evict a tenant with a full queue and time revoke -> quiesce ->
+    detach. Rejects are counted (never silent) and every queued
+    request of the evicted tenant is answered EVICTED."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, "-c", _ADMISSION_EVICTION_WORKER],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=420,
+        )
+        if p.returncode != 0:
+            return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+        for line in p.stdout.splitlines():
+            if line.startswith("ADMITEVICT "):
+                return json.loads(line[len("ADMITEVICT "):])
+        return {"error": "no ADMITEVICT line"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def _host_rows() -> dict:
     """Every host-side (tunnel-independent) row, each with r4
     comparison values where r4 measured the same thing. Cached: on
@@ -2291,6 +2495,10 @@ def _host_rows() -> dict:
     rows["schedule_cache_warm_start"] = _sched_warm_start_row()
     _set_phase("elastic recovery (rank_kill -> revoke/agree/shrink)")
     rows["elastic_recovery"] = _elastic_recovery_row()
+    _set_phase("tenant isolation (guaranteed p50 under scavenger flood)")
+    rows["tenant_isolation"] = _tenant_isolation_row()
+    _set_phase("admission/eviction (reject -> retry-after -> admit)")
+    rows["admission_eviction"] = _admission_eviction_row()
     return rows
 
 

@@ -270,6 +270,7 @@ def ensure_rules() -> None:
         from . import revokecheck  # noqa: F401
         from . import schedcutoff  # noqa: F401
         from . import tags  # noqa: F401
+        from . import tenantscope  # noqa: F401
         from . import tracespan  # noqa: F401
 
         _registered = True

@@ -39,7 +39,8 @@ _SPC_METHODS = frozenset({
 #: First name segment -> the subsystem it files under. Grown with the
 #: tree: grep `SPC\.` registrations before trimming this set.
 KNOWN_PREFIXES = frozenset({
-    "btl", "coll", "convertor", "dcn", "fabric", "faultline", "fp",
+    "btl", "coll", "convertor", "daemon", "dcn", "fabric", "faultline",
+    "fp",
     "ft", "health", "hier", "init", "io", "memchecker", "monitoring",
     "mpit", "mtl", "nbc", "op", "osc", "parallel", "part", "pml",
     "pmpi", "quant", "sanitizer", "sched", "shmem", "sm", "telemetry",

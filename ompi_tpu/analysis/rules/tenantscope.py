@@ -1,0 +1,102 @@
+"""Tenant-scope hygiene rule for the bulkhead daemon.
+
+``tenantscope``: the daemon multiplexes many tenants over shared
+control planes — the health ledger, the sched winner cache, SLO
+accounting. Every one of those surfaces is scope-keyed (``str(cid)``
+comm scopes, ``tenant:<id>`` namespaces), and the bulkhead isolation
+guarantee holds only while daemon code *names the scope it is acting
+for*: a ``seed_scope``/``gc_scope``/``is_denied``/``note_read``/
+``set_target``/``note_violation`` call with no tenant-scope evidence
+in its arguments either acts on the global scope (one tenant's fault
+bleeds into everyone's deny decisions) or meters a tenant's traffic
+into an unlabelled bucket (the per-tenant Prometheus series under-
+count, silently).
+
+Scope evidence, checked statically over the call's argument subtree:
+a ``tenant_scope(...)`` call, or any name/attribute mentioning
+``tenant``/``scope``/``cid`` (covers ``str(comm.cid)``, a ``scope=``
+local, a ``session.comm`` chain). Only files under the ``daemon``
+package are checked — outside it, global-scope calls are legitimate
+(the watchtower sets fleet-wide SLOs; tuned consults global tiers).
+
+Suppression: ``# commlint: allow(tenantscope)`` on the call line,
+for a deliberate daemon-global action (e.g. draining every scope at
+shutdown).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule
+
+#: Callees acting on a scope-keyed shared surface. Each takes (or
+#: defaults) a scope; the rule demands the argument list show which.
+SCOPED_CALLEES = frozenset({
+    "seed_scope", "gc_scope", "is_denied", "note_read", "set_target",
+    "note_violation",
+})
+
+#: Identifier substrings that count as scope evidence.
+_EVIDENCE = ("tenant", "scope", "cid")
+
+
+def _has_scope_evidence(call: ast.Call) -> bool:
+    # only the ARGUMENTS count as evidence — the callee attribute
+    # itself (``LEDGER.seed_scope``) always mentions "scope" and must
+    # not vouch for the call it names
+    for kw in call.keywords:
+        if kw.arg and any(e in kw.arg.lower() for e in _EVIDENCE):
+            return True
+    for root in list(call.args) + [kw.value for kw in call.keywords]:
+        for sub in ast.walk(root):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else "")
+                if callee == "tenant_scope":
+                    return True
+            if isinstance(sub, ast.Name) and any(
+                    e in sub.id.lower() for e in _EVIDENCE):
+                return True
+            if isinstance(sub, ast.Attribute) and any(
+                    e in sub.attr.lower() for e in _EVIDENCE):
+                return True
+    return False
+
+
+@COMMLINT.register
+class TenantScopeRule(LintRule):
+    NAME = "tenantscope"
+    PRIORITY = 16
+    DESCRIPTION = ("daemon code touching scope-keyed shared state must "
+                   "name the tenant scope it acts for")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        parts = ctx.relpath.replace("\\", "/").split("/")
+        if "daemon" not in parts:
+            return
+        for node in ast.walk(ctx.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            callee = fn.attr if isinstance(fn, ast.Attribute) else (
+                fn.id if isinstance(fn, ast.Name) else "")
+            if callee not in SCOPED_CALLEES:
+                continue
+            if _has_scope_evidence(node):
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"daemon call {callee}() names no tenant scope — the "
+                "ledger/cache/SLO surfaces are scope-keyed and an "
+                "unscoped call here acts globally (one tenant's fault "
+                "or metering bleeding across the bulkhead); pass "
+                "tenant_scope(t) / str(comm.cid), or allow() a "
+                "deliberate daemon-global action",
+            )

@@ -735,6 +735,26 @@ class ShmEndpoint:
         with self._native_call(what="fp_release"):
             self._lib.fp_release(self._fp, token)
 
+    def fp_drain_views(self, src: int, max_msgs: int = 16) -> list:
+        """Batched demux drain — the daemon ingest primitive: up to
+        ``max_msgs`` nonblocking polls of ``src``'s ring, returning
+        (tag, view, release_token) triples. Frame-backed views
+        (token >= 0) alias the shared slab and stay valid until
+        fp_release(token); inline payloads (token -1) live in a
+        ctx-local scratch the NEXT poll overwrites, so they are
+        materialized here — the only copy on the ingest path, and
+        only for ≤ 256 B control frames."""
+        out: list = []
+        for _ in range(max_msgs):
+            got = self.fp_try_recv_view(src)
+            if got is None:
+                break
+            tag, view, tok = got
+            if tok < 0:
+                view = view.copy()
+            out.append((tag, view, tok))
+        return out
+
     def fp_corrupt_next(self) -> None:
         """Faultline drill hook: the next fp_send posts a descriptor
         with a deliberately wrong CRC; the receiver must reject it."""

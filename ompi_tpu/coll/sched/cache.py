@@ -101,6 +101,11 @@ class ScheduleCache:
         # (tuned._fast_allreduce) stamp it so a warm/tune invalidates
         # them.
         self._generation = 0
+        # shared-read accounting by consumer scope ("tenant:<id>" /
+        # "global"): the winner table warms ONCE per controller and
+        # every daemon tenant reads the same entries — this meters
+        # who benefits without ever scoping the entries themselves.
+        self._scope_reads: dict[str, int] = {}
 
     # -- entries -------------------------------------------------------
 
@@ -189,6 +194,18 @@ class ScheduleCache:
     def get(self, key: str) -> Optional[dict]:
         return self._entries.get(key)
 
+    def note_read(self, *, scope: str) -> None:
+        """Meter one shared winner-table consult by a tenant scope
+        (daemon dispatch calls this per collective) — billing-plane
+        data, non-semantic: never in the digest."""
+        with self._mu:
+            self._scope_reads[scope] = \
+                self._scope_reads.get(scope, 0) + 1
+
+    def scope_reads(self) -> dict[str, int]:
+        with self._mu:
+            return dict(self._scope_reads)
+
     def entries(self) -> dict[str, dict]:
         with self._mu:
             return dict(self._entries)
@@ -200,6 +217,7 @@ class ScheduleCache:
         with self._mu:
             self._entries.clear()
             self._load_attempted.clear()
+            self._scope_reads.clear()
             self._config_gen = -1
             self._generation += 1
 

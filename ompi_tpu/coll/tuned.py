@@ -934,7 +934,8 @@ class TunedColl(XlaColl):
                 # probes give rank_kill@coll:after_step=k its
                 # mid-collective firing point.
                 if inject.armed():
-                    inject.kernel_fault("allreduce", algo)
+                    inject.kernel_fault("allreduce", algo,
+                                        cid=comm.cid)
                     _probe_steps(comm, "allreduce", algo)
                 return plan(x)
 

@@ -1,0 +1,29 @@
+"""bulkhead — the multi-tenant comm daemon.
+
+One long-lived service multiplexes many client sessions onto one
+device mesh: a versioned wire protocol over a zero-copy shm ingest
+lane, per-tenant QoS (guaranteed / burst / scavenger) with
+deterministic weighted admission, bulkhead fault isolation over the
+health ledger's scope namespaces, and lifeboat-grade eviction. See
+docs/DAEMON.md.
+"""
+
+from .bulkhead import Bulkhead, DecisionLog, tenant_scope
+from .ingest import IngestError, LocalLane, ShmLane, shm_available, \
+    wait_reply
+from .protocol import (Message, PROTOCOL_VERSION, ProtocolError,
+                       decode, encode, stamp)
+from .qos import (ADMITTED, BURST, GUARANTEED, SCAVENGER, Admission,
+                  QosClass, qos_class, tenant_seed)
+from .service import Daemon, DaemonError, current, start, stop
+from .session import Request, Session, Tenant
+
+__all__ = [
+    "ADMITTED", "Admission", "BURST", "Bulkhead", "Daemon",
+    "DaemonError", "DecisionLog", "GUARANTEED", "IngestError",
+    "LocalLane", "Message", "PROTOCOL_VERSION", "ProtocolError",
+    "QosClass", "Request", "SCAVENGER", "Session", "ShmLane",
+    "Tenant", "current", "decode", "encode", "qos_class",
+    "shm_available", "stamp", "start", "stop", "tenant_scope",
+    "tenant_seed", "wait_reply",
+]

@@ -1,0 +1,215 @@
+"""Zero-copy shared-memory ingest lane for local daemon clients.
+
+Rides the PR-6 fastpath slab/ring machinery directly: the daemon owns
+``ShmEndpoint(prefix, 0)``, each local client attaches as rank 1 and
+posts protocol frames with ``fp_send`` — small frames (≤ 256 B:
+hello/attach/barrier/detach and every reply header) ride the inline
+descriptor tier, larger submits land in slab frames the daemon
+*decodes in place* from the receive view (PiP-style: the payload
+bytes are read straight out of the client's posted frame, released
+back to the slab pool after decode — no intermediate copy buffer).
+Frames too large for a slab frame spill to ``send_small``'s v2 path
+exactly like organic fastpath traffic.
+
+When the native engine is unavailable (no compiler in the container,
+cvar off) the lane degrades to an in-process deque pair with the same
+API, so every daemon test and drill runs identically — the shm lane
+is a transport, never a semantic.
+
+The client attach path goes through the dpm name service: the daemon
+publishes ``bulkhead/<name>`` (prefix + protocol version), clients
+``lookup_name`` it under a seeded ``core/backoff.Backoff`` deadline —
+no bare spin loops (polldeadline's contract).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..btl import sm
+from ..core.backoff import Backoff
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+
+
+class IngestError(OmpiTpuError):
+    errclass = "ERR_INTERN"
+
+
+def shm_available() -> bool:
+    return sm.engine_available()
+
+
+class LocalLane:
+    """In-process fallback lane: two bounded deques. Deterministic
+    and allocation-cheap — the drill/test transport."""
+
+    kind = "local"
+
+    def __init__(self) -> None:
+        self._mu = threading.Lock()
+        self._to_daemon: list[tuple[int, bytes]] = []
+        self._to_client: list[tuple[int, bytes]] = []
+
+    # client side
+    def submit(self, tag: int, frame: bytes) -> bool:
+        with self._mu:
+            self._to_daemon.append((tag, bytes(frame)))
+        return True
+
+    def poll_reply(self) -> Optional[tuple[int, bytes]]:
+        with self._mu:
+            if not self._to_client:
+                return None
+            return self._to_client.pop(0)
+
+    # daemon side
+    def drain(self, max_msgs: int = 16) -> list:
+        """List of (tag, frame, release_token); token -1 = nothing to
+        release (API parity with the shm lane's slab tokens)."""
+        with self._mu:
+            out = self._to_daemon[:max_msgs]
+            del self._to_daemon[:max_msgs]
+        return [(tag, frame, -1) for tag, frame in out]
+
+    def release(self, token: int) -> None:
+        pass  # nothing slab-backed to return
+
+    def reply(self, tag: int, frame: bytes) -> bool:
+        with self._mu:
+            self._to_client.append((tag, bytes(frame)))
+        return True
+
+    def close(self) -> None:
+        with self._mu:
+            self._to_daemon.clear()
+            self._to_client.clear()
+
+
+class ShmLane:
+    """Fastpath-backed lane. Daemon is fp rank 0, the client rank 1.
+
+    Descriptor tags carry the protocol's epoch-stamped wire tag, so a
+    stale client's post-eviction frames are identifiable before
+    decode (the fence check is the service layer's job; the lane only
+    moves bytes)."""
+
+    kind = "shm"
+    DAEMON_RANK = 0
+    CLIENT_RANK = 1
+
+    def __init__(self, ep, peer: int, *, prefix: str = "",
+                 connected: bool = True) -> None:
+        self.ep = ep
+        self.peer = peer
+        self.prefix = prefix
+        self._connected = connected
+
+    @classmethod
+    def create(cls, prefix: str) -> "ShmLane":
+        # Daemon side: publish our segment now, attach the client's
+        # LAZILY — the daemon starts long before any client exists,
+        # and must never block its pump waiting for one.
+        ep = sm.ShmEndpoint(prefix, cls.DAEMON_RANK)
+        return cls(ep, cls.CLIENT_RANK, prefix=prefix, connected=False)
+
+    @classmethod
+    def attach(cls, prefix: str) -> "ShmLane":
+        ep = sm.ShmEndpoint(prefix, cls.CLIENT_RANK)
+        ep.connect(cls.DAEMON_RANK)
+        return cls(ep, cls.DAEMON_RANK, prefix=prefix)
+
+    def _ensure_peer(self, timeout_s: float = 0.05) -> bool:
+        if self._connected:
+            return True
+        try:
+            self.ep.connect(self.peer, timeout_s=timeout_s)
+        except sm.ShmError:
+            return False  # no client yet: nothing to drain
+        self._connected = True
+        return True
+
+    def _post(self, tag: int, frame: bytes) -> bool:
+        if self.ep.fp_send(self.peer, tag, frame):
+            SPC.record("daemon_ingest_fp_frames")
+            return True
+        # ring/slab full or frame larger than a slab frame: spill to
+        # the v2 small-message path like any fastpath producer
+        self.ep.send_small(self.peer, tag, frame)
+        SPC.record("daemon_ingest_spills")
+        return True
+
+    # client side
+    def submit(self, tag: int, frame: bytes) -> bool:
+        return self._post(tag, frame)
+
+    def poll_reply(self) -> Optional[tuple[int, bytes]]:
+        got = self.ep.fp_try_recv_view(self.peer)
+        if got is None:
+            return None
+        tag, view, tok = got
+        try:
+            return tag, bytes(view)
+        finally:
+            self.ep.fp_release(tok)
+
+    # daemon side
+    def drain(self, max_msgs: int = 16) -> list:
+        """List of (tag, view, release_token). Frame-backed views
+        alias the client's slab frame IN the shared segment — the
+        service decodes straight out of it (PiP-style, no staging
+        copy) and must ``release(token)`` afterwards; inline payloads
+        arrive pre-materialized by fp_drain_views."""
+        if not self._ensure_peer():
+            return []
+        return self.ep.fp_drain_views(self.peer, max_msgs=max_msgs)
+
+    def release(self, token: int) -> None:
+        self.ep.fp_release(token)
+
+    def reply(self, tag: int, frame: bytes) -> bool:
+        return self._post(tag, frame)
+
+    def close(self) -> None:
+        self.ep.close()
+
+
+def connect_client(daemon_name: str = "bulkhead", *,
+                   timeout: float = 5.0) -> "ShmLane":
+    """Client attach: resolve ``bulkhead/<name>`` through the dpm
+    name service (lookup_name polls under its own Backoff deadline)
+    and attach to the daemon's shm prefix. Version skew is rejected
+    here, before any frame is posted."""
+    from ..runtime import dpm
+
+    port = dpm.lookup_name(f"bulkhead/{daemon_name}", timeout=timeout)
+    if not isinstance(port, dict) or "prefix" not in port:
+        raise IngestError(
+            f"daemon {daemon_name!r}: bad name-service record"
+        )
+    from . import protocol
+
+    version = port.get("version")
+    if version != protocol.PROTOCOL_VERSION:
+        raise IngestError(
+            f"daemon {daemon_name!r} speaks protocol {version}, "
+            f"client speaks {protocol.PROTOCOL_VERSION}"
+        )
+    return ShmLane.attach(port["prefix"])
+
+
+def wait_reply(lane, *, timeout: float = 10.0,
+               seed: int = 0) -> tuple[int, bytes]:
+    """Deadline-bounded reply poll (Backoff evidence, never a bare
+    spin). Raises IngestError past the deadline."""
+    bo = Backoff(initial=1e-5, maximum=0.005, timeout=timeout,
+                 seed=seed)
+    while True:
+        got = lane.poll_reply()
+        if got is not None:
+            return got
+        if not bo.sleep():
+            raise IngestError(
+                f"no daemon reply within {timeout}s"
+            )

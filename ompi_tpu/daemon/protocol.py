@@ -1,0 +1,150 @@
+"""bulkhead wire protocol v1: the client<->daemon message frame.
+
+One frame = 4-byte magic + 1 version byte + a dss-packed 6-tuple
+``(kind, tenant, session, epoch, seq, body)``. dss already ships
+ndarrays (the submit payloads) and dicts (everything else), so the
+protocol layer is a thin, versioned envelope: a daemon that doesn't
+speak the client's version rejects at decode, before any state is
+touched.
+
+Epoch stamping rides lifeboat's tag namespace: every admitted request
+gets a wire tag ``stamp(cid, epoch, seq)`` in the same
+``(cid+1) << 20`` id space as commtrace span ids and the revocation
+fence, so a reply from a pre-eviction epoch can never be confused
+with post-recovery traffic — the fence rejects it structurally, no
+timestamps involved.
+
+Request kinds (client -> daemon):
+    hello    version/feature probe, no session required
+    attach   open a session: tenant + qos class (+ optional ranks)
+    submit   one collective: op, payload, params
+    detach   close a session (drains first — never drops work)
+
+Reply kinds (daemon -> client):
+    welcome  hello response: version, qos classes, daemon name
+    attached session id, comm cid, epoch, granted class
+    admit    request admitted: seq + wire tag
+    reject   admission refused: reason + seeded retry_after_ms
+    result   completed collective: payload or error detail
+    evicted  session was evicted (cause, final meter)
+    detached clean close acknowledgement
+    error    malformed / unknown-session / protocol fault
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..core import dss
+from ..core.errors import OmpiTpuError
+
+PROTOCOL_VERSION = 1
+MAGIC = b"OTPD"
+
+# request kinds
+HELLO = "hello"
+ATTACH = "attach"
+SUBMIT = "submit"
+DETACH = "detach"
+REQUEST_KINDS = frozenset((HELLO, ATTACH, SUBMIT, DETACH))
+
+# reply kinds
+WELCOME = "welcome"
+ATTACHED = "attached"
+ADMIT = "admit"
+REJECT = "reject"
+RESULT = "result"
+EVICTED = "evicted"
+DETACHED = "detached"
+ERROR = "error"
+REPLY_KINDS = frozenset((WELCOME, ATTACHED, ADMIT, REJECT, RESULT,
+                         EVICTED, DETACHED, ERROR))
+
+
+class ProtocolError(OmpiTpuError):
+    errclass = "ERR_ARG"
+
+
+@dataclass
+class Message:
+    """One protocol frame. ``body`` carries the kind-specific fields
+    (op/payload for submit, reason/retry_after_ms for reject, ...)."""
+
+    kind: str
+    tenant: str = ""
+    session: int = 0
+    epoch: int = 0
+    seq: int = 0
+    body: dict = field(default_factory=dict)
+
+    def __post_init__(self) -> None:
+        if self.kind not in REQUEST_KINDS and \
+                self.kind not in REPLY_KINDS:
+            raise ProtocolError(f"unknown message kind {self.kind!r}")
+
+
+def stamp(cid: int, epoch: int, seq: int) -> int:
+    """The request's wire tag in lifeboat's epoch-tag namespace:
+    cid field above bit 20, epoch in bits 12..19, sequence below.
+    Identical layout to ``lifeboat.epoch_tag`` so the revocation
+    fence and commtrace spans see daemon traffic natively."""
+    return ((cid + 1) << 20) | ((epoch & 0xFF) << 12) | (seq & 0xFFF)
+
+
+def encode(msg: Message) -> bytes:
+    return MAGIC + bytes((PROTOCOL_VERSION,)) + dss.pack(
+        msg.kind, msg.tenant, int(msg.session), int(msg.epoch),
+        int(msg.seq), msg.body,
+    )
+
+
+def decode(buf: bytes) -> Message:
+    buf = bytes(buf)
+    if len(buf) < len(MAGIC) + 1 or buf[:len(MAGIC)] != MAGIC:
+        raise ProtocolError("not a bulkhead frame (bad magic)")
+    version = buf[len(MAGIC)]
+    if version != PROTOCOL_VERSION:
+        raise ProtocolError(
+            f"protocol version {version} unsupported "
+            f"(daemon speaks {PROTOCOL_VERSION})"
+        )
+    try:
+        kind, tenant, session, epoch, seq, body = \
+            dss.unpack(buf[len(MAGIC) + 1:])
+    except (dss.DssError, ValueError) as exc:
+        raise ProtocolError(f"frame payload undecodable: {exc}") \
+            from exc
+    return Message(kind=kind, tenant=tenant, session=session,
+                   epoch=epoch, seq=seq, body=body)
+
+
+def reject(request: Message, *, reason: str,
+           retry_after_ms: float) -> Message:
+    """The canonical REJECT: always carries a machine-actionable
+    reason and a positive seeded retry-after — admission refusal is
+    flow control, never a silent drop."""
+    return Message(REJECT, tenant=request.tenant,
+                   session=request.session, epoch=request.epoch,
+                   seq=request.seq,
+                   body={"reason": reason,
+                         "retry_after_ms": float(retry_after_ms)})
+
+
+def error(detail: str, *, request: Optional[Message] = None) -> Message:
+    m = request or Message(ERROR)
+    return Message(ERROR, tenant=m.tenant, session=m.session,
+                   epoch=m.epoch, seq=m.seq,
+                   body={"detail": detail})
+
+
+def result(request: Message, payload: Any = None, *,
+           ok: bool = True, detail: str = "") -> Message:
+    body: dict = {"ok": bool(ok)}
+    if payload is not None:
+        body["payload"] = payload
+    if detail:
+        body["detail"] = detail
+    return Message(RESULT, tenant=request.tenant,
+                   session=request.session, epoch=request.epoch,
+                   seq=request.seq, body=body)

@@ -1,0 +1,147 @@
+"""Per-tenant QoS classes and deterministic weighted admission.
+
+Three service classes multiplex one device mesh:
+
+    guaranteed  reserved share: widest queues, most dispatch quanta
+                per pump round, an SLO target the dispatcher meters
+                violation minutes against
+    burst       best-effort with headroom: admitted freely while the
+                mesh keeps up, throttled first under pressure
+    scavenger   strictly-residual: one dispatch quantum per round and
+                a small queue — an adversarial scavenger flood can
+                only burn its own (bounded) budget
+
+Admission is a deterministic token bucket per tenant: capacity and
+refill come from the class, refills happen per *pump round* (logical
+time), and every refusal carries a retry-after drawn from a seeded
+``core/backoff.Backoff`` — same seed, same workload, byte-identical
+decisions. Nothing here reads the wall clock; that is what makes the
+daemon's decision-log digest reproducible across controllers.
+
+Backpressure invariants (the never-silent contract):
+  * per-tenant queues are bounded by ``queue_depth`` — growth beyond
+    it is a REJECT, not memory
+  * per-tenant queued payload bytes are bounded by ``byte_budget``
+    (hog@daemon charges this same budget)
+  * every reject is counted (SPC + tenant meter), logged (numbered
+    decision line), and answered (REJECT + retry_after_ms)
+"""
+
+from __future__ import annotations
+
+import zlib
+from dataclasses import dataclass
+
+from ..core.backoff import Backoff
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+
+
+class QosError(OmpiTpuError):
+    errclass = "ERR_ARG"
+
+
+@dataclass(frozen=True)
+class QosClass:
+    """One service class. ``weight`` is the dispatch quantum (requests
+    served per pump round), ``deadline_slots`` the logical EDF horizon
+    (arrival slot + horizon = deadline slot), ``slo_p50_us`` the
+    latency target violation minutes are metered against (0 = none).
+    """
+
+    name: str
+    weight: int
+    queue_depth: int
+    byte_budget: int
+    admit_tokens: int     # token-bucket capacity
+    refill: int           # tokens restored per pump round
+    deadline_slots: int
+    slo_p50_us: float = 0.0
+
+
+GUARANTEED = QosClass("guaranteed", weight=8, queue_depth=64,
+                      byte_budget=16 << 20, admit_tokens=64,
+                      refill=32, deadline_slots=64,
+                      slo_p50_us=50_000.0)
+BURST = QosClass("burst", weight=4, queue_depth=32,
+                 byte_budget=8 << 20, admit_tokens=32, refill=16,
+                 deadline_slots=256)
+SCAVENGER = QosClass("scavenger", weight=1, queue_depth=16,
+                     byte_budget=1 << 20, admit_tokens=8, refill=2,
+                     deadline_slots=4096)
+
+CLASSES = {c.name: c for c in (GUARANTEED, BURST, SCAVENGER)}
+
+
+def qos_class(name: str) -> QosClass:
+    try:
+        return CLASSES[name]
+    except KeyError:
+        raise QosError(
+            f"unknown qos class {name!r}; expected one of "
+            f"{sorted(CLASSES)}"
+        ) from None
+
+
+def tenant_seed(base_seed: int, tenant: str) -> int:
+    """Deterministic per-tenant RNG seed: the daemon seed folded with
+    a crc32 of the tenant name — stable across controllers, distinct
+    across tenants."""
+    return (int(base_seed) << 1) ^ zlib.crc32(tenant.encode())
+
+
+ADMITTED = "admitted"
+R_QUEUE = "queue_full"
+R_BYTES = "byte_budget"
+R_RATE = "rate"
+
+
+class Admission:
+    """Per-tenant admission state: token bucket + seeded retry-after.
+
+    ``try_admit`` is called with the tenant's *current* queue load so
+    the bounded-queue and byte-budget checks see hog charges too; it
+    never blocks and never drops — the caller turns a refusal into a
+    REJECT reply carrying ``retry_after_ms``."""
+
+    def __init__(self, qos: QosClass, *, seed: int) -> None:
+        self.qos = qos
+        self.tokens = float(qos.admit_tokens)
+        # no deadline: next_delay() is a pure seeded schedule the
+        # rejected client honours before re-submitting
+        self._backoff = Backoff(initial=0.001, maximum=0.25,
+                                seed=seed)
+        self.rejects = 0
+        self.admits = 0
+
+    def refill(self) -> None:
+        """One pump round of logical time: restore ``refill`` tokens
+        up to the bucket capacity."""
+        self.tokens = min(float(self.qos.admit_tokens),
+                          self.tokens + self.qos.refill)
+
+    def try_admit(self, *, queued: int, queued_bytes: int,
+                  nbytes: int) -> tuple[str, float]:
+        """(verdict, retry_after_ms). verdict is ``admitted`` or a
+        reject reason; retry_after_ms is 0.0 on admit, else the next
+        seeded backoff delay."""
+        reason = None
+        if queued >= self.qos.queue_depth:
+            reason = R_QUEUE
+        elif queued_bytes + nbytes > self.qos.byte_budget:
+            reason = R_BYTES
+        elif self.tokens < 1.0:
+            reason = R_RATE
+        if reason is None:
+            self.tokens -= 1.0
+            self.admits += 1
+            self._backoff.reset()
+            return ADMITTED, 0.0
+        self.rejects += 1
+        SPC.record("daemon_admission_rejects")
+        retry_ms = round(self._backoff.next_delay() * 1e3, 3)
+        # escalate: next_delay() alone doesn't advance the attempt
+        # counter, and consecutive rejects should push the tenant
+        # further out (reset on the next admit)
+        self._backoff.attempts += 1
+        return reason, retry_ms

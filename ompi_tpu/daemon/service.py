@@ -1,0 +1,681 @@
+"""The bulkhead daemon: one long-lived comm service, many tenants.
+
+Multiplexes client sessions onto one device mesh. Each session owns a
+communicator carved from the daemon's base comm (so tuned's dispatch,
+the health ledger, commtrace spans, and lifeboat's revocation fence
+all scope to it natively); each tenant owns an admission token
+bucket, bounded queues, a meter, and a ``tenant:<id>`` ledger
+namespace the bulkhead moves fault state through.
+
+Event flow per pump round::
+
+    lane.drain -> decode -> handle (admit/reject) -> refill tokens
+        -> dispatcher.pump_round (weighted EDF) -> replies out
+
+Everything the daemon *decides* — attach, admit, reject (with its
+seeded retry-after), dispatch order, absorb, evict, recover — lands
+in one numbered timestamp-free decision log; same seed + same
+workload replays byte-identically on another controller
+(``Daemon.digest()``). Wall-clock exists only in meters.
+
+Eviction is lifeboat's pipeline: absorb faults into the tenant
+namespace, revoke → quiesce → detach each session comm (queued work
+is answered with EVICTED, never dropped), then GC the tenant
+namespace — ``health.LEDGER.scopes()`` shows zero orphaned scopes
+afterwards.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Any, Optional
+
+import numpy as np
+
+from ..core import config
+from ..core.backoff import Backoff
+from ..core.counters import SPC
+from ..core.errors import OmpiTpuError
+from ..core.logging import get_logger
+from ..coll.sched import cache as scache
+from ..coll.sched import slo
+from ..ft import inject
+from . import ingest, protocol
+from .bulkhead import Bulkhead, DecisionLog, tenant_scope
+from .dispatch import Dispatcher
+from .qos import ADMITTED, QosError, qos_class, tenant_seed
+from .session import (ATTACHED, DETACHED, DRAINING, EVICTED, REVOKED,
+                      Request, Session, Tenant)
+
+logger = get_logger("daemon")
+
+_max_sessions_var = config.register(
+    "daemon", "base", "max_sessions", type=int, default=64,
+    description="Hard cap on concurrently attached sessions across "
+                "all tenants (attach beyond it is rejected)",
+)
+_state_path_var = config.register(
+    "daemon", "base", "state_path", type=str, default="",
+    description="When set, every pump atomically rewrites this JSON "
+                "status snapshot and consumes operator commands from "
+                "'<path>.cmd' — the tools/daemon CLI seam",
+)
+_lane_var = config.register(
+    "daemon", "base", "lane", type=str, default="auto",
+    description="Ingest lane: 'shm' (fastpath slab/ring), 'local' "
+                "(in-process), 'auto' (shm when the native engine is "
+                "available)",
+)
+
+
+class DaemonError(OmpiTpuError):
+    errclass = "ERR_INTERN"
+
+
+class Daemon:
+    def __init__(self, base_comm=None, *, name: str = "bulkhead",
+                 seed: int = 0,
+                 lane: Optional[str] = None) -> None:
+        if base_comm is None:
+            from .. import api
+
+            base_comm = api.world()
+        self.name = name
+        self.seed = int(seed)
+        self.world = base_comm
+        self.log = DecisionLog()
+        self.bulkhead = Bulkhead(self.log)
+        self.dispatcher = Dispatcher(self)
+        self.tenants: dict[str, Tenant] = {}
+        self.sessions: dict[int, Session] = {}
+        self.history: dict[str, dict] = {}  # evicted tenants' meters
+        self._mu = threading.RLock()
+        self._next_sid = 1
+        self._slot = 0  # logical arrival clock (never wall time)
+        self._stopped = False
+        lane_kind = lane if lane is not None else _lane_var.value
+        if lane_kind == "auto":
+            lane_kind = "shm" if ingest.shm_available() else "local"
+        if lane_kind == "shm":
+            self.lane: Any = ingest.ShmLane.create(
+                f"bkd{os.getpid()}x{self.seed}"
+            )
+            # rendezvous record for connect_client(): clients resolve
+            # the shm prefix + protocol version through dpm before
+            # posting any frame
+            from ..runtime import dpm
+
+            dpm.publish_name(
+                f"bulkhead/{name}",
+                {"prefix": self.lane.prefix,
+                 "version": protocol.PROTOCOL_VERSION},
+            )
+        elif lane_kind == "local":
+            self.lane = ingest.LocalLane()
+        else:
+            raise DaemonError(f"unknown ingest lane {lane_kind!r}")
+        self.log.note(
+            f"start name={name} seed={self.seed} "
+            f"version={protocol.PROTOCOL_VERSION} "
+            f"lane={self.lane.kind} base_cid={base_comm.cid}"
+        )
+
+    # -- logical time ----------------------------------------------------
+
+    def _tick(self) -> int:
+        self._slot += 1
+        return self._slot
+
+    def note_cache_read(self, *, scope: str) -> None:
+        scache.CACHE.note_read(scope=scope)
+
+    # -- wire entry ------------------------------------------------------
+
+    def handle(self, msg: protocol.Message) -> protocol.Message:
+        """One request in, one reply out — the single choke point
+        both the shm lane and in-process clients go through."""
+        with self._mu:
+            if self._stopped:
+                return protocol.error("daemon stopped", request=msg)
+            try:
+                if msg.kind == protocol.HELLO:
+                    return self._handle_hello(msg)
+                if msg.kind == protocol.ATTACH:
+                    return self._handle_attach(msg)
+                if msg.kind == protocol.SUBMIT:
+                    return self._handle_submit(msg)
+                if msg.kind == protocol.DETACH:
+                    return self._handle_detach(msg)
+            except (protocol.ProtocolError, QosError) as exc:
+                return protocol.error(str(exc), request=msg)
+            return protocol.error(
+                f"unexpected request kind {msg.kind!r}", request=msg
+            )
+
+    # -- hello -----------------------------------------------------------
+
+    def _handle_hello(self, msg: protocol.Message) -> protocol.Message:
+        from .qos import CLASSES
+
+        return protocol.Message(
+            protocol.WELCOME, tenant=msg.tenant,
+            body={
+                "name": self.name,
+                "version": protocol.PROTOCOL_VERSION,
+                "classes": sorted(CLASSES),
+                "lane": self.lane.kind,
+            },
+        )
+
+    # -- attach ----------------------------------------------------------
+
+    def _handle_attach(self, msg: protocol.Message) -> protocol.Message:
+        if not msg.tenant:
+            raise protocol.ProtocolError("attach requires a tenant id")
+        qos_name = msg.body.get("qos", "burst")
+        qos = qos_class(qos_name)
+        if len(self.sessions) >= _max_sessions_var.value:
+            # attach pressure is admission pressure: bounded, counted,
+            # answered with a seeded retry-after
+            t = self._tenant(msg.tenant, qos)
+            t.meter["rejected"] += 1
+            verdict, retry_ms = t.admission.try_admit(
+                queued=t.qos.queue_depth, queued_bytes=0, nbytes=0
+            )
+            self.log.note(
+                f"reject tenant={msg.tenant} op=attach "
+                f"reason=max_sessions retry_after_ms={retry_ms}"
+            )
+            return protocol.reject(msg, reason="max_sessions",
+                                   retry_after_ms=retry_ms)
+        tenant = self._tenant(msg.tenant, qos)
+        inject.on_daemon("attach", tenant=tenant.name)
+        ranks = msg.body.get("ranks")
+        if ranks:
+            comm = self.world.create(
+                self.world.group.incl(list(ranks))
+            )
+        else:
+            comm = self.world.dup()
+        sid = self._next_sid
+        self._next_sid += 1
+        session = Session(sid, tenant, comm)
+        tenant.sessions[sid] = session
+        self.sessions[sid] = session
+        tenant.meter["sessions"] += 1
+        SPC.record("daemon_sessions_attached")
+        seeded = self.bulkhead.on_attach(tenant.name, comm)
+        if tenant.qos.slo_p50_us:
+            slo.set_target(str(comm.cid), tenant.qos.slo_p50_us)
+        self.log.note(
+            f"attach tenant={tenant.name} sid={sid} cid={comm.cid} "
+            f"epoch={comm.epoch} class={tenant.qos.name} "
+            f"ranks={len(ranks) if ranks else comm.size} "
+            f"seeded={seeded}"
+        )
+        return protocol.Message(
+            protocol.ATTACHED, tenant=tenant.name, session=sid,
+            epoch=comm.epoch,
+            body={"cid": comm.cid, "qos": tenant.qos.name,
+                  "size": comm.size},
+        )
+
+    def _tenant(self, name: str, qos) -> Tenant:
+        t = self.tenants.get(name)
+        if t is None:
+            t = Tenant(name, qos,
+                       seed=tenant_seed(self.seed, name))
+            self.tenants[name] = t
+        return t
+
+    # -- submit / admission ----------------------------------------------
+
+    def _handle_submit(self, msg: protocol.Message) -> protocol.Message:
+        session = self.sessions.get(msg.session)
+        if session is None:
+            return protocol.error(
+                f"unknown session {msg.session}", request=msg
+            )
+        if session.state in (EVICTED, DETACHED):
+            return protocol.Message(
+                protocol.EVICTED, tenant=msg.tenant,
+                session=msg.session,
+                body={"cause": session.state},
+            )
+        if session.state == REVOKED:
+            return protocol.error(
+                "session comm revoked; recover_tenant() or detach",
+                request=msg,
+            )
+        tenant = session.tenant
+        # adversarial-tenant probes: flood/hog amplify HERE, through
+        # the same admission path as organic traffic
+        for spec in inject.on_daemon("submit", tenant=tenant.name,
+                                     cid=session.comm.cid):
+            if spec.action == "flood":
+                self._flood(session, spec.rate)
+            elif spec.action == "hog":
+                self._hog(tenant, spec.nbytes)
+        op = msg.body.get("op", "")
+        payload = msg.body.get("payload")
+        nbytes = int(np.asarray(payload).nbytes) \
+            if payload is not None else 0
+        tenant.meter["requests"] += 1
+        verdict, retry_ms = tenant.admission.try_admit(
+            queued=tenant.queued(),
+            queued_bytes=tenant.queued_bytes(),
+            nbytes=nbytes,
+        )
+        if verdict != ADMITTED:
+            tenant.meter["rejected"] += 1
+            self.log.note(
+                f"reject tenant={tenant.name} sid={session.sid} "
+                f"op={op} reason={verdict} "
+                f"retry_after_ms={retry_ms}"
+            )
+            return protocol.reject(msg, reason=verdict,
+                                   retry_after_ms=retry_ms)
+        seq = session.next_seq()
+        slot = self._tick()
+        tag = protocol.stamp(session.comm.cid, session.comm.epoch,
+                             seq)
+        params = dict(msg.body.get("params") or {})
+        params["msg"] = msg
+        req = Request(
+            seq=seq, op=op, payload=payload, nbytes=nbytes, tag=tag,
+            arrival_slot=slot,
+            deadline_slot=slot + tenant.qos.deadline_slots,
+            params=params,
+        )
+        session.queue.append(req)
+        session.queued_bytes += nbytes
+        tenant.meter["admitted"] += 1
+        tenant.meter["bytes"] += nbytes
+        self.log.note(
+            f"admit tenant={tenant.name} sid={session.sid} "
+            f"seq={seq} op={op} bytes={nbytes} slot={slot} "
+            f"deadline={req.deadline_slot}"
+        )
+        return protocol.Message(
+            protocol.ADMIT, tenant=tenant.name,
+            session=session.sid, epoch=session.comm.epoch, seq=seq,
+            body={"tag": tag, "slot": slot},
+        )
+
+    def _flood(self, session: Session, rate: int) -> None:
+        """Amplify a flood@daemon firing: ``rate`` synthetic no-op
+        submits pushed through admission. Admitted ones clog the
+        flooding tenant's own (bounded) queue; the rest are rejected
+        and counted. One summary decision line keeps the log compact
+        and deterministic."""
+        tenant = session.tenant
+        admitted = rejected = 0
+        for _ in range(rate):
+            verdict, _retry = tenant.admission.try_admit(
+                queued=tenant.queued(),
+                queued_bytes=tenant.queued_bytes(), nbytes=0,
+            )
+            if verdict != ADMITTED:
+                tenant.meter["rejected"] += 1
+                rejected += 1
+                continue
+            admitted += 1
+            seq = session.next_seq()
+            slot = self._tick()
+            nop = protocol.Message(
+                protocol.SUBMIT, tenant=tenant.name,
+                session=session.sid, body={"op": "nop"},
+            )
+            session.queue.append(Request(
+                seq=seq, op="nop", payload=None, nbytes=0,
+                tag=protocol.stamp(session.comm.cid,
+                                   session.comm.epoch, seq),
+                arrival_slot=slot,
+                deadline_slot=slot + tenant.qos.deadline_slots,
+                params={"msg": nop},
+            ))
+        tenant.meter["flood_synthetic"] += rate
+        SPC.record("daemon_flood_synthetic", rate)
+        self.log.note(
+            f"flood tenant={tenant.name} sid={session.sid} "
+            f"rate={rate} admitted={admitted} rejected={rejected}"
+        )
+
+    def _hog(self, tenant: Tenant, nbytes: int) -> None:
+        """Charge a hog@daemon firing against the tenant's queue
+        byte budget — subsequent submits hit R_BYTES until eviction
+        (or detach) releases the charge."""
+        tenant.hogged_bytes += nbytes
+        tenant.meter["hog_bytes"] += nbytes
+        SPC.record("daemon_hog_bytes", nbytes)
+        self.log.note(
+            f"hog tenant={tenant.name} bytes={nbytes} "
+            f"hogged={tenant.hogged_bytes}"
+        )
+
+    # -- detach ----------------------------------------------------------
+
+    def _handle_detach(self, msg: protocol.Message) -> protocol.Message:
+        session = self.sessions.get(msg.session)
+        if session is None:
+            return protocol.error(
+                f"unknown session {msg.session}", request=msg
+            )
+        tenant = session.tenant
+        inject.on_daemon("detach", tenant=tenant.name,
+                         cid=session.comm.cid)
+        session.state = DRAINING
+        # drain-before-detach: queued work completes (bounded — the
+        # queue is bounded and nothing new is admitted in DRAINING)
+        while session.queue:
+            self.dispatcher.pump_round()
+        self.bulkhead.evict_session(tenant.name, session.comm,
+                                    cause="detach")
+        slo.set_target(str(session.comm.cid), None)
+        session.state = DETACHED
+        tenant.sessions.pop(session.sid, None)
+        self.sessions.pop(session.sid, None)
+        tenant.meter["sessions"] -= 1
+        self.log.note(
+            f"detach tenant={tenant.name} sid={session.sid} "
+            f"cid={session.comm.cid}"
+        )
+        return protocol.Message(
+            protocol.DETACHED, tenant=tenant.name,
+            session=session.sid,
+            body={"completed": len(session.completed)},
+        )
+
+    # -- eviction (operator / policy) ------------------------------------
+
+    def evict(self, tenant_name: str, *,
+              cause: str = "operator") -> dict:
+        """Tenant-level eviction: every session revoked → quiesced →
+        detached (queued requests answered EVICTED — never silently
+        dropped), hog charges released, SLO targets cleared, tenant
+        namespace GC'd. Deterministic: one numbered line per phase."""
+        with self._mu:
+            tenant = self.tenants.get(tenant_name)
+            if tenant is None:
+                raise DaemonError(f"unknown tenant {tenant_name!r}")
+            dropped = 0
+            for session in sorted(tenant.sessions.values(),
+                                  key=lambda s: s.sid):
+                for req in session.queue:
+                    req.reply = protocol.Message(
+                        protocol.EVICTED, tenant=tenant_name,
+                        session=session.sid, seq=req.seq,
+                        body={"cause": cause},
+                    )
+                    session.completed[req.seq] = req.reply
+                    dropped += 1
+                session.queue.clear()
+                session.queued_bytes = 0
+                self.bulkhead.evict_session(tenant_name,
+                                            session.comm,
+                                            cause=cause)
+                slo.set_target(str(session.comm.cid), None)
+                session.state = EVICTED
+                self.sessions.pop(session.sid, None)
+            tenant.sessions.clear()
+            tenant.hogged_bytes = 0
+            tenant.meter["evictions"] += 1
+            tenant.meter["sessions"] = 0
+            released = self.bulkhead.release_tenant(tenant_name)
+            slo.set_target(tenant_scope(tenant_name), None)
+            self.history[tenant_name] = dict(tenant.meter,
+                                             qos=tenant.qos.name)
+            self.tenants.pop(tenant_name, None)
+            self.log.note(
+                f"evicted tenant={tenant_name} cause={cause} "
+                f"answered={dropped} released={released}"
+            )
+            return {"tenant": tenant_name, "answered": dropped,
+                    "released": released}
+
+    # -- recovery --------------------------------------------------------
+
+    def recover_tenant(self, tenant_name: str) -> dict:
+        """Recover a tenant whose session comms were revoked (rank
+        death): lifeboat's shrink pipeline per session, then rebind —
+        the session keeps its sid and meter, gets a fresh comm, cid
+        scope seeded from the tenant namespace, epoch bumped."""
+        with self._mu:
+            from ..ft import lifeboat
+
+            tenant = self.tenants.get(tenant_name)
+            if tenant is None:
+                raise DaemonError(f"unknown tenant {tenant_name!r}")
+            recovered = 0
+            for session in sorted(tenant.sessions.values(),
+                                  key=lambda s: s.sid):
+                if session.state != REVOKED and \
+                        not lifeboat.revoked(session.comm):
+                    continue
+                old = session.comm
+                new = lifeboat.recover(old, quiesce_timeout=0.5,
+                                       seed=self.seed)
+                session.comm = new
+                session.state = ATTACHED
+                self.bulkhead.on_attach(tenant_name, new)
+                if tenant.qos.slo_p50_us:
+                    slo.set_target(str(new.cid),
+                                   tenant.qos.slo_p50_us)
+                    slo.set_target(str(old.cid), None)
+                recovered += 1
+                self.log.note(
+                    f"recover tenant={tenant_name} "
+                    f"sid={session.sid} cid={old.cid}->{new.cid} "
+                    f"epoch={old.epoch}->{new.epoch} "
+                    f"survivors={new.size}"
+                )
+            SPC.record("daemon_recoveries", recovered)
+            return {"tenant": tenant_name, "recovered": recovered}
+
+    # -- pump ------------------------------------------------------------
+
+    def pump(self, rounds: int = 1) -> int:
+        """The daemon's heartbeat: ingest, refill, dispatch."""
+        served = 0
+        for _ in range(rounds):
+            with self._mu:
+                self._pump_lane()
+                for t in self.tenants.values():
+                    t.admission.refill()
+                served += self.dispatcher.pump_round()
+        state_path = _state_path_var.value
+        if state_path:
+            self.process_control(state_path + ".cmd")
+            self.save_state(state_path)
+        return served
+
+    def _pump_lane(self) -> None:
+        for tag, frame, token in self.lane.drain():
+            try:
+                msg = protocol.decode(frame)
+            except protocol.ProtocolError as exc:
+                SPC.record("daemon_protocol_errors")
+                reply = protocol.error(str(exc))
+            else:
+                reply = self.handle(msg)
+            finally:
+                self.lane.release(token)
+            self.lane.reply(tag, protocol.encode(reply))
+
+    def drain(self, *, timeout: float = 30.0) -> int:
+        """Pump until every dispatchable queue is empty (deadline-
+        bounded: a REVOKED session's queue cannot drain — recover or
+        evict it first; past the deadline this raises)."""
+        bo = Backoff(initial=1e-4, maximum=0.01, timeout=timeout,
+                     seed=self.seed)
+        served = 0
+        while True:
+            pending = sum(
+                len(s.queue) for s in self.sessions.values()
+                if s.state in (ATTACHED, DRAINING)
+            )
+            if pending == 0:
+                return served
+            served += self.pump()
+            if not bo.sleep():
+                raise DaemonError(
+                    f"drain deadline ({timeout}s) with {pending} "
+                    f"request(s) stuck"
+                )
+
+    # -- client fetch ----------------------------------------------------
+
+    def fetch(self, sid: int, seq: int) -> Optional[protocol.Message]:
+        """Pop a completed request's reply (RESULT / EVICTED)."""
+        session = self.sessions.get(sid)
+        if session is None:
+            return None
+        return session.completed.pop(seq, None)
+
+    # -- introspection / metering ----------------------------------------
+
+    def metering(self) -> dict:
+        """Per-tenant meter snapshot (active + evicted) — the
+        telescope export reads this for the labelled series."""
+        with self._mu:
+            out = {}
+            for name, t in self.tenants.items():
+                m = dict(t.meter)
+                m["sessions"] = len(t.sessions)
+                m["queued"] = t.queued()
+                m["queued_bytes"] = t.queued_bytes()
+                m["qos"] = t.qos.name
+                out[name] = m
+            for name, meter in self.history.items():
+                if name not in out:
+                    m = dict(meter)
+                    m["qos"] = m.get("qos", "")
+                    out[name] = m
+            viol = slo.violation_minutes()
+            for name, m in out.items():
+                m["slo_violation_minutes"] = round(
+                    viol.get(tenant_scope(name), 0.0), 6
+                )
+            return out
+
+    def status(self) -> dict:
+        with self._mu:
+            return {
+                "name": self.name,
+                "version": protocol.PROTOCOL_VERSION,
+                "lane": self.lane.kind,
+                "seed": self.seed,
+                "slot": self._slot,
+                "base_cid": self.world.cid,
+                "sessions": [
+                    {
+                        "sid": s.sid,
+                        "tenant": s.tenant.name,
+                        "qos": s.tenant.qos.name,
+                        "cid": s.comm.cid,
+                        "epoch": s.comm.epoch,
+                        "state": s.state,
+                        "queued": len(s.queue),
+                        "queued_bytes": s.queued_bytes,
+                    }
+                    for s in sorted(self.sessions.values(),
+                                    key=lambda s: s.sid)
+                ],
+                "tenants": self.metering(),
+                "digest": self.log.digest(),
+                "cache_scope_reads": scache.CACHE.scope_reads(),
+            }
+
+    def digest(self) -> str:
+        return self.log.digest()
+
+    # -- state file / control channel (tools/daemon CLI) -----------------
+
+    def save_state(self, path: str) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump(self.status(), fh, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+
+    def process_control(self, cmd_path: str) -> int:
+        """Consume operator commands (JSON lines appended by the
+        CLI): {"cmd": "evict", "tenant": X} / {"cmd": "drain"}.
+        Unknown or malformed commands are logged, never fatal."""
+        try:
+            with open(cmd_path, "r", encoding="utf-8") as fh:
+                lines = fh.readlines()
+        except FileNotFoundError:
+            return 0
+        except OSError as exc:
+            logger.warning("daemon: control file unreadable: %s", exc)
+            return 0
+        os.unlink(cmd_path)
+        done = 0
+        for line in lines:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                cmd = json.loads(line)
+            except ValueError as exc:
+                logger.warning("daemon: bad control line %r: %s",
+                               line, exc)
+                continue
+            kind = cmd.get("cmd")
+            try:
+                if kind == "evict" and cmd.get("tenant"):
+                    self.evict(cmd["tenant"], cause="cli")
+                    done += 1
+                elif kind == "drain":
+                    self.drain()
+                    done += 1
+                else:
+                    logger.warning("daemon: unknown control %r", cmd)
+            except (DaemonError, OmpiTpuError) as exc:
+                logger.warning("daemon: control %r failed: %s",
+                               cmd, exc)
+        return done
+
+    # -- shutdown --------------------------------------------------------
+
+    def stop(self) -> None:
+        with self._mu:
+            if self._stopped:
+                return
+            self._stopped = True
+            for name in sorted(self.tenants):
+                self.evict(name, cause="shutdown")
+            if self.lane.kind == "shm":
+                from ..runtime import dpm
+
+                dpm.unpublish_name(f"bulkhead/{self.name}")
+            self.lane.close()
+            self.log.note("stop")
+
+
+# -- module singleton ---------------------------------------------------
+
+_CURRENT: Optional[Daemon] = None
+
+
+def start(base_comm=None, **kw) -> Daemon:
+    global _CURRENT
+    if _CURRENT is not None and not _CURRENT._stopped:
+        raise DaemonError("a daemon is already running; stop() first")
+    _CURRENT = Daemon(base_comm, **kw)
+    return _CURRENT
+
+
+def current() -> Optional[Daemon]:
+    if _CURRENT is not None and _CURRENT._stopped:
+        return None
+    return _CURRENT
+
+
+def stop() -> None:
+    global _CURRENT
+    if _CURRENT is not None:
+        _CURRENT.stop()
+        _CURRENT = None

@@ -1,0 +1,107 @@
+"""Session and tenant state for the bulkhead daemon.
+
+A *tenant* is the isolation + accounting unit (admission tokens, byte
+budget, meter, ledger namespace); a *session* is one attached client
+with its own communicator (and therefore its own ledger comm scope
+and epoch-tagged slice of the wire tag namespace).
+
+All scheduling state is logical: arrival slots, deadline slots,
+token counts — never wall-clock — so the daemon's decisions replay
+byte-identically across same-seed controllers. Wall-clock exists
+only in the *meter* (SLO violation minutes, latency), which is
+deliberately outside the decision log, mirroring lifeboat's
+phase-timing split.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from .qos import Admission, QosClass
+
+# session lifecycle
+ATTACHED = "attached"
+DRAINING = "draining"   # detach requested: no new admits, queue drains
+REVOKED = "revoked"     # comm poisoned (rank death / revocation)
+EVICTED = "evicted"
+DETACHED = "detached"
+
+
+@dataclass
+class Request:
+    """One admitted collective. ``deadline_slot`` is logical EDF time
+    (arrival slot + the class horizon); ``tag`` is the epoch-stamped
+    wire tag from protocol.stamp."""
+
+    seq: int
+    op: str
+    payload: Any
+    nbytes: int
+    tag: int
+    arrival_slot: int
+    deadline_slot: int
+    params: dict = field(default_factory=dict)
+    reply: Optional[Any] = None  # protocol.Message once completed
+
+
+class Session:
+    def __init__(self, sid: int, tenant: "Tenant", comm) -> None:
+        self.sid = sid
+        self.tenant = tenant
+        self.comm = comm
+        self.state = ATTACHED
+        self.queue: deque[Request] = deque()
+        self.queued_bytes = 0
+        self.seq = 0
+        self.completed: dict[int, Any] = {}  # seq -> reply Message
+
+    @property
+    def qos(self) -> QosClass:
+        return self.tenant.qos
+
+    def next_seq(self) -> int:
+        self.seq += 1
+        return self.seq
+
+    def head_deadline(self) -> int:
+        return self.queue[0].deadline_slot if self.queue else 1 << 62
+
+
+class Tenant:
+    """Admission + metering scope shared by all of one tenant's
+    sessions. ``hogged_bytes`` is the synthetic queue-memory charge a
+    hog@daemon fault injects — it consumes the same byte budget as
+    real queued payloads, so the bulkhead drill exercises the exact
+    production reject path."""
+
+    def __init__(self, name: str, qos: QosClass, *,
+                 seed: int) -> None:
+        self.name = name
+        self.qos = qos
+        self.admission = Admission(qos, seed=seed)
+        self.sessions: dict[int, Session] = {}
+        self.hogged_bytes = 0
+        self.meter = {
+            "sessions": 0,
+            "requests": 0,
+            "admitted": 0,
+            "rejected": 0,
+            "dispatched": 0,
+            "bytes": 0,
+            "evictions": 0,
+            "denied_tier_observations": 0,
+            "flood_synthetic": 0,
+            "hog_bytes": 0,
+            "slo_violation_ms": 0.0,
+            "errors": 0,
+        }
+
+    def queued(self) -> int:
+        return sum(len(s.queue) for s in self.sessions.values())
+
+    def queued_bytes(self) -> int:
+        return self.hogged_bytes + sum(
+            s.queued_bytes for s in self.sessions.values()
+        )

@@ -98,13 +98,17 @@ from ..core.logging import get_logger
 
 logger = get_logger("ft.inject")
 
-LAYERS = ("btl_sm", "btl_dcn", "pml", "modex", "coll")
+LAYERS = ("btl_sm", "btl_dcn", "pml", "modex", "coll", "daemon")
 ACTIONS = ("drop", "delay", "duplicate", "corrupt", "disconnect",
-           "rank_kill", "wedge")
+           "rank_kill", "wedge", "flood", "hog")
 
 #: Which actions make sense at which boundary (parse-time validation —
 #: a spec that could never fire is a plan bug, not a quiet no-op).
 #: wedge is valid everywhere: any seam can stall indefinitely.
+#: flood/hog are the adversarial-tenant primitives: they only make
+#: sense at the daemon admission boundary, where the daemon amplifies
+#: a fired spec into `rate=` synthetic submits or charges `bytes=` of
+#: queue memory against the probing tenant's budget.
 _VALID = {
     "btl_sm": {"drop", "delay", "corrupt", "wedge"},
     "btl_dcn": {"drop", "delay", "duplicate", "corrupt", "disconnect",
@@ -112,6 +116,7 @@ _VALID = {
     "pml": {"drop", "delay", "duplicate", "corrupt", "wedge"},
     "modex": {"drop", "delay", "wedge", "rank_kill"},
     "coll": {"delay", "disconnect", "rank_kill", "wedge"},
+    "daemon": {"delay", "wedge", "flood", "hog"},
 }
 
 _plan_var = config.register(
@@ -154,8 +159,11 @@ class FaultSpec:
     ms: float = 0.0           # delay milliseconds
     link: int = 0             # DCN link index for disconnect
     algo: Optional[str] = None
-    key: Optional[str] = None  # modex key substring
+    key: Optional[str] = None  # modex key / daemon tenant substring
     exit_code: Optional[int] = None
+    cid: Optional[int] = None  # communicator scope (coll/daemon probes)
+    rate: int = 0             # flood: synthetic submits per firing
+    nbytes: int = 0           # hog: queue-memory bytes per firing
     # runtime state
     seen: int = field(default=0, compare=False)
     fired: int = field(default=0, compare=False)
@@ -182,10 +190,17 @@ class FaultSpec:
     def scope_matches(self, layer: str, op: Optional[str],
                       peer: Optional[int], tag: Optional[int],
                       algo: Optional[str], key: Optional[str],
-                      step: Optional[int] = None) -> bool:
+                      step: Optional[int] = None,
+                      cid: Optional[int] = None) -> bool:
         if layer != self.layer:
             return False
         if self.op is not None and op != self.op:
+            return False
+        # cid= pins a spec to one communicator scope — how a drill
+        # targets one tenant's session comm on a shared daemon without
+        # perturbing its neighbours. Non-strict: an unscoped spec
+        # still matches probes that carry a cid.
+        if self.cid is not None and cid != self.cid:
             return False
         # For rank_kill (and all coll-layer specs) `peer=` is not a
         # scope filter: those probes carry no peer; the key instead
@@ -222,13 +237,18 @@ class FaultSpec:
         parts = [f"{self.action}@{self.layer}"]
         kv = []
         for name, val in (("op", self.op), ("peer", self.peer),
-                          ("algo", self.algo), ("key", self.key)):
+                          ("algo", self.algo), ("key", self.key),
+                          ("cid", self.cid)):
             if val is not None:
                 kv.append(f"{name}={val}")
         if self.tag_lo is not None:
             kv.append(f"tag={self.tag_lo}-{self.tag_hi}")
         if self.after_step is not None:
             kv.append(f"after_step={self.after_step}")
+        if self.rate:
+            kv.append(f"rate={self.rate}")
+        if self.nbytes:
+            kv.append(f"bytes={self.nbytes}")
         if kv:
             parts.append(":" + ",".join(kv))
         return "".join(parts)
@@ -275,6 +295,12 @@ def _parse_spec(text: str) -> FaultSpec:
             spec.algo = v
         elif k == "key":
             spec.key = v
+        elif k == "cid":
+            spec.cid = int(v)
+        elif k == "rate":
+            spec.rate = int(v)
+        elif k == "bytes":
+            spec.nbytes = int(v)
         elif k == "exit":
             spec.exit_code = int(v)
         else:
@@ -283,6 +309,10 @@ def _parse_spec(text: str) -> FaultSpec:
         raise PlanError(
             f"spec {text!r}: after_step only scopes coll-layer specs"
         )
+    if spec.action == "flood" and spec.rate <= 0:
+        raise PlanError(f"spec {text!r}: flood needs rate=N>0")
+    if spec.action == "hog" and spec.nbytes <= 0:
+        raise PlanError(f"spec {text!r}: hog needs bytes=N>0")
     return spec
 
 
@@ -306,7 +336,8 @@ class FaultPlan:
     def decide(self, layer: str, op: Optional[str] = None, *,
                peer: Optional[int] = None, tag: Optional[int] = None,
                algo: Optional[str] = None, key: Optional[str] = None,
-               step: Optional[int] = None) -> list[FaultSpec]:
+               step: Optional[int] = None,
+               cid: Optional[int] = None) -> list[FaultSpec]:
         """All specs firing for this occurrence, in plan order. Each
         scope match advances the spec's occurrence counter (and the
         seeded RNG when ``prob`` is set) whether or not it fires, so
@@ -315,7 +346,7 @@ class FaultPlan:
         with self._mu:
             for spec in self.specs:
                 if not spec.scope_matches(layer, op, peer, tag, algo,
-                                          key, step):
+                                          key, step, cid):
                     continue
                 spec.seen += 1
                 if spec.seen <= spec.skip or spec.fired >= spec.count:
@@ -730,7 +761,7 @@ def on_coll(comm, opname: str) -> None:
     p = _PLAN
     if p is None:
         return
-    for spec in p.decide("coll", opname):
+    for spec in p.decide("coll", opname, cid=comm.cid):
         if spec.action == "delay":
             _apply_delay(spec)
         elif spec.action == "wedge":
@@ -751,7 +782,7 @@ def coll_step(comm, opname: str, step: int) -> None:
     p = _PLAN
     if p is None:
         return
-    for spec in p.decide("coll", opname, step=step):
+    for spec in p.decide("coll", opname, step=step, cid=comm.cid):
         if spec.action == "rank_kill":
             _rank_kill(spec,
                        f"{opname} step {step} on {comm.name}")
@@ -761,14 +792,17 @@ def coll_step(comm, opname: str, step: int) -> None:
             _apply_wedge(spec)
 
 
-def kernel_fault(opname: str, algo: str) -> None:
+def kernel_fault(opname: str, algo: str,
+                 cid: Optional[int] = None) -> None:
     """tuned-dispatch hook: a `disconnect@coll:algo=X` spec makes tier
     X raise FaultInjected — the kernel/transport fault the circuit
-    breaker (coll/breaker.py) degrades on."""
+    breaker (coll/breaker.py) degrades on. ``cid`` scopes the probe
+    to the dispatching communicator so `cid=` specs can wedge one
+    tenant's tier without touching a neighbour's."""
     p = _PLAN
     if p is None:
         return
-    for spec in p.decide("coll", opname, algo=algo):
+    for spec in p.decide("coll", opname, algo=algo, cid=cid):
         if spec.action == "disconnect":
             raise FaultInjected(
                 f"injected {opname} tier fault in {algo!r}"
@@ -779,3 +813,29 @@ def kernel_fault(opname: str, algo: str) -> None:
             # the tier STALLS (no raise): only a sentinel deadline —
             # or disarm() — gets the collective off this tier
             _apply_wedge(spec)
+
+
+# -- daemon boundary (interposed in daemon/service request handlers) ----
+
+def on_daemon(op: str, *, tenant: Optional[str] = None,
+              cid: Optional[int] = None) -> list[FaultSpec]:
+    """Daemon-boundary probe (``op`` is the request kind: attach /
+    submit / dispatch / detach). ``key=`` scopes a spec to a tenant
+    substring, ``cid=`` to one session comm. delay/wedge are applied
+    in place; flood/hog specs are *returned* — the daemon amplifies a
+    flood into ``rate=`` synthetic admission attempts and charges a
+    hog's ``bytes=`` against the probing tenant's queue budget, so
+    the adversarial pressure goes through the same admission path
+    (counted, logged, never silent) as organic traffic."""
+    p = _PLAN
+    if p is None:
+        return []
+    out: list[FaultSpec] = []
+    for spec in p.decide("daemon", op, key=tenant, cid=cid):
+        if spec.action == "delay":
+            _apply_delay(spec)
+        elif spec.action == "wedge":
+            _apply_wedge(spec)
+        else:
+            out.append(spec)
+    return out

@@ -445,6 +445,41 @@ def recover(comm, *, quiesce_timeout: float = 1.0,
     return new
 
 
+def detach(comm, *, cause: str = "detach",
+           quiesce_timeout: float = 1.0) -> dict:
+    """Deterministic teardown of one communicator: revoke → quiesce →
+    free → ledger scope GC. This is recover() without the shrink — the
+    comm is leaving, not surviving. The daemon's eviction pipeline
+    reuses it so an evicted tenant's sessions drain through exactly
+    the recovery machinery (outstanding waits cancelled-and-marked,
+    scope entries collected, a numbered timestamp-free log line), and
+    a same-seed eviction keeps the digest byte-identical."""
+    from ..health import ledger as health
+    from . import crcp
+
+    revoke(comm, cause=cause)
+    cancelled = drained = 0
+    try:
+        bm = crcp.quiesce(comm, timeout=quiesce_timeout)
+        drained = bm.drained_waits
+    except crcp.QuiesceTimeout as exc:
+        bm = getattr(exc, "bookmark", None)
+        cancelled = bm.cancelled if bm is not None else 0
+    comm.free()
+    gcd = health.LEDGER.gc_scope(str(comm.cid), cause=cause)
+    _note(
+        f"detach cid={comm.cid} epoch={comm.epoch} cause={cause} "
+        f"drained={drained} cancelled={cancelled} ledger_gc={gcd}"
+    )
+    SPC.record("ft_detaches")
+    from ..trace import span as tspan
+
+    tspan.instant("ft.detach", cat="ft", cid=comm.cid,
+                  epoch=comm.epoch, cause=cause)
+    return {"drained": drained, "cancelled": cancelled,
+            "ledger_gc": gcd}
+
+
 # -- respawn / re-admission ---------------------------------------------
 
 def readmit(comm, *, canary: Optional[Callable[[], bool]] = None

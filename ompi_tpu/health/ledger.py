@@ -403,6 +403,13 @@ class Ledger:
             return [k for k, e in self._entries.items()
                     if e.state == SUSPECT]
 
+    def scopes(self) -> list[str]:
+        """Sorted distinct scopes with live entries — the bulkhead's
+        zero-orphaned-scopes audit: after a tenant eviction, no
+        ``tenant:*`` or session-cid scope it owned may remain."""
+        with self._mu:
+            return sorted({s for (s, _t) in self._entries})
+
     # -- recovery (ft/lifeboat) ------------------------------------------
 
     def gc_scope(self, scope: str, *, cause: str = "recover") -> int:
@@ -429,17 +436,26 @@ class Ledger:
                 )
         return len(keys)
 
-    def seed_scope(self, scope: str, *, cause: str = "recover") -> int:
+    def seed_scope(self, scope: str, *,
+                   src: str = GLOBAL_SCOPE,
+                   cause: str = "recover") -> int:
         """Seed a fresh comm scope (the shrunk communicator's cid)
-        from the global scope's non-HEALTHY entries, so a process-wide
-        quarantine observed before the shrink keeps denying the new
-        comm without waiting to re-learn it. Returns the number of
-        entries seeded."""
+        from ``src``'s non-HEALTHY entries — by default the global
+        scope, so a process-wide quarantine observed before a shrink
+        keeps denying the new comm without waiting to re-learn it.
+        The daemon's bulkhead passes ``src="tenant:<id>"`` both ways:
+        a tenant's namespace seeds its fresh session comms, and a
+        faulted session comm is absorbed back into the tenant
+        namespace before its scope is GC'd, so quarantines follow the
+        tenant across session churn instead of leaking to everyone or
+        dying with the comm. Returns the number of entries seeded."""
+        if scope == src:
+            return 0
         seeded = 0
         with self._mu:
             for (s, tier) in sorted(self._entries):
                 e = self._entries[(s, tier)]
-                if s != GLOBAL_SCOPE or e.state == HEALTHY:
+                if s != src or e.state == HEALTHY:
                     continue
                 ne = self._entry(scope, tier)
                 ne.failures = e.failures
@@ -548,8 +564,13 @@ def gc_scope(scope: str, *, cause: str = "recover") -> int:
     return LEDGER.gc_scope(scope, cause=cause)
 
 
-def seed_scope(scope: str, *, cause: str = "recover") -> int:
-    return LEDGER.seed_scope(scope, cause=cause)
+def seed_scope(scope: str, *, src: str = GLOBAL_SCOPE,
+               cause: str = "recover") -> int:
+    return LEDGER.seed_scope(scope, src=src, cause=cause)
+
+
+def scopes() -> list[str]:
+    return LEDGER.scopes()
 
 
 def reset() -> None:

@@ -15,8 +15,6 @@ from __future__ import annotations
 
 import os
 import threading
-import time
-from typing import Optional
 
 from ..core import config, dss
 from ..core.errors import ArgumentError, CommError, OmpiTpuError
@@ -69,10 +67,15 @@ def lookup_name(service: str, timeout: float = 0.0):
             rec = _published.get(service)
         if rec is None:
             d = _ns_dir.value
-            p = os.path.join(d, service) if d else None
-            if p and os.path.exists(p):
-                with open(p, "rb") as f:
-                    rec = f.read()
+            if d:
+                # open directly instead of exists()+open(): an
+                # unpublish between the two would turn a routine
+                # not-yet-published poll into a spurious abort
+                try:
+                    with open(os.path.join(d, service), "rb") as f:
+                        rec = f.read()
+                except FileNotFoundError:
+                    rec = None
         if rec is not None:
             return dss.unpack_one(rec)
         if not bo.sleep():
@@ -80,14 +83,23 @@ def lookup_name(service: str, timeout: float = 0.0):
 
 
 def unpublish_name(service: str) -> None:
+    from ..core.logging import warn_once
+
     with _ns_lock:
         _published.pop(service, None)
     d = _ns_dir.value
     if d:
         try:
             os.unlink(os.path.join(d, service))
-        except OSError:
-            pass
+        except FileNotFoundError:
+            pass  # never spilled, or a concurrent unpublish won
+        except OSError as exc:
+            # the record is now stale on disk: a later lookup can
+            # still rendezvous with a dead service — say so instead
+            # of silently leaking it
+            warn_once("dpm",
+                      "unpublish %r left a stale record (%s)",
+                      service, exc)
 
 
 def _tile(value, n: int):

@@ -175,6 +175,59 @@ def _control_plane_lines(registry: counters.CounterRegistry,
         for scope, v in sorted(minutes.items()):
             lines.append(
                 f'{name}{{scope="{sanitize_name(scope)}"}} {_fmt(v)}')
+    lines.extend(_daemon_tenant_lines(namespace))
+    return lines
+
+
+#: (meter key, metric suffix, type, help) for the per-tenant daemon
+#: series. Every active AND evicted tenant gets every series — a
+#: tenant that was just evicted must not vanish from /metrics with
+#: its reject history.
+_DAEMON_TENANT_SERIES = (
+    ("sessions", "daemon_tenant_sessions", "gauge",
+     "attached sessions per tenant"),
+    ("bytes", "daemon_tenant_bytes_total", "counter",
+     "admitted payload bytes per tenant"),
+    ("admitted", "daemon_tenant_admitted_total", "counter",
+     "admitted requests per tenant"),
+    ("rejected", "daemon_tenant_admission_rejects_total", "counter",
+     "admission rejects per tenant (each carried a retry-after)"),
+    ("dispatched", "daemon_tenant_dispatched_total", "counter",
+     "completed dispatches per tenant"),
+    ("evictions", "daemon_tenant_evictions_total", "counter",
+     "tenant-level evictions"),
+    ("slo_violation_minutes", "daemon_tenant_slo_violation_minutes",
+     "gauge", "minutes of dispatch latency spent over the tenant's "
+     "QoS-class p50 target"),
+)
+
+
+def _daemon_tenant_lines(namespace: str) -> list[str]:
+    """Per-tenant labelled series from the live daemon's meter (absent
+    entirely when no daemon runs in this process)."""
+    try:
+        from .. import daemon as daemon_mod
+
+        d = daemon_mod.current()
+    except ImportError:
+        return []
+    if d is None:
+        return []
+    metering = d.metering()
+    if not metering:
+        return []
+    lines: list[str] = []
+    for key, metric, kind, help_text in _DAEMON_TENANT_SERIES:
+        name = f"{namespace}_{metric}"
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for tenant, meter in sorted(metering.items()):
+            qos = meter.get("qos", "")
+            lines.append(
+                f'{name}{{tenant="{sanitize_name(tenant)}"'
+                f',qos="{sanitize_name(qos)}"}} '
+                f"{_fmt(meter.get(key, 0))}"
+            )
     return lines
 
 

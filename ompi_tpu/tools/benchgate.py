@@ -50,7 +50,7 @@ _HIGHER = ("gbps", "busbw", "gb_s", "hit_rate", "speedup", "ratio_x",
 #: Fragments that mark a lower-is-better series. ``overhead_pct``
 #: rides the _pct absolute-slack path in _is_regression.
 _LOWER = ("p50", "p99", "_us", "_ms", "rtt", "latency", "detect_ms",
-          "overhead_pct", "tune_ms", "restore_ms")
+          "overhead_pct", "tune_ms", "restore_ms", "degradation_pct")
 
 DEFAULT_ALLOWANCE = 0.25
 

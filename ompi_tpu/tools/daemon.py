@@ -1,0 +1,180 @@
+"""bulkhead daemon CLI: status, sessions, evict, drain.
+
+Operational surface for ompi_tpu/daemon. The daemon is a long-lived
+in-process service; the CLI talks to it through its state file — the
+daemon (with ``daemon_base_state_path`` set) atomically rewrites a
+JSON status snapshot every pump and consumes commands appended to
+``<state_path>.cmd``:
+
+    # what is the daemon doing right now?
+    python -m ompi_tpu.tools.daemon status --state /run/bulkhead.json
+
+    # per-session queue depths and states
+    python -m ompi_tpu.tools.daemon sessions --state /run/bulkhead.json
+
+    # deterministically evict a tenant (revoke -> quiesce -> detach
+    # every session, GC its ledger namespace)
+    python -m ompi_tpu.tools.daemon evict --state /run/bulkhead.json \\
+        --tenant acme
+
+    # ask the daemon to drain all queues
+    python -m ompi_tpu.tools.daemon drain --state /run/bulkhead.json
+
+``evict``/``drain`` append a command line and return immediately; the
+daemon executes it on its next pump and the following ``status`` shows
+the effect. When this process itself hosts the daemon (tests, single-
+controller deployments), the same subcommands act on it directly via
+``ompi_tpu.daemon.current()``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _load_status(state_path: str):
+    """The daemon's snapshot, preferring the live in-process instance
+    over the (possibly one-pump-stale) state file."""
+    from .. import daemon as daemon_mod
+
+    d = daemon_mod.current()
+    if d is not None:
+        return d.status(), d
+    try:
+        with open(state_path, "r", encoding="utf-8") as fh:
+            return json.load(fh), None
+    except FileNotFoundError:
+        print(f"no daemon state at {state_path!r} (is the daemon "
+              f"running with daemon_base_state_path set?)",
+              file=sys.stderr)
+        return None, None
+    except ValueError as exc:
+        print(f"daemon state {state_path!r} unreadable: {exc}",
+              file=sys.stderr)
+        return None, None
+
+
+def _append_cmd(state_path: str, cmd: dict) -> None:
+    path = state_path + ".cmd"
+    with open(path, "a", encoding="utf-8") as fh:
+        fh.write(json.dumps(cmd, sort_keys=True) + "\n")
+
+
+def _cmd_status(args) -> int:
+    st, _d = _load_status(args.state)
+    if st is None:
+        return 1
+    if args.json:
+        print(json.dumps(st, indent=2, sort_keys=True))
+        return 0
+    print(f"daemon {st['name']} (protocol v{st['version']}, "
+          f"lane={st['lane']}, seed={st['seed']}, "
+          f"slot={st['slot']})")
+    print(f"decision-log digest {st['digest']}")
+    tenants = st.get("tenants", {})
+    if not tenants:
+        print("no tenants")
+        return 0
+    for name in sorted(tenants):
+        m = tenants[name]
+        print(f"  {name:<16} class={m.get('qos', '?'):<10} "
+              f"sessions={m.get('sessions', 0)} "
+              f"admitted={m.get('admitted', 0)} "
+              f"rejected={m.get('rejected', 0)} "
+              f"bytes={m.get('bytes', 0)} "
+              f"slo_viol_min={m.get('slo_violation_minutes', 0)}")
+    return 0
+
+
+def _cmd_sessions(args) -> int:
+    st, _d = _load_status(args.state)
+    if st is None:
+        return 1
+    sessions = st.get("sessions", [])
+    if args.json:
+        print(json.dumps(sessions, indent=2, sort_keys=True))
+        return 0
+    if not sessions:
+        print("no attached sessions")
+        return 0
+    for s in sessions:
+        print(f"  sid={s['sid']:<4} tenant={s['tenant']:<16} "
+              f"class={s['qos']:<10} cid={s['cid']} "
+              f"epoch={s['epoch']} state={s['state']} "
+              f"queued={s['queued']} bytes={s['queued_bytes']}")
+    return 0
+
+
+def _cmd_evict(args) -> int:
+    from .. import daemon as daemon_mod
+
+    d = daemon_mod.current()
+    if d is not None:
+        rep = d.evict(args.tenant, cause="cli")
+        print(f"evicted {args.tenant}: answered={rep['answered']} "
+              f"released={rep['released']}")
+        return 0
+    _append_cmd(args.state, {"cmd": "evict", "tenant": args.tenant})
+    print(f"eviction of {args.tenant!r} queued at "
+          f"{args.state + '.cmd'} (applied on the daemon's next pump)")
+    return 0
+
+
+def _cmd_drain(args) -> int:
+    from .. import daemon as daemon_mod
+
+    d = daemon_mod.current()
+    if d is not None:
+        served = d.drain(timeout=args.timeout)
+        print(f"drained: {served} request(s) served")
+        return 0
+    _append_cmd(args.state, {"cmd": "drain"})
+    print(f"drain queued at {args.state + '.cmd'} (applied on the "
+          f"daemon's next pump)")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ompi_tpu.tools.daemon")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    def _with_state(p):
+        p.add_argument(
+            "--state",
+            default=os.environ.get("OMPI_TPU_DAEMON_STATE",
+                                   "bulkhead.json"),
+            help="daemon state file (the daemon's "
+                 "daemon_base_state_path; default "
+                 "$OMPI_TPU_DAEMON_STATE or ./bulkhead.json)")
+        return p
+
+    st = _with_state(sub.add_parser(
+        "status", help="daemon + per-tenant summary"))
+    st.add_argument("--json", action="store_true")
+    st.set_defaults(fn=_cmd_status)
+
+    se = _with_state(sub.add_parser(
+        "sessions", help="per-session queue state"))
+    se.add_argument("--json", action="store_true")
+    se.set_defaults(fn=_cmd_sessions)
+
+    ev = _with_state(sub.add_parser(
+        "evict", help="evict a tenant (revoke -> quiesce -> detach, "
+                      "GC scopes)"))
+    ev.add_argument("--tenant", required=True)
+    ev.set_defaults(fn=_cmd_evict)
+
+    dr = _with_state(sub.add_parser(
+        "drain", help="serve every queued request"))
+    dr.add_argument("--timeout", type=float, default=30.0)
+    dr.set_defaults(fn=_cmd_drain)
+
+    args = ap.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

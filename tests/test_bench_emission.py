@@ -201,6 +201,8 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -267,6 +269,8 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._telemetry_overhead_row = lambda: {"stub": True}
         bench._straggler_detect_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -335,6 +339,8 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._telemetry_overhead_row = lambda: {"stub": True}
         bench._straggler_detect_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -400,6 +406,8 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -474,6 +482,8 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
         bench._straggler_detect_row = lambda: {"stub": True}
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -492,4 +502,90 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
     # every ratcheted key auto-maps to lower-is-better in benchgate
     from ompi_tpu.tools import benchgate
     for key in ("recovery_p50_ms", "detect_ms", "shrink_ms"):
+        assert benchgate.direction(key) == "lower"
+
+
+def test_daemon_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR13 satellite 6: the tenant_isolation and
+    admission_eviction rows run end-to-end (real daemon subprocess
+    workers, shrunk via env) inside the probe-failed host-only path and
+    emit schema-complete JSON — the isolation row carrying the
+    guaranteed-p50-under-scavenger-flood degradation verdict, the
+    admission row carrying the reject -> retry-after -> admit cycle and
+    evict-to-detach timings."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the workers so the schema check stays fast
+        os.environ["OMPI_TPU_BENCH_TENANT_ITERS"] = "10"
+        os.environ["OMPI_TPU_BENCH_ADMIT_TRIALS"] = "4"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench._telemetry_overhead_row = lambda: {"stub": True}
+        bench._watchtower_overhead_row = lambda: {"stub": True}
+        bench._straggler_detect_row = lambda: {"stub": True}
+        bench._sched_autotune_row = lambda: {"stub": True}
+        bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    iso = rows["tenant_isolation"]
+    assert "error" not in iso, iso
+    for key in ("iters", "baseline_p50_us", "flood_p50_us",
+                "degradation_pct", "scavenger_rejects",
+                "scavenger_served", "pass"):
+        assert key in iso, key
+    assert iso["baseline_p50_us"] > 0 and iso["flood_p50_us"] > 0
+    # the ISSUE bound is <=10% guaranteed-class degradation; the
+    # recorded bench run ratchets that via "pass" — assert the same
+    # bound here (the drill is dispatcher-weight math, not wall-clock
+    # noise: guaranteed weight 8 vs scavenger weight 1)
+    assert iso["degradation_pct"] <= 10.0, iso
+    # the flood must actually have pressured admission, not vanished
+    assert iso["scavenger_rejects"] > 0
+    assert iso["scavenger_served"] > 0
+    assert iso["pass"] is True
+
+    adm = rows["admission_eviction"]
+    assert "error" not in adm, adm
+    for key in ("trials", "admit_p50_us", "retry_after_p50_ms",
+                "reject_to_admit_p50_ms", "evict_to_detach_ms",
+                "evict_answered", "rejects_counted", "pass"):
+        assert key in adm, key
+    assert adm["admit_p50_us"] > 0
+    assert adm["retry_after_p50_ms"] > 0
+    assert adm["reject_to_admit_p50_ms"] > 0
+    assert adm["evict_to_detach_ms"] > 0
+    # every queued request on the evicted tenant got an EVICTED answer
+    assert adm["evict_answered"] == 16
+    assert adm["rejects_counted"] >= adm["trials"]
+    assert adm["pass"] is True
+
+    # the ratchet directions resolve automatically from the key names
+    from ompi_tpu.tools import benchgate
+    for key in ("degradation_pct", "flood_p50_us",
+                "reject_to_admit_p50_ms", "evict_to_detach_ms"):
         assert benchgate.direction(key) == "lower"

@@ -1,0 +1,837 @@
+"""bulkhead — the multi-tenant comm daemon: versioned wire protocol,
+QoS-classed admission with seeded retry-after, deadline-aware weighted
+dispatch, per-tenant ledger namespaces (fault isolation under
+adversarial tenants), the deterministic evict pipeline, ingest lanes,
+the operator CLI, per-tenant telescope series, and the tenantscope
+lint rule."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+
+import numpy as np
+import pytest
+
+import ompi_tpu as mt
+from ompi_tpu import daemon as daemon_mod
+from ompi_tpu import health
+from ompi_tpu.analysis.lint import Linter
+from ompi_tpu.analysis.report import Severity
+from ompi_tpu.coll import breaker  # noqa: F401 - registers breaker cvars
+from ompi_tpu.coll.sched import slo
+from ompi_tpu.core import config
+from ompi_tpu.daemon import ingest, protocol
+from ompi_tpu.daemon.qos import (ADMITTED, SCAVENGER, Admission, QosError,
+                                 R_BYTES, R_QUEUE, R_RATE, qos_class,
+                                 tenant_seed)
+from ompi_tpu.ft import inject, lifeboat
+from ompi_tpu.health import ledger as hledger
+from ompi_tpu.runtime import dpm
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init():
+    if not mt.initialized():
+        mt.init()
+    yield
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    yield
+    daemon_mod.stop()
+    inject.disarm()
+    lifeboat.reset()
+    health.reset_for_testing()
+    slo.reset_for_testing()
+    w = mt.world()
+    w._revoked = False
+    w.epoch = 0
+
+
+@pytest.fixture
+def d():
+    dm = daemon_mod.start(seed=0, lane="local", name="t")
+    yield dm
+    daemon_mod.stop()
+
+
+def _attach(d, tenant, qos="burst", ranks=None):
+    body = {"qos": qos}
+    if ranks:
+        body["ranks"] = ranks
+    r = d.handle(protocol.Message(protocol.ATTACH, tenant=tenant,
+                                  body=body))
+    assert r.kind == protocol.ATTACHED, r
+    return r
+
+
+def _submit(d, tenant, sid, op="nop", payload=None, **params):
+    body = {"op": op}
+    if payload is not None:
+        body["payload"] = payload
+    if params:
+        body["params"] = params
+    return d.handle(protocol.Message(protocol.SUBMIT, tenant=tenant,
+                                     session=sid, body=body))
+
+
+# -- wire protocol -----------------------------------------------------------
+
+def test_protocol_roundtrip_preserves_payload():
+    msg = protocol.Message(
+        protocol.SUBMIT, tenant="acme", session=3, epoch=2, seq=9,
+        body={"op": "allreduce",
+              "payload": np.arange(12, dtype=np.float32)},
+    )
+    out = protocol.decode(protocol.encode(msg))
+    assert (out.kind, out.tenant, out.session, out.epoch, out.seq) == \
+        ("submit", "acme", 3, 2, 9)
+    assert out.body["op"] == "allreduce"
+    np.testing.assert_array_equal(np.asarray(out.body["payload"]),
+                                  np.asarray(msg.body["payload"]))
+
+
+def test_protocol_rejects_bad_magic_and_truncation():
+    with pytest.raises(protocol.ProtocolError, match="magic"):
+        protocol.decode(b"NOPE\x01xx")
+    with pytest.raises(protocol.ProtocolError):
+        protocol.decode(b"OT")
+    # magic right, payload garbage: still a ProtocolError, not a crash
+    with pytest.raises(protocol.ProtocolError, match="undecodable"):
+        protocol.decode(protocol.MAGIC + b"\x01" + b"\xff\xff")
+
+
+def test_protocol_version_skew_rejected_before_any_state():
+    frame = bytearray(protocol.encode(
+        protocol.Message(protocol.HELLO, tenant="x")))
+    frame[len(protocol.MAGIC)] = protocol.PROTOCOL_VERSION + 1
+    with pytest.raises(protocol.ProtocolError, match="version"):
+        protocol.decode(bytes(frame))
+
+
+def test_protocol_unknown_kind_refused_at_construction():
+    with pytest.raises(protocol.ProtocolError, match="kind"):
+        protocol.Message("bogus")
+
+
+def test_stamp_rides_lifeboat_epoch_tag_namespace():
+    t = protocol.stamp(5, 3, 17)
+    assert t >> 20 == 6            # (cid+1) above bit 20
+    assert (t >> 12) & 0xFF == 3   # epoch field
+    assert t & 0xFFF == 17         # sequence
+    # seq=0 stamps are exactly lifeboat's epoch_tag for that comm
+    comm = mt.world()
+    assert protocol.stamp(comm.cid, comm.epoch, 0) == \
+        lifeboat.epoch_tag(comm)
+    # epoch wraps mod 256, seq masked to 12 bits — never bleeding
+    # into the cid field
+    assert protocol.stamp(0, 256, 0) == protocol.stamp(0, 0, 0)
+    assert protocol.stamp(0, 0, 1 << 12) == protocol.stamp(0, 0, 0)
+    assert protocol.stamp(1, 0, 0) != protocol.stamp(0, 0, 0)
+
+
+# -- qos / admission ---------------------------------------------------------
+
+def test_qos_classes_and_lookup():
+    g, b, s = (qos_class(n) for n in
+               ("guaranteed", "burst", "scavenger"))
+    assert g.weight > b.weight > s.weight
+    assert g.queue_depth > b.queue_depth > s.queue_depth
+    assert g.slo_p50_us > 0 and s.slo_p50_us == 0
+    with pytest.raises(QosError, match="platinum"):
+        qos_class("platinum")
+
+
+def test_tenant_seed_stable_and_distinct():
+    assert tenant_seed(0, "acme") == tenant_seed(0, "acme")
+    assert tenant_seed(0, "acme") != tenant_seed(0, "beta")
+    assert tenant_seed(0, "acme") != tenant_seed(1, "acme")
+
+
+def test_admission_reject_reasons_cover_queue_bytes_rate():
+    adm = Admission(SCAVENGER, seed=3)
+    v, r = adm.try_admit(queued=SCAVENGER.queue_depth,
+                         queued_bytes=0, nbytes=0)
+    assert v == R_QUEUE and r > 0
+    v, r = adm.try_admit(queued=0, queued_bytes=SCAVENGER.byte_budget,
+                         nbytes=1)
+    assert v == R_BYTES and r > 0
+    adm2 = Admission(SCAVENGER, seed=3)
+    for _ in range(SCAVENGER.admit_tokens):
+        v, r = adm2.try_admit(queued=0, queued_bytes=0, nbytes=0)
+        assert v == ADMITTED and r == 0.0
+    v, r = adm2.try_admit(queued=0, queued_bytes=0, nbytes=0)
+    assert v == R_RATE and r > 0
+    # refill restores tokens up to capacity, one round at a time
+    adm2.refill()
+    assert adm2.tokens == SCAVENGER.refill
+    for _ in range(40):
+        adm2.refill()
+    assert adm2.tokens == SCAVENGER.admit_tokens
+
+
+def test_admission_retry_after_is_seeded_escalating_resetting():
+    def reject_seq(seed, n=6):
+        adm = Admission(SCAVENGER, seed=seed)
+        adm.tokens = 0.0
+        return adm, [
+            adm.try_admit(queued=0, queued_bytes=0, nbytes=0)[1]
+            for _ in range(n)
+        ]
+
+    adm, seq1 = reject_seq(5)
+    _, seq2 = reject_seq(5)
+    assert seq1 == seq2           # same seed: byte-identical schedule
+    _, seq3 = reject_seq(6)
+    assert seq1 != seq3           # seed actually matters
+    assert all(r > 0 for r in seq1)
+    # consecutive rejects escalate past the initial-delay band (1 ms)
+    assert seq1[-1] > 1.0 >= min(seq1[:2]) or seq1[-1] > seq1[0]
+    assert max(seq1) > 2.0
+    # an admit resets the schedule back to the initial band
+    adm.refill()
+    v, _ = adm.try_admit(queued=0, queued_bytes=0, nbytes=0)
+    assert v == ADMITTED
+    adm.tokens = 0.0
+    _, r = adm.try_admit(queued=0, queued_bytes=0, nbytes=0)
+    assert r <= 1.0
+
+
+# -- daemon service ----------------------------------------------------------
+
+def test_hello_reports_version_classes_lane(d):
+    r = d.handle(protocol.Message(protocol.HELLO, tenant="x"))
+    assert r.kind == protocol.WELCOME
+    assert r.body["version"] == protocol.PROTOCOL_VERSION
+    assert r.body["classes"] == ["burst", "guaranteed", "scavenger"]
+    assert r.body["lane"] == "local"
+    assert r.body["name"] == "t"
+
+
+def test_attach_submit_pump_fetch_roundtrip(d):
+    a = _attach(d, "acme", qos="guaranteed")
+    assert a.body["qos"] == "guaranteed" and a.body["size"] == 8
+    x = np.arange(8 * 16, dtype=np.float32).reshape(8, 16)
+    r = _submit(d, "acme", a.session, op="allreduce", payload=x)
+    assert r.kind == protocol.ADMIT
+    assert r.body["tag"] == protocol.stamp(a.body["cid"], a.epoch,
+                                           r.seq)
+    d.drain()
+    rep = d.fetch(a.session, r.seq)
+    assert rep.kind == protocol.RESULT and rep.body["ok"]
+    np.testing.assert_allclose(
+        np.asarray(rep.body["payload"]),
+        np.broadcast_to(x.sum(0), (8, 16)), rtol=1e-5)
+    # fetch pops: replies are delivered exactly once
+    assert d.fetch(a.session, r.seq) is None
+    m = d.metering()["acme"]
+    assert m["admitted"] == 1 and m["dispatched"] == 1
+    assert m["bytes"] == x.nbytes
+
+
+def test_protocol_faults_are_answered_never_raised(d):
+    r = d.handle(protocol.Message(protocol.SUBMIT, tenant="x",
+                                  session=99, body={"op": "nop"}))
+    assert r.kind == protocol.ERROR
+    assert "unknown session" in r.body["detail"]
+    r = d.handle(protocol.Message(protocol.ATTACH, tenant="x",
+                                  body={"qos": "platinum"}))
+    assert r.kind == protocol.ERROR and "platinum" in r.body["detail"]
+    r = d.handle(protocol.Message(protocol.ATTACH, tenant="",
+                                  body={}))
+    assert r.kind == protocol.ERROR
+    # an unknown op passes admission but is answered RESULT(ok=False)
+    # at dispatch — absorbed, not propagated into the pump
+    a = _attach(d, "x")
+    r = _submit(d, "x", a.session, op="frobnicate")
+    assert r.kind == protocol.ADMIT
+    d.pump()
+    rep = d.fetch(a.session, r.seq)
+    assert rep.kind == protocol.RESULT and rep.body["ok"] is False
+    assert "frobnicate" in rep.body["detail"]
+    assert d.metering()["x"]["errors"] == 1
+
+
+def test_attach_beyond_max_sessions_rejected_with_retry(d):
+    old = config.get("daemon_base_max_sessions")
+    config.set("daemon_base_max_sessions", 1)
+    try:
+        _attach(d, "a")
+        r = d.handle(protocol.Message(protocol.ATTACH, tenant="b",
+                                      body={"qos": "burst"}))
+        assert r.kind == protocol.REJECT
+        assert r.body["reason"] == "max_sessions"
+        assert r.body["retry_after_ms"] > 0
+        assert d.metering()["b"]["rejected"] == 1
+    finally:
+        config.set("daemon_base_max_sessions", old)
+
+
+def test_weighted_dispatch_serves_class_quanta(d):
+    g = _attach(d, "gold", qos="guaranteed")
+    s = _attach(d, "scrap", qos="scavenger")
+    for _ in range(12):
+        assert _submit(d, "gold", g.session).kind == protocol.ADMIT
+    for _ in range(8):
+        assert _submit(d, "scrap", s.session).kind == protocol.ADMIT
+    served = d.dispatcher.pump_round()
+    m = d.metering()
+    # one round: guaranteed gets its full weight-8 quantum, the
+    # scavenger exactly one residual slot — the bound behind the
+    # tenant_isolation bench's <=10% degradation row
+    assert m["gold"]["dispatched"] == 8
+    assert m["scrap"]["dispatched"] == 1
+    assert served == 9
+
+
+def test_edf_order_within_class_follows_logical_arrival(d):
+    a = _attach(d, "amber", qos="burst")
+    b = _attach(d, "blue", qos="burst")
+    # blue's request arrives first -> earlier deadline slot -> first
+    _submit(d, "blue", b.session)
+    _submit(d, "amber", a.session)
+    d.dispatcher.pump_round()
+    order = [ln for ln in d.log.lines() if " dispatch " in ln]
+    assert "tenant=blue" in order[0]
+    assert "tenant=amber" in order[1]
+
+
+def test_flood_amplifies_through_admission_bounded(d):
+    s = _attach(d, "scav", qos="scavenger")
+    inject.arm("flood@daemon:key=scav,rate=40,count=1", seed=3)
+    r = _submit(d, "scav", s.session)
+    inject.disarm()
+    m = d.metering()["scav"]
+    assert m["flood_synthetic"] == 40
+    # the token bucket (8) bounds what the flood could park in the
+    # queue; the other 32 were rejected and counted, never dropped
+    assert len(d.sessions[s.session].queue) == SCAVENGER.admit_tokens
+    assert m["rejected"] >= 40 - SCAVENGER.admit_tokens
+    # the organic submit rode the same (now exhausted) admission path
+    assert r.kind == protocol.REJECT and r.body["reason"] == R_RATE
+    assert any(" flood tenant=scav " in ln for ln in d.log.lines())
+
+
+def test_hog_charges_byte_budget_until_eviction_releases(d):
+    s = _attach(d, "pig", qos="scavenger")   # 1 MiB byte budget
+    inject.arm("hog@daemon:key=pig,bytes=2097152,count=1", seed=3)
+    r0 = _submit(d, "pig", s.session)
+    inject.disarm()
+    # the hog charge landed before admission: byte-bound from now on
+    assert r0.kind == protocol.REJECT and r0.body["reason"] == R_BYTES
+    r1 = _submit(d, "pig", s.session)
+    assert r1.kind == protocol.REJECT and r1.body["reason"] == R_BYTES
+    assert r1.body["retry_after_ms"] > 0
+    m = d.metering()["pig"]
+    assert m["hog_bytes"] == 2097152
+    assert m["queued_bytes"] >= 2097152
+    d.evict("pig", cause="hog-drill")
+    # eviction released the charge: the tenant starts clean
+    s2 = _attach(d, "pig", qos="scavenger")
+    assert _submit(d, "pig", s2.session).kind == protocol.ADMIT
+
+
+def test_eviction_answers_queued_work_and_gcs_scopes(d):
+    a = _attach(d, "acme", qos="burst")
+    seqs = [_submit(d, "acme", a.session).seq for _ in range(5)]
+    sess = d.sessions[a.session]
+    rep = d.evict("acme", cause="drill")
+    assert rep["answered"] == 5
+    for q in seqs:
+        r = sess.completed[q]
+        assert r.kind == protocol.EVICTED
+        assert r.body["cause"] == "drill"
+    assert sess.state == "evicted"
+    # zero orphaned scopes: neither the comm scope nor tenant:acme
+    assert health.LEDGER.scopes() == []
+    # the tenant's meter survives into history (and metering())
+    assert "acme" not in d.tenants
+    m = d.metering()["acme"]
+    assert m["evictions"] == 1 and m["qos"] == "burst"
+    assert any(" evicted tenant=acme cause=drill " in ln or
+               "evicted tenant=acme cause=drill" in ln
+               for ln in d.log.lines())
+
+
+def test_detach_drains_queued_work_first(d):
+    a = _attach(d, "acme")
+    x = np.ones((8, 8), np.float32)
+    r = _submit(d, "acme", a.session, op="allreduce", payload=x)
+    sess = d.sessions[a.session]
+    rep = d.handle(protocol.Message(protocol.DETACH, tenant="acme",
+                                    session=a.session))
+    assert rep.kind == protocol.DETACHED
+    assert rep.body["completed"] >= 1
+    done = sess.completed[r.seq]
+    assert done.kind == protocol.RESULT and done.body["ok"]
+    assert sess.state == "detached"
+    assert a.session not in d.sessions
+    # the tenant (admission state, meter, namespace) outlives its
+    # sessions — only tenant-level eviction clears it
+    assert "acme" in d.tenants
+
+
+def test_attach_sets_slo_target_detach_clears_it(d):
+    a = _attach(d, "gold", qos="guaranteed")
+    scope = str(a.body["cid"])
+    assert slo.targets().get(scope) == 50_000.0
+    d.handle(protocol.Message(protocol.DETACH, tenant="gold",
+                              session=a.session))
+    assert scope not in slo.targets()
+
+
+def test_submit_on_revoked_session_is_directed_to_recovery(d):
+    a = _attach(d, "acme", qos="burst", ranks=[0, 1, 2, 3])
+    sess = d.sessions[a.session]
+    r = _submit(d, "acme", a.session, op="allreduce",
+                payload=np.ones((4, 8), np.float32))
+    sess.comm._revoked = True
+    d.pump()
+    rep = d.fetch(a.session, r.seq)
+    assert rep.kind == protocol.RESULT and rep.body["ok"] is False
+    assert "revoked" in rep.body["detail"]
+    assert sess.state == "revoked"
+    # new submits are refused with the recovery hint, not queued
+    r2 = _submit(d, "acme", a.session)
+    assert r2.kind == protocol.ERROR
+    assert "recover_tenant" in r2.body["detail"]
+    # recover: same sid, fresh comm/cid, session serviceable again
+    old_cid = sess.comm.cid
+    rep = d.recover_tenant("acme")
+    assert rep["recovered"] == 1
+    assert sess.state == "attached"
+    assert sess.comm.cid != old_cid
+    r3 = _submit(d, "acme", a.session, op="allreduce",
+                 payload=np.ones((sess.comm.size, 8), np.float32))
+    assert r3.kind == protocol.ADMIT
+    d.drain()
+    assert d.fetch(a.session, r3.seq).body["ok"]
+
+
+# -- bulkhead isolation drill ------------------------------------------------
+
+def test_wedge_quarantines_only_faulting_tenant_and_outlives_session(d):
+    """The tentpole invariant end to end, one process: tenant A wedges
+    its device tier; only A's comm scope is quarantined (B never sees
+    a denied tier and keeps its full service); the fault follows A
+    across sessions via the tenant:<id> namespace; tenant eviction
+    leaves zero orphaned scopes."""
+    saved = {k: config.get(k) for k in (
+        "health_sentinel_deadline_ms",
+        "health_ledger_suspect_threshold",
+        "coll_breaker_threshold",
+        "coll_tuned_allreduce_algorithm")}
+    config.set("coll_tuned_allreduce_algorithm", "ring")
+    # the breaker is per-(op, algo) GLOBAL state: keep it closed so
+    # the drill proves isolation comes from the scoped ledger alone
+    config.set("coll_breaker_threshold", 1000)
+    try:
+        a = _attach(d, "acme", qos="burst", ranks=[0, 1, 2, 3])
+        b = _attach(d, "beta", qos="burst", ranks=[4, 5, 6, 7])
+        cid_a, cid_b = a.body["cid"], b.body["cid"]
+        x = np.ones((4, 64), np.float32)
+        # warm BOTH ring plans before arming the sentinel: a cold
+        # compile legitimately exceeds the drill's 300 ms deadline and
+        # would quarantine an innocent tenant
+        for att in (a, b):
+            r = _submit(d, att.tenant, att.session, op="allreduce",
+                        payload=x)
+            d.drain()
+            assert d.fetch(att.session, r.seq).body["ok"]
+        config.set("health_sentinel_deadline_ms", 300.0)
+        config.set("health_ledger_suspect_threshold", 1)
+        inject.arm(f"wedge@coll:op=allreduce,algo=ring,count=1,"
+                   f"cid={cid_a}")
+        r = _submit(d, "acme", a.session, op="allreduce", payload=x)
+        d.drain()
+        rep = d.fetch(a.session, r.seq)
+        assert rep.body["ok"], rep  # sentinel fallback completed it
+        inject.disarm()
+        config.set("health_sentinel_deadline_ms",
+                   saved["health_sentinel_deadline_ms"])
+        # quarantine scoped to A's comm; B's scope untouched
+        assert hledger.state("device", str(cid_a)) == \
+            hledger.QUARANTINED
+        assert hledger.state("device", str(cid_b)) == hledger.HEALTHY
+        # both tenants keep completing; only A observes denied tiers
+        ra = _submit(d, "acme", a.session, op="allreduce", payload=x)
+        rb = _submit(d, "beta", b.session, op="allreduce", payload=x)
+        d.drain()
+        assert d.fetch(a.session, ra.seq).body["ok"]
+        assert d.fetch(b.session, rb.seq).body["ok"]
+        m = d.metering()
+        assert m["acme"]["denied_tier_observations"] > 0
+        assert m["beta"]["denied_tier_observations"] == 0
+        # session detach absorbs the fault into tenant:acme — the
+        # quarantine outlives the session, the comm scope is GC'd
+        d.handle(protocol.Message(protocol.DETACH, tenant="acme",
+                                  session=a.session))
+        scopes = health.LEDGER.scopes()
+        assert "tenant:acme" in scopes and str(cid_a) not in scopes
+        # session six: a fresh attach re-seeds the denial
+        a2 = _attach(d, "acme", qos="burst", ranks=[0, 1, 2, 3])
+        assert "device" in d.bulkhead.denied_tiers(
+            d.sessions[a2.session].comm)
+        assert d.bulkhead.denied_tiers(
+            d.sessions[b.session].comm) == []
+        # tenant-level eviction: zero orphaned scopes, B untouched
+        d.evict("acme", cause="drill")
+        leftover = [s for s in health.LEDGER.scopes()
+                    if s.startswith("tenant:acme")
+                    or s in (str(cid_a), str(cid_b))]
+        assert leftover in ([], [str(cid_b)])
+        rb2 = _submit(d, "beta", b.session, op="allreduce", payload=x)
+        d.drain()
+        assert d.fetch(b.session, rb2.seq).body["ok"]
+    finally:
+        inject.disarm()
+        for k, v in saved.items():
+            config.set(k, v)
+
+
+# -- cross-controller determinism --------------------------------------------
+
+_DIGEST_WORKER = textwrap.dedent(r"""
+    import json, os, sys
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu as mt
+    from ompi_tpu import daemon as daemon_mod
+    from ompi_tpu.daemon import protocol
+    from ompi_tpu.ft import inject, lifeboat
+
+    mt.init()
+    lifeboat.enable()
+    d = daemon_mod.start(seed=11, lane="local", name="drill")
+
+    def attach(tenant, qos, ranks=None):
+        body = {"qos": qos}
+        if ranks:
+            body["ranks"] = ranks
+        r = d.handle(protocol.Message(protocol.ATTACH, tenant=tenant,
+                                      body=body))
+        assert r.kind == protocol.ATTACHED, r
+        return r
+
+    def submit(tenant, sid, op="nop", payload=None):
+        body = {"op": op}
+        if payload is not None:
+            body["payload"] = payload
+        return d.handle(protocol.Message(
+            protocol.SUBMIT, tenant=tenant, session=sid, body=body))
+
+    a = attach("acme", "guaranteed", ranks=[0, 1, 2, 3])
+    b = attach("beta", "burst", ranks=[4, 5, 6, 7])
+    s = attach("scav", "scavenger")
+    x4 = np.ones((4, 32), np.float32)
+    for _ in range(3):
+        assert submit("acme", a.session, "allreduce", x4).kind == "admit"
+        assert submit("beta", b.session, "allreduce", x4).kind == "admit"
+        d.pump()
+    d.drain()
+    # adversarial tenant: seeded flood + hog through real admission
+    inject.arm("flood@daemon:key=scav,rate=40,count=1;"
+               "hog@daemon:key=scav,bytes=2097152,count=1", seed=11)
+    submit("scav", s.session)
+    submit("scav", s.session)
+    inject.disarm()
+    d.drain()
+    d.evict("scav", cause="drill")
+    # rank death INSIDE acme's comm: beta must never notice
+    inject.arm("rank_kill@coll:op=allreduce,after_step=1,peer=2")
+    r = submit("acme", a.session, "allreduce", x4)
+    d.pump()
+    inject.disarm()
+    rep = d.recover_tenant("acme")
+    assert rep["recovered"] == 1, rep
+    x3 = np.ones((3, 32), np.float32)
+    r2 = submit("acme", a.session, "allreduce", x3)
+    r3 = submit("beta", b.session, "allreduce", x4)
+    assert r2.kind == "admit" and r3.kind == "admit"
+    d.drain()
+    m = d.metering()
+    assert m["beta"]["denied_tier_observations"] == 0
+    assert m["beta"]["errors"] == 0
+    out = {"digest": d.digest(), "n_lines": len(d.log.lines()),
+           "beta_dispatched": m["beta"]["dispatched"],
+           "scav": {k: d.metering()["scav"][k]
+                    for k in ("flood_synthetic", "hog_bytes",
+                              "rejected")}}
+    d.stop()
+    print("DIGEST " + json.dumps(out, sort_keys=True), flush=True)
+    os._exit(0)
+""")
+
+
+def test_same_seed_decision_log_byte_identical_across_controllers():
+    """Two fresh controllers replay the same seeded workload —
+    organic traffic, a flood+hog adversary, an eviction, a rank kill
+    into one tenant's comm, recovery — and produce byte-identical
+    decision-log digests (the cid allocator is process-global, so
+    byte-identity is a cross-process contract, not an in-process one).
+    """
+    env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+    outs = []
+    for _ in range(2):
+        r = subprocess.run([sys.executable, "-c", _DIGEST_WORKER],
+                           capture_output=True, text=True,
+                           timeout=300, env=env, cwd=REPO)
+        assert r.returncode == 0, (r.stdout[-2000:], r.stderr[-2000:])
+        line = [ln for ln in r.stdout.splitlines()
+                if ln.startswith("DIGEST ")][-1]
+        outs.append(json.loads(line[len("DIGEST "):]))
+    assert outs[0] == outs[1]
+    assert outs[0]["digest"] == outs[1]["digest"]
+    assert len(outs[0]["digest"]) == 64
+    assert outs[0]["scav"]["flood_synthetic"] == 40
+    assert outs[0]["scav"]["hog_bytes"] == 2097152
+    assert outs[0]["scav"]["rejected"] > 0
+
+
+# -- ingest lanes ------------------------------------------------------------
+
+def test_local_lane_full_wire_roundtrip(d):
+    lane = d.lane
+    lane.submit(7, protocol.encode(
+        protocol.Message(protocol.HELLO, tenant="w")))
+    d.pump()
+    tag, frame = ingest.wait_reply(lane, timeout=5.0)
+    assert tag == 7
+    assert protocol.decode(frame).kind == protocol.WELCOME
+    # a garbage frame is answered with a protocol ERROR, not dropped
+    lane.submit(9, b"garbage-frame")
+    d.pump()
+    tag, frame = ingest.wait_reply(lane, timeout=5.0)
+    assert tag == 9
+    rep = protocol.decode(frame)
+    assert rep.kind == protocol.ERROR and "magic" in rep.body["detail"]
+
+
+def test_wait_reply_is_deadline_bounded():
+    lane = ingest.LocalLane()
+    with pytest.raises(ingest.IngestError, match="reply"):
+        ingest.wait_reply(lane, timeout=0.05)
+
+
+def test_connect_client_validates_record_and_version():
+    dpm.publish_name("bulkhead/skewed", {"prefix": "x", "version": 99})
+    try:
+        with pytest.raises(ingest.IngestError, match="protocol 99"):
+            ingest.connect_client("skewed", timeout=0.2)
+    finally:
+        dpm.unpublish_name("bulkhead/skewed")
+    dpm.publish_name("bulkhead/mangled", "not-a-dict")
+    try:
+        with pytest.raises(ingest.IngestError, match="name-service"):
+            ingest.connect_client("mangled", timeout=0.2)
+    finally:
+        dpm.unpublish_name("bulkhead/mangled")
+    # never published: the dpm lookup deadline surfaces
+    with pytest.raises(dpm.NameServiceError):
+        ingest.connect_client("ghost", timeout=0.05)
+
+
+def test_shm_lane_roundtrip_when_native_available():
+    if not ingest.shm_available():
+        pytest.skip("native engine unavailable")
+    dm = daemon_mod.start(seed=0, lane="shm", name="shmtest")
+    try:
+        assert dm.lane.kind == "shm"
+        lane = ingest.connect_client("shmtest", timeout=5.0)
+        lane.submit(3, protocol.encode(
+            protocol.Message(protocol.HELLO, tenant="c")))
+        dm.pump()
+        tag, frame = ingest.wait_reply(lane, timeout=5.0)
+        assert tag == 3
+        assert protocol.decode(frame).kind == protocol.WELCOME
+        lane.close()
+    finally:
+        daemon_mod.stop()
+    # stop() unpublished the rendezvous record
+    with pytest.raises(dpm.NameServiceError):
+        dpm.lookup_name("bulkhead/shmtest")
+
+
+# -- dpm satellites ----------------------------------------------------------
+
+def test_dpm_lookup_polls_under_backoff_and_unpublish_is_idempotent():
+    with pytest.raises(dpm.NameServiceError):
+        dpm.lookup_name("daemon-test/ghost", timeout=0.05)
+    dpm.unpublish_name("daemon-test/ghost")  # never published: no-op
+    dpm.publish_name("daemon-test/svc", {"prefix": "p", "version": 1})
+    try:
+        assert dpm.lookup_name("daemon-test/svc")["version"] == 1
+    finally:
+        dpm.unpublish_name("daemon-test/svc")
+    # a publish landing mid-poll is picked up before the deadline —
+    # the client-attach retry path (Backoff evidence, no bare spin)
+    t = threading.Timer(
+        0.05, lambda: dpm.publish_name("daemon-test/late", "ok"))
+    t.start()
+    try:
+        assert dpm.lookup_name("daemon-test/late", timeout=5.0) == "ok"
+    finally:
+        t.join()
+        dpm.unpublish_name("daemon-test/late")
+
+
+# -- operator surface: state file + CLI --------------------------------------
+
+def test_state_file_snapshot_and_control_channel(d, tmp_path):
+    state = str(tmp_path / "bulkhead.json")
+    old = config.get("daemon_base_state_path")
+    config.set("daemon_base_state_path", state)
+    try:
+        _attach(d, "acme")
+        d.pump()
+        with open(state, "r", encoding="utf-8") as fh:
+            st = json.load(fh)
+        assert st["name"] == "t"
+        assert st["tenants"]["acme"]["sessions"] == 1
+        assert st["digest"] == d.digest()
+        # operator commands are consumed on the next pump
+        with open(state + ".cmd", "a", encoding="utf-8") as fh:
+            fh.write(json.dumps({"cmd": "evict", "tenant": "acme"})
+                     + "\n")
+            fh.write("not json\n")   # malformed: logged, never fatal
+            fh.write(json.dumps({"cmd": "evict", "tenant": "ghost"})
+                     + "\n")
+        d.pump()
+        assert "acme" not in d.tenants
+        assert not os.path.exists(state + ".cmd")
+    finally:
+        config.set("daemon_base_state_path", old)
+
+
+def test_cli_acts_on_live_daemon(d, capsys):
+    from ompi_tpu.tools import daemon as cli
+
+    a = _attach(d, "acme", qos="guaranteed")
+    _submit(d, "acme", a.session)
+    assert cli.main(["status", "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["tenants"]["acme"]["sessions"] == 1
+    assert cli.main(["sessions"]) == 0
+    assert "tenant=acme" in capsys.readouterr().out
+    assert cli.main(["drain", "--timeout", "10"]) == 0
+    assert "served" in capsys.readouterr().out
+    assert cli.main(["evict", "--tenant", "acme"]) == 0
+    assert "evicted acme" in capsys.readouterr().out
+    assert "acme" not in d.tenants
+
+
+def test_cli_reads_state_file_and_queues_commands(tmp_path, capsys):
+    from ompi_tpu.tools import daemon as cli
+
+    state = str(tmp_path / "bk.json")
+    snap = {"name": "bk", "version": 1, "lane": "local", "seed": 0,
+            "slot": 3, "digest": "d" * 64, "tenants": {},
+            "sessions": []}
+    with open(state, "w", encoding="utf-8") as fh:
+        json.dump(snap, fh)
+    assert cli.main(["status", "--state", state]) == 0
+    assert "no tenants" in capsys.readouterr().out
+    assert cli.main(["sessions", "--state", state]) == 0
+    assert "no attached sessions" in capsys.readouterr().out
+    # no live daemon: evict/drain queue a command for the next pump
+    assert cli.main(["evict", "--state", state,
+                     "--tenant", "ghost"]) == 0
+    capsys.readouterr()
+    with open(state + ".cmd", "r", encoding="utf-8") as fh:
+        assert json.loads(fh.readline()) == {"cmd": "evict",
+                                             "tenant": "ghost"}
+    # missing state file: a pointed error, rc 1
+    assert cli.main(["status", "--state",
+                     str(tmp_path / "none.json")]) == 1
+    assert "no daemon state" in capsys.readouterr().err
+
+
+# -- telescope metering ------------------------------------------------------
+
+def test_tenant_metering_reaches_prometheus_series(d):
+    from ompi_tpu.telemetry import export
+
+    a = _attach(d, "acme", qos="guaranteed")
+    _submit(d, "acme", a.session)
+    d.drain()
+    text = export.prometheus_text()
+    assert ('daemon_tenant_sessions{tenant="acme",qos="guaranteed"} 1'
+            in text)
+    assert ('daemon_tenant_dispatched_total{tenant="acme"'
+            ',qos="guaranteed"} 1' in text)
+    assert "daemon_tenant_slo_violation_minutes" in text
+    assert "daemon_tenant_admission_rejects_total" in text
+    # evicted tenants keep reporting from history (final meter)
+    d.evict("acme", cause="drill")
+    text = export.prometheus_text()
+    assert ('daemon_tenant_evictions_total{tenant="acme"'
+            ',qos="guaranteed"} 1' in text)
+    # no live daemon -> the series vanish rather than zero-filling
+    daemon_mod.stop()
+    assert "daemon_tenant_sessions" not in export.prometheus_text()
+
+
+# -- commlint: tenantscope ---------------------------------------------------
+
+_UNSCOPED = (
+    "def sweep(led):\n"
+    "    led.gc_scope(\"everything\", cause=\"shutdown\")\n"
+)
+
+_SCOPED = (
+    "def seed(led, comm, t):\n"
+    "    led.seed_scope(str(comm.cid), src=tenant_scope(t),\n"
+    "                   cause=\"attach\")\n"
+)
+
+
+def test_tenantscope_rule_fires_only_under_daemon_paths():
+    lin = Linter()
+    bad = lin.lint_source(_UNSCOPED, relpath="ompi_tpu/daemon/x.py")
+    assert [f.rule for f in bad] == ["tenantscope"]
+    assert bad[0].severity is Severity.WARNING
+    assert "names no tenant scope" in bad[0].message
+    # the same code outside the daemon package is legitimate (global
+    # scope is the right default for watchtower/tuned)
+    assert lin.lint_source(_UNSCOPED,
+                           relpath="ompi_tpu/telemetry/x.py") == []
+    # scope evidence in the ARGUMENTS silences it — the callee name
+    # containing "scope" never does
+    assert lin.lint_source(_SCOPED,
+                           relpath="ompi_tpu/daemon/x.py") == []
+
+
+def test_tenantscope_suppression_and_registration():
+    src = (
+        "def shutdown(led):\n"
+        "    led.gc_scope(\"all\", cause=\"x\")"
+        "  # commlint: allow(tenantscope)\n"
+    )
+    lin = Linter()
+    assert lin.lint_source(src, relpath="ompi_tpu/daemon/x.py") == []
+    # registered as a commlint component like every other rule
+    from ompi_tpu.analysis.rules import COMMLINT, ensure_rules
+    ensure_rules()
+    assert "tenantscope" in COMMLINT.component_names()
+
+
+def test_daemon_package_is_tenantscope_clean():
+    """The daemon package itself must satisfy its own rule — every
+    scope-keyed call in daemon/ names the tenant scope it acts for."""
+    pkg = os.path.join(REPO, "ompi_tpu", "daemon")
+    lin = Linter(base=REPO)
+    rep = lin.lint_paths([
+        os.path.join(pkg, f) for f in sorted(os.listdir(pkg))
+        if f.endswith(".py")
+    ])
+    assert [f for f in rep if f.rule == "tenantscope"] == []
