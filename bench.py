@@ -116,6 +116,55 @@ def _probe_device(timeout_s: float = 180.0) -> bool:
     return False
 
 
+#: Tiers the preflight medic drill exercises (the device plane and the
+#: sched compiler's fused-kernel tier above it).
+_MEDIC_TIERS = ("device", "device_pallas")
+
+
+def _medic_probe_cycle(timeout_s: float = 180.0) -> bool:
+    """Preflight: the cheap tunnel probe, then a full medic re-probe
+    cycle over the device tiers — QUARANTINE both, drive the health
+    supervisor's tick schedule, watch the PROBATION walk, confirm the
+    canaries restore them to HEALTHY — so the sweep starts from a
+    proven-recoverable health plane instead of a one-shot probe.
+    Returns the tunnel probe's verdict; the drill outcome is recorded
+    in its own row (never silent) but a drill failure does not veto the
+    host-side rows."""
+    if not _probe_device(timeout_s):
+        return False
+    try:
+        from ompi_tpu.health import ledger as hl
+        from ompi_tpu.health import prober as hp
+
+        t0 = time.monotonic()
+        for tier in _MEDIC_TIERS:
+            hl.LEDGER.quarantine(tier, cause="bench_preflight_drill")
+        hp.ensure_builtin_probes()
+        sup = hp.Supervisor(seed=0)
+        walked: set = set()
+        while time.monotonic() - t0 < min(60.0, timeout_s):
+            sup.tick()
+            for tier in _MEDIC_TIERS:
+                if hl.state(tier) == hl.PROBATION:
+                    walked.add(tier)
+            if all(hl.state(t) == hl.HEALTHY for t in _MEDIC_TIERS):
+                break
+            time.sleep(0.05)
+        restored = [t for t in _MEDIC_TIERS
+                    if hl.state(t) == hl.HEALTHY]
+        _record("medic_probe_cycle", {
+            "tiers": list(_MEDIC_TIERS),
+            "restored": restored,
+            "probation_walk": sorted(walked),
+            "cycle_ms": round((time.monotonic() - t0) * 1e3, 1),
+            "full_restore": len(restored) == len(_MEDIC_TIERS),
+        })
+    except Exception as exc:  # the drill is evidence, not a gate
+        _record("medic_probe_cycle",
+                {"error": f"{type(exc).__name__}: {exc}"})
+    return True
+
+
 def _timed(fn, *args) -> float:
     # np.asarray (host readback) — block_until_ready does not reliably
     # block through the axon RPC tunnel.
@@ -1985,6 +2034,177 @@ def _sched_autotune_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_PALLAS_SCHED_WORKER = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu import ops
+from ompi_tpu.coll import pallas_ring
+from ompi_tpu.coll.framework import compile_plan
+from ompi_tpu.coll.sched import ir, lower
+
+world = ompi_tpu.init()
+assert world.size == 8
+on_tpu = jax.default_backend() == "tpu"
+executable = on_tpu or pallas_ring.interpret_available()
+out = {"backend": jax.default_backend(),
+       "pallas_executable": executable}
+
+# Bit-identity evidence across the three generators x f32/bf16: the
+# codegen oracle (table simulator off hardware, the real kernel under
+# interpret/TPU otherwise) vs the ring reference.
+checks = 0
+ok = True
+for base in (ir.ring(8), ir.segmented_ring(8, 2), ir.reduce_scatter(8)):
+    s = ir.with_lowering(base, "pallas")
+    for dtype in ("float32", "bfloat16"):
+        checks += 1
+        ok = ok and bool(lower.validate_schedule(world, s, "sum", dtype))
+out["bit_identity"] = {"checked": checks, "ok": ok}
+
+sizes = [int(s) for s in os.environ.get(
+    "OMPI_TPU_BENCH_PALLAS_SIZES", "").split(",") if s]
+if not sizes:
+    sizes = [1 << 10, 64 << 10, 4 << 20, 64 << 20, 512 << 20]
+    if not on_tpu:
+        # interpret-lowering wall clock through the 8-way CPU mesh is
+        # pure noise above a few MiB; dropped sizes are on the record
+        sizes = [s for s in sizes if s <= (4 << 20)]
+        out["sizes_dropped"] = "64 MiB+ dropped off-TPU"
+if not executable:
+    out["degraded"] = True
+    out["degraded_reason"] = (
+        "this jax has no Mosaic TPU interpret mode and no TPU is "
+        "attached: compiled/handwritten pallas timings unmeasurable; "
+        "interpret-lowering timings + simulator bit-identity only")
+
+variants = [("interpret", lower.lower(ir.ring(8)), True)]
+if executable:
+    variants.append(
+        ("compiled", lower.lower(ir.with_lowering(ir.ring(8), "pallas")),
+         False))
+    variants.append(("handwritten", pallas_ring.allreduce_block, False))
+
+sweep = []
+for nbytes in sizes:
+    elems = max(8, nbytes // 4)
+    data = np.ones((8, elems), np.float32)
+    x = world.put_rank_major(data)
+    iters = 15 if nbytes <= (64 << 10) else 5
+    row = {"bytes": elems * 4}
+    for label, fn, vma in variants:
+        try:
+            plan = compile_plan(
+                world, ("bench.pallas_sched", label, elems),
+                lambda b, fn=fn: fn(b, "ranks", ops.SUM), check_vma=vma)
+            jax.block_until_ready(plan(x))  # warm/compile
+            ts = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                jax.block_until_ready(plan(x))
+                ts.append(time.perf_counter() - t0)
+            p50 = float(np.median(ts))
+            row[label + "_gbps"] = round(nbytes / p50 / 1e9, 3)
+            row[label + "_p50_us"] = round(p50 * 1e6, 1)
+        except Exception as exc:
+            row[label + "_error"] = f"{type(exc).__name__}: {exc}"[:200]
+    sweep.append(row)
+out["sweep"] = sweep
+print("PALLASSCHED " + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def _pallas_sched_row() -> dict:
+    """The sched compiler's pallas backend vs its interpret lowering vs
+    the hand-written kernel, GB/s + p50 per message size, plus the
+    bit-identity evidence. Off TPU on a jax without Mosaic interpret
+    mode the compiled/handwritten columns are unmeasurable — the row
+    says so loudly (degraded=true) instead of dropping silently."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, "-c", _PALLAS_SCHED_WORKER],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=420,
+        )
+        if p.returncode != 0:
+            return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+        for line in p.stdout.splitlines():
+            if line.startswith("PALLASSCHED "):
+                return json.loads(line[len("PALLASSCHED "):])
+        return {"error": "no PALLASSCHED line"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _device_resurrection_row() -> dict:
+    """The medic drill as a measured row: QUARANTINE the device tiers,
+    drive the supervisor's re-probe schedule through the PROBATION
+    walk, time the restore, then time the first good device row after
+    it. restore_ms / first_good_row_ms ratchet lower-is-better; off
+    TPU the row is degraded=true (the supervisor/canary path is real,
+    the device op behind first_good_row runs on CPU) — excused by the
+    gate, never silent."""
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from ompi_tpu.health import ledger as hl
+        from ompi_tpu.health import prober as hp
+
+        t0 = time.monotonic()
+        for tier in _MEDIC_TIERS:
+            hl.LEDGER.quarantine(tier, cause="bench_resurrection_drill")
+        hp.ensure_builtin_probes()
+        sup = hp.Supervisor(seed=0)
+        walked: set = set()
+        while time.monotonic() - t0 < 60.0:
+            sup.tick()
+            for tier in _MEDIC_TIERS:
+                if hl.state(tier) == hl.PROBATION:
+                    walked.add(tier)
+            if all(hl.state(t) == hl.HEALTHY for t in _MEDIC_TIERS):
+                break
+            time.sleep(0.05)
+        restore_ms = (time.monotonic() - t0) * 1e3
+        restored = all(hl.state(t) == hl.HEALTHY for t in _MEDIC_TIERS)
+        t1 = time.monotonic()
+        val = float(np.asarray(jnp.sum(jnp.ones(1 << 16, jnp.float32))))
+        first_good_ms = (time.monotonic() - t1) * 1e3
+        row = {
+            "tiers": list(_MEDIC_TIERS),
+            "restored": restored,
+            "restore_ms": round(restore_ms, 1),
+            "first_good_row_ms": round(first_good_ms, 2),
+            "first_good_value_ok": val == float(1 << 16),
+            "probation_walk": sorted(walked),
+        }
+        if jax.default_backend() != "tpu":
+            row["degraded"] = True
+            row["degraded_reason"] = (
+                "no TPU behind the tunnel: the quarantine/supervisor/"
+                "canary path is the real one but first_good_row times a "
+                "CPU op")
+        if not restored:
+            row["error"] = ("tier(s) stayed quarantined after 60s of "
+                            "supervisor ticks: "
+                            + str({t: hl.state(t) for t in _MEDIC_TIERS}))
+        return row
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _SCHED_WARM_A = r"""
 import os, sys, json
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -2491,6 +2711,10 @@ def _host_rows() -> dict:
     rows["latency_histograms"] = _latency_hist_row()
     _set_phase("schedule autotune (measure-mode sweep, 8-rank mesh)")
     rows["sched_autotune"] = _sched_autotune_row()
+    _set_phase("sched pallas lowering (compiled vs interpret, 8-rank)")
+    rows["pallas_sched_allreduce"] = _pallas_sched_row()
+    _set_phase("device resurrection (quarantine -> probation -> restore)")
+    rows["device_resurrection"] = _device_resurrection_row()
     _set_phase("schedule cache warm start (2-process fleet warm)")
     rows["schedule_cache_warm_start"] = _sched_warm_start_row()
     _set_phase("elastic recovery (rank_kill -> revoke/agree/shrink)")
@@ -2518,6 +2742,45 @@ def _commlint_row() -> dict:
             "findings": len(rep),
             "errors": len(linter.errors),
             "runtime_ms": round(linter.elapsed_ms, 1),
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _multirank_chip_row(device) -> dict:
+    """Multi-ranks-per-chip staging mode: the N_RANKS rank blocks land
+    in one partitioned (n, elems) HBM staging buffer via a single
+    device_put, vs the old serialized path of n whole-buffer copies
+    each waited to completion before the next starts. The ratio is the
+    staging-bandwidth headroom a multi-tenant chip recovers."""
+    import jax
+
+    try:
+        elems = (8 << 20) // 4  # 8 MiB per rank block, 64 MiB total
+        data = np.ones((N_RANKS, elems), np.float32)
+
+        def t_partitioned() -> float:
+            t0 = time.perf_counter()
+            buf = jax.device_put(data, device)
+            np.asarray(buf[:, :1])  # host readback: tunnel-safe barrier
+            return time.perf_counter() - t0
+
+        def t_serialized() -> float:
+            t0 = time.perf_counter()
+            for r in range(N_RANKS):
+                b = jax.device_put(data[r], device)
+                np.asarray(b[:1])  # wait each copy before the next
+            return time.perf_counter() - t0
+
+        t_partitioned(), t_serialized()  # warm the transfer path
+        tp = min(t_partitioned() for _ in range(5))
+        ts = min(t_serialized() for _ in range(5))
+        return {
+            "ranks_per_chip": N_RANKS,
+            "bytes_per_rank": elems * 4,
+            "partitioned_gbps": round(data.nbytes / tp / 1e9, 2),
+            "serialized_gbps": round(data.nbytes / ts / 1e9, 2),
+            "speedup_ratio_x": round(ts / tp, 2),
         }
     except Exception as exc:
         return {"error": f"{type(exc).__name__}: {exc}"}
@@ -2602,6 +2865,10 @@ def bench_single_chip() -> dict:
     persistent_start_us = round(_persistent_start_us(world), 1)
     _record("persistent_start_us", persistent_start_us)
 
+    _set_phase("multi-ranks-per-chip partitioned HBM staging")
+    multirank = _multirank_chip_row(device)
+    _record("multirank_chip", multirank)
+
     _set_phase("pallas ring proof")
     pallas = _pallas_proof(device)
     _record("pallas", pallas)
@@ -2631,6 +2898,7 @@ def bench_single_chip() -> dict:
                              "plan-cache overhead (the ob1 small-"
                              "message latency regime)",
             "persistent_start_us": persistent_start_us,
+            "multirank_chip": multirank,
             "pallas": pallas,
             "pallas_attn": pallas_attn,
             **host,
@@ -2849,8 +3117,8 @@ def main() -> None:
     # Cheap probe with its own short deadline: when the chip is already
     # dead, report it in minutes (with any host-side rows still
     # runnable) instead of burning the watchdog budget.
-    _set_phase("probe (trivial op through the tunnel)")
-    if not _probe_device(180.0):
+    _set_phase("medic probe cycle (tunnel probe + quarantine/restore)")
+    if not _medic_probe_cycle(180.0):
         _set_phase("probe failed; host-only fabric phases")
         # No TPU in the path for the wire benches — capture them anyway
         # (every row carries round-over-round comparison values).
@@ -2858,8 +3126,8 @@ def main() -> None:
             _record(k, v)
         # The tunnel sometimes revives: re-probe once after the host
         # phases (~5 min later) before declaring the round device-less.
-        _set_phase("re-probe after host phases")
-        if not _probe_device(120.0):
+        _set_phase("medic re-probe after host phases")
+        if not _medic_probe_cycle(120.0):
             print(_emit_abort(metric, None,
                               "chip probe timed out twice: device "
                               "tunnel dead; host-side rows captured"),

@@ -258,6 +258,7 @@ def ensure_rules() -> None:
     global _registered
     if not _registered:
         from . import collectives  # noqa: F401
+        from . import devicesem  # noqa: F401
         from . import excepts  # noqa: F401
         from . import fastpath  # noqa: F401
         from . import healthseam  # noqa: F401

@@ -1,0 +1,188 @@
+"""Device-semaphore rule.
+
+``devicesem``: a Pallas kernel under ``coll/`` that issues remote DMAs
+(``pltpu.make_async_remote_copy``) owns real hardware state — DMA
+semaphores the copy signals on completion. Three ways that state goes
+wrong, each a silent-corruption or deadlock bug on the chip that no
+CPU test can catch:
+
+- a copy is **started but never waited**: the kernel exits with the
+  DMA in flight and the next collective on the same ``collective_id``
+  inherits a half-signalled semaphore;
+- a copy is waited **only on some control-flow paths** (a wait inside
+  an ``if`` whose condition doesn't also gate the start): the
+  untaken path leaks the in-flight copy;
+- the kernel takes no **DMA semaphore scratch** at all
+  (``scratch_shapes`` with ``pltpu.SemaphoreType.DMA``): the copy has
+  nowhere safe to signal.
+
+The rule is deliberately scoped to ``coll/`` files — the only place
+device kernels live — and to the documented Mosaic spelling, so
+host-side request code never matches.
+
+Suppression: ``# commlint: allow(devicesem)`` on or above the line,
+for kernels that hand the wait to a helper the AST walk can't see
+through.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, Optional
+
+from ..report import Severity
+from . import COMMLINT, LintRule, call_name, scope_walk, scopes
+
+_MAKER = "make_async_remote_copy"
+
+#: Completion spellings: full wait, or the split-phase send/recv halves
+#: (a kernel may legitimately wait only its half — the sender drains
+#: send_sem, the matched receiver drains recv_sem).
+_WAITS = frozenset({"wait", "wait_send", "wait_recv"})
+
+
+def _attr_calls_on(scope: ast.AST, name: str,
+                   attrs: frozenset) -> list[ast.Call]:
+    """Every ``name.<attr>()`` call in the scope (document order)."""
+    out = []
+    for node in scope_walk(scope):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in attrs \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == name:
+            out.append(node)
+    return out
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
+
+
+def _is_none_guard(test: ast.AST, handle: str) -> bool:
+    """``if handle is not None:`` — the guard is exactly "was the copy
+    started", so a wait under it cannot leak an in-flight DMA."""
+    return (isinstance(test, ast.Compare)
+            and isinstance(test.left, ast.Name)
+            and test.left.id == handle
+            and len(test.ops) == 1
+            and isinstance(test.ops[0], ast.IsNot)
+            and isinstance(test.comparators[0], ast.Constant)
+            and test.comparators[0].value is None)
+
+
+def _conditional_only(scope: ast.AST, handle: str, waits: list[ast.Call],
+                      starts: list[ast.Call]) -> Optional[ast.Call]:
+    """The first wait that sits under an ``if`` which neither contains
+    every start nor null-guards the handle — i.e. some path starts the
+    copy but skips the wait. None when at least one wait covers every
+    started path."""
+    ifs = [n for n in scope_walk(scope) if isinstance(n, ast.If)]
+    flagged = None
+    for w in waits:
+        guarded = [i for i in ifs if _contains(i, w)]
+        # balanced pairings: an If that also contains all the starts
+        # gates the whole copy; an `is not None` guard on the handle
+        # is the started-at-all test itself
+        guarded = [i for i in guarded
+                   if not all(_contains(i, s) for s in starts)
+                   and not _is_none_guard(i.test, handle)]
+        if not guarded:
+            return None  # this wait covers every started path
+        flagged = flagged or w
+    return flagged
+
+
+@COMMLINT.register
+class DeviceSemRule(LintRule):
+    NAME = "devicesem"
+    PRIORITY = 44
+    DESCRIPTION = ("coll/ Pallas kernels must take DMA-semaphore "
+                   "scratch and wait every started remote copy on "
+                   "all control-flow paths")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        if not ctx.relpath.startswith("coll/"):
+            return
+        makers = [n for n in ast.walk(ctx.tree)
+                  if isinstance(n, ast.Call) and call_name(n) == _MAKER]
+        if not makers:
+            return
+        # file-level: somewhere a pallas_call must allocate DMA
+        # semaphores in scratch_shapes for these copies to signal on
+        has_dma_scratch = any(
+            isinstance(n, ast.Call) and any(
+                k.arg == "scratch_shapes" and any(
+                    isinstance(a, ast.Attribute) and a.attr == "DMA"
+                    for a in ast.walk(k.value))
+                for k in n.keywords)
+            for n in ast.walk(ctx.tree))
+        if not has_dma_scratch:
+            first = makers[0]
+            if not ctx.suppressed(first.lineno, self.NAME):
+                yield self.finding(
+                    ctx, first,
+                    "file issues make_async_remote_copy but no "
+                    "pallas_call allocates DMA semaphores in "
+                    "scratch_shapes (pltpu.SemaphoreType.DMA) — the "
+                    "copies have no completion semaphore to signal "
+                    "(or annotate commlint: allow(devicesem))",
+                )
+        for scope, _is_module in scopes(ctx.tree):
+            for node in scope_walk(scope):
+                if not isinstance(node, ast.Call):
+                    continue
+                # fire-and-forget: make_async_remote_copy(...).start()
+                # leaves no handle to wait on
+                if isinstance(node.func, ast.Attribute) \
+                        and node.func.attr == "start" \
+                        and call_name(node.func.value) == _MAKER:
+                    if not ctx.suppressed(node.lineno, self.NAME):
+                        yield self.finding(
+                            ctx, node,
+                            "remote copy started without binding the "
+                            "handle — nothing can wait this DMA; bind "
+                            "it and wait both semaphores (or annotate "
+                            "commlint: allow(devicesem))",
+                        )
+                    continue
+                if call_name(node) != _MAKER:
+                    continue
+                # bound handle: X = make_async_remote_copy(...)
+                assign = next(
+                    (a for a in scope_walk(scope)
+                     if isinstance(a, ast.Assign) and a.value is node
+                     and len(a.targets) == 1
+                     and isinstance(a.targets[0], ast.Name)), None)
+                if assign is None:
+                    continue  # non-Name binding: the .start() check
+                    # above still covers the chained spelling
+                handle = assign.targets[0].id
+                starts = _attr_calls_on(scope, handle,
+                                        frozenset({"start"}))
+                waits = _attr_calls_on(scope, handle, _WAITS)
+                if starts and not waits:
+                    if not ctx.suppressed(node.lineno, self.NAME):
+                        yield self.finding(
+                            ctx, node,
+                            f"remote copy {handle!r} is start()ed but "
+                            "never wait()ed in this scope — the kernel "
+                            "can exit with the DMA in flight (or "
+                            "annotate commlint: allow(devicesem))",
+                        )
+                    continue
+                if starts and waits:
+                    cond = _conditional_only(scope, handle, waits,
+                                             starts)
+                    if cond is not None \
+                            and not ctx.suppressed(cond.lineno,
+                                                   self.NAME):
+                        yield self.finding(
+                            ctx, cond,
+                            f"remote copy {handle!r} is waited only "
+                            "inside a conditional that does not gate "
+                            "its start — the untaken path leaks an "
+                            "in-flight DMA (or annotate commlint: "
+                            "allow(devicesem))",
+                        )

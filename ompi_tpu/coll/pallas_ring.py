@@ -82,6 +82,15 @@ _rd_cutoff_var = config.register(
 )
 
 
+def interpret_available() -> bool:
+    """Does this jax build ship Mosaic's TPU interpret mode (the
+    inter-device DMA + remote semaphore emulation)? 0.4.x builds do
+    not — there the pallas kernels only run on real TPU hardware, and
+    CPU-tier validation falls back to the sched compiler's table
+    simulator (sched/pallas_lower.simulate)."""
+    return hasattr(pltpu, "InterpretParams")
+
+
 def _interpret():
     """False on TPU (compiled); Mosaic TPU-interpret params on CPU —
     the mode that emulates inter-device DMA + remote semaphore signals
@@ -90,6 +99,11 @@ def _interpret():
     if forced is not None and not forced:
         return False
     if forced or jax.default_backend() == "cpu":
+        if not interpret_available():
+            raise RuntimeError(
+                "this jax build has no Mosaic TPU interpret mode "
+                "(pltpu.InterpretParams); pallas kernels need a TPU "
+                "backend or jax >= 0.5")
         return pltpu.InterpretParams()
     return False
 

@@ -32,9 +32,11 @@ from .ir import Schedule, ScheduleError
 #: lowering memo in lower.py is keyed by digest underneath this).
 _SCHED_MEMO: dict = {}
 
-#: Algorithms this package registers into tuned.ALLREDUCE_ALGOS.
+#: Algorithms this package registers into tuned.ALLREDUCE_ALGOS. The
+#: sched_pallas_* names are the same IR programs lowered to fused
+#: Mosaic kernels (sched/pallas_lower) — the device_pallas tier.
 ALGOS = ("sched_ring", "sched_rd", "sched_ring_seg", "sched_hier",
-         "sched_quant")
+         "sched_quant", "sched_pallas_ring", "sched_pallas_ring_seg")
 
 
 # ---------------------------------------------------------------------------
@@ -116,6 +118,20 @@ def build_schedule(algo: str, nranks: int, *, segments: int = 2,
         sch = ir.quantized_wire(nranks, quant._wire_var.value,
                                 quant._block_var.value,
                                 order=_topo_order(nranks))
+    elif algo == "sched_pallas_ring":
+        sch = ir.with_lowering(
+            ir.ring(nranks, order=_topo_order(nranks)), "pallas",
+            tier="device_pallas")
+    elif algo == "sched_pallas_ring_seg":
+        sch = ir.with_lowering(
+            ir.segmented_ring(nranks,
+                              retune.effective_segments(segments),
+                              order=_topo_order(nranks)), "pallas",
+            tier="device_pallas")
+    elif algo == "sched_pallas_rs":
+        sch = ir.with_lowering(
+            ir.reduce_scatter(nranks, order=_topo_order(nranks)),
+            "pallas", tier="device_pallas")
     else:
         raise ScheduleError(f"unknown sched algorithm {algo!r}; "
                             f"known: {list(ALGOS)}")
@@ -162,6 +178,20 @@ def allreduce_sched_hier(x, axis_name, op):
 
 def allreduce_sched_quant(x, axis_name, op):
     return _run("sched_quant", x, axis_name, op)
+
+
+def allreduce_sched_pallas_ring(x, axis_name, op):
+    return _run("sched_pallas_ring", x, axis_name, op)
+
+
+def allreduce_sched_pallas_ring_seg(x, axis_name, op):
+    return _run("sched_pallas_ring_seg", x, axis_name, op)
+
+
+def reduce_scatter_sched_pallas(x, axis_name, op):
+    """REDUCE_SCATTER_ALGOS signature: x is the local (nranks, chunk)
+    contribution view, the result the own reduced block."""
+    return _run("sched_pallas_rs", x, axis_name, op)
 
 
 # ---------------------------------------------------------------------------
@@ -245,8 +275,9 @@ def warm(nranks: int, **kw) -> dict:
 
 __all__ = [
     "ALGOS", "Schedule", "ScheduleError", "allreduce_sched_hier",
+    "allreduce_sched_pallas_ring", "allreduce_sched_pallas_ring_seg",
     "allreduce_sched_quant", "allreduce_sched_rd",
     "allreduce_sched_ring", "allreduce_sched_ring_seg",
     "build_schedule", "clear_schedules", "ir", "lattice", "lookup",
-    "warm",
+    "reduce_scatter_sched_pallas", "warm",
 ]

@@ -91,7 +91,8 @@ def candidates(opname: str, nranks: int, dtype=None, op=None, *,
 
     pool = list(_EXACT_CANDIDATES)
     if include_pallas:
-        pool += ["pallas_ring", "pallas_bidir", "pallas_rd"]
+        pool += ["pallas_ring", "pallas_bidir", "pallas_rd",
+                 "sched_pallas_ring", "sched_pallas_ring_seg"]
     if quant._enable_var.value and quant.supports(op or "sum", dtype):
         pool += list(_QUANT_CANDIDATES)
     pof2 = nranks & (nranks - 1) == 0
@@ -115,8 +116,12 @@ def candidates(opname: str, nranks: int, dtype=None, op=None, *,
 # ---------------------------------------------------------------------------
 
 #: (alpha per step, beta per wire byte) by transport tier — relative
-#: units; only the ordering of costs matters.
-_TIER_COEFF = {"device": (1.0, 1.0e-4), "host": (30.0, 8.0e-4)}
+#: units; only the ordering of costs matters. device_pallas (the sched
+#: compiler's fused kernels) beats plain device on both coefficients:
+#: no per-round dispatch (one kernel, alpha down) and the DMA overlaps
+#: the combine (effective wire cost down).
+_TIER_COEFF = {"device_pallas": (0.8, 0.9e-4),
+               "device": (1.0, 1.0e-4), "host": (30.0, 8.0e-4)}
 
 
 def _steps_and_wire(algo: str, nbytes: int, nranks: int) -> tuple:
@@ -130,9 +135,11 @@ def _steps_and_wire(algo: str, nbytes: int, nranks: int) -> tuple:
         return logn, ring_wire * 0.85
     if algo in ("recursive_doubling", "sched_rd"):
         return logn, float(nbytes) * logn
-    if algo in ("ring", "sched_ring", "pallas_ring", "pallas_bidir"):
+    if algo in ("ring", "sched_ring", "pallas_ring", "pallas_bidir",
+                "sched_pallas_ring"):
         return 2 * (n - 1), ring_wire
-    if algo in ("ring_segmented", "sched_ring_seg"):
+    if algo in ("ring_segmented", "sched_ring_seg",
+                "sched_pallas_ring_seg"):
         # segmentation overlaps combine with DMA on large payloads and
         # only adds round overhead on small ones
         factor = 0.92 if nbytes > (1 << 20) else 1.1
@@ -312,6 +319,11 @@ SCHED_GENERATOR = {
     "sched_ring_seg": "segmented_ring",
     "sched_hier": "hierarchical",
     "sched_quant": "quantized_wire",
+    # the pallas-compiled names share their base generator's digest:
+    # the step program is identical, only the lowering differs (the
+    # lowered-callable memo keys on meta["lowering"] separately).
+    "sched_pallas_ring": "ring",
+    "sched_pallas_ring_seg": "segmented_ring",
 }
 
 

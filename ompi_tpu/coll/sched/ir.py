@@ -258,6 +258,43 @@ def segmented_ring(nranks: int, segments: int,
     return sched
 
 
+def reduce_scatter(nranks: int,
+                   order: Optional[Sequence[int]] = None) -> Schedule:
+    """The reduce-scatter phase of the ring on its own: n-1 rounds over
+    n chunks, after which rank r owns the fully reduced chunk r. The
+    chunk walk is the first loop of ``_ring_steps`` re-anchored so the
+    final reduce at position p lands on chunk order[p] — the rank-owns-
+    its-own-index convention of REDUCE_SCATTER_ALGOS."""
+    order = _order_or_identity(nranks, order)
+    n = nranks
+    steps: list[Step] = []
+    for k in range(n - 1):
+        for p in range(n):
+            succ = order[(p + 1) % n]
+            pred = order[(p - 1) % n]
+            steps.append(Step(k, "send", order[p], succ,
+                              order[(p - k - 1) % n]))
+            steps.append(Step(k, "reduce", order[p], pred,
+                              order[(p - k - 2) % n]))
+    sched = Schedule(
+        name="reduce_scatter", op="reduce_scatter", nranks=nranks,
+        nchunks=nranks, steps=tuple(steps),
+        meta={"tier": "device", "lowering": "interpret", "order": order},
+    )
+    check(sched)
+    return sched
+
+
+def with_lowering(sched: Schedule, lowering: str, **meta) -> Schedule:
+    """The same step program under a different lowering directive (and
+    optional extra meta). The digest changes with it — a pallas-lowered
+    ring is a different compiled artifact than the interpreted one."""
+    import dataclasses
+
+    return dataclasses.replace(
+        sched, meta={**sched.meta, "lowering": lowering, **meta})
+
+
 def hierarchical(groups: Sequence[Sequence[int]]) -> Schedule:
     """Hierarchical allreduce over host groups (the coll/sm + tuned
     split): phase A reduces each group onto its leader (first member),
@@ -344,6 +381,7 @@ GENERATORS = {
     "segmented_ring": segmented_ring,
     "hierarchical": hierarchical,
     "quantized_wire": quantized_wire,
+    "reduce_scatter": reduce_scatter,
 }
 
 
@@ -364,7 +402,7 @@ def generate(name: str, nranks: int, **params) -> Schedule:
     if name == "quantized_wire":
         return gen(nranks, params.get("wire", "int8"),
                    params.get("block", 128), order=params.get("order"))
-    if name == "ring":
+    if name in ("ring", "reduce_scatter"):
         return gen(nranks, order=params.get("order"))
     return gen(nranks)
 
@@ -372,5 +410,6 @@ def generate(name: str, nranks: int, **params) -> Schedule:
 __all__ = [
     "ANNOTATIONS", "GENERATORS", "KINDS", "Schedule", "ScheduleError",
     "Step", "check", "generate", "hierarchical", "quantized_wire",
-    "recursive_doubling", "ring", "segmented_ring",
+    "recursive_doubling", "reduce_scatter", "ring", "segmented_ring",
+    "with_lowering",
 ]

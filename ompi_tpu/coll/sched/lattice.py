@@ -26,8 +26,15 @@ TERMINAL = "gather_reduce"
 #: health/ledger's TIERS lattice; a fallback of None ends the chain.
 #: Quant tiers fall back to the plain-precision chain (bit-identical
 #: across ranks regardless of breaker state); sched_* interpreted
-#: schedules fall back within the lattice before leaving it.
+#: schedules fall back within the lattice before leaving it. The
+#: sched_pallas_* compiled kernels sit on the distinct "device_pallas"
+#: tier and degrade to their interpreted/hand-written equivalent, so a
+#: Mosaic-kernel fault quarantines the compiled tier without touching
+#: the plain device plane.
 LATTICE: dict[str, tuple[str, Optional[str]]] = {
+    "sched_pallas_ring": ("device_pallas", "sched_ring"),
+    "sched_pallas_ring_seg": ("device_pallas", "sched_ring_seg"),
+    "sched_pallas_rs": ("device_pallas", "ring"),
     "quant_pallas": ("device", "quant_ring"),
     "quant_ring": ("device", "ring"),
     "sched_quant": ("device", "sched_ring"),

@@ -1,6 +1,7 @@
 """Lowering: compile a Schedule IR program to a fused jitted callable.
 
-Two lowering modes, selected by ``Schedule.meta["lowering"]``:
+Three lowering modes, selected by ``Schedule.meta["lowering"]`` (or
+the explicit ``lower(sched, strategy=...)`` override):
 
 ``interpret``
     A genuine IR executor: the step program is compiled round-by-round
@@ -19,6 +20,13 @@ Two lowering modes, selected by ``Schedule.meta["lowering"]``:
     ``pltpu.make_async_remote_copy`` device kernels (coll/pallas_ring),
     the quantized-wire codec (coll/quant), or the host tiers — and the
     IR is the *documentation + validation contract* for it.
+
+``pallas``
+    Compiled: the step program itself is lowered into one fused
+    ``make_async_remote_copy`` kernel (sched/pallas_lower.py) — every
+    round a remote DMA overlapped with the combine, double-buffered
+    chunk slots sized from the IR's chunk plan. The ``device_pallas``
+    lattice tier.
 
 The lowered callable has the ALLREDUCE_ALGOS signature
 ``fn(x, axis_name, op)`` and composes with coll/framework's
@@ -43,9 +51,15 @@ import numpy as np
 from ...core.errors import ArgumentError
 from .ir import ANNOTATIONS, Schedule
 
-#: lowered-callable memo, keyed by schedule digest (table construction
-#: is pure python; jit caching happens downstream in compile_plan).
-_LOWERED: dict[str, Callable] = {}
+#: lowered-callable memo, keyed by (schedule digest, strategy): the
+#: digest covers meta["lowering"], but the explicit strategy override
+#: must not collide with the meta-selected lowering of the same
+#: program (table construction is pure python; jit caching happens
+#: downstream in compile_plan).
+_LOWERED: dict[tuple, Callable] = {}
+
+#: The three lowering strategies, in maturity order.
+STRATEGIES = ("interpret", "primitive", "pallas")
 
 
 def _round_tables(sched: Schedule) -> list[tuple]:
@@ -148,16 +162,35 @@ def _lower_primitive(sched: Schedule) -> Callable:
     )
 
 
-def lower(sched: Schedule) -> Callable:
-    """Schedule -> callable with the ALLREDUCE_ALGOS signature.
-    Memoized on the schedule digest; emits one ``sched.compile`` trace
-    instant per actual lowering."""
-    key = sched.digest()
+def lower(sched: Schedule, strategy: Optional[str] = None) -> Callable:
+    """Schedule -> callable with the registered-algo signature
+    (ALLREDUCE_ALGOS for allreduce programs, REDUCE_SCATTER_ALGOS for
+    reduce-scatter ones). ``strategy`` overrides the schedule's own
+    ``meta["lowering"]`` directive. Memoized on (digest, strategy);
+    emits one ``sched.compile`` trace instant per actual lowering and
+    counts every selection in the per-strategy SPC counters (the
+    ``sched_lower_strategy_total`` telemetry series)."""
+    if strategy is None:
+        strategy = sched.meta.get("lowering", "interpret")
+        if strategy not in STRATEGIES:
+            strategy = "interpret"
+    elif strategy not in STRATEGIES:
+        raise ArgumentError(
+            f"unknown lowering strategy {strategy!r}; known: "
+            f"{list(STRATEGIES)}")
+    from ...core.counters import SPC
+
+    SPC.record(f"sched_lower_strategy_{strategy}")
+    key = (sched.digest(), strategy)
     fn = _LOWERED.get(key)
     if fn is not None:
         return fn
-    if sched.meta.get("lowering", "interpret") == "primitive":
+    if strategy == "primitive":
         fn = _lower_primitive(sched)
+    elif strategy == "pallas":
+        from . import pallas_lower
+
+        fn = pallas_lower.compile_schedule(sched)
     else:
         fn = _lower_interpret(sched)
     _LOWERED[key] = fn
@@ -165,14 +198,16 @@ def lower(sched: Schedule) -> Callable:
 
     tspan.instant("sched.compile", cat="sched", schedule=sched.name,
                   nranks=sched.nranks, rounds=sched.rounds(),
-                  lowering=sched.meta.get("lowering", "interpret"),
-                  digest=key)
+                  lowering=strategy, digest=key[0])
     return fn
 
 
 def clear_lowered() -> None:
     """Forget memoized lowerings (tests / re-init)."""
     _LOWERED.clear()
+    from . import pallas_lower
+
+    pallas_lower.clear_compiled()
 
 
 # ---------------------------------------------------------------------------
@@ -259,6 +294,105 @@ def _validate_bounded(comm, fn: Callable, op, dtype, *, wire, block,
         np.all(np.abs(ref - got) <= bound[None, :] + 1e-12))
 
 
+def _validate_reduce_scatter(comm, fn: Callable, op, dtype, *,
+                             nelems: int, label: str,
+                             check_vma: bool = True) -> bool:
+    """Bit-identical check of a reduce-scatter callable (input: the
+    local (n, chunk) contribution view; output: the own reduced block)
+    against the ring reference ``spmd.reduce_scatter_ring``."""
+    import jax
+
+    from ..framework import compile_plan
+    from .. import spmd
+    from ...ops import lookup as op_lookup
+
+    op = op_lookup(op)
+    n = comm.size
+    data = _payload(n, n * nelems, dtype,
+                    block_constant=False).reshape(n, n, nelems)
+    x = comm.put_rank_major(data)
+    # shard_map hands each rank a (1, n, nelems) slice; the [0]/[None]
+    # bracket keeps the P("ranks") in/out specs.
+    ref_plan = compile_plan(
+        comm, ("sched.validate.rs_ref", op.cache_key,
+               str(np.dtype(dtype)), x.shape),
+        lambda b: spmd.reduce_scatter_ring(b[0], "ranks", op)[None])
+    got_plan = compile_plan(
+        comm, ("sched.validate", label, op.cache_key,
+               str(np.dtype(dtype)), x.shape),
+        lambda b: fn(b[0], "ranks", op)[None], check_vma=check_vma)
+    ref = np.asarray(jax.device_get(ref_plan(x)))
+    got = np.asarray(jax.device_get(got_plan(x)))
+    return ref.dtype == got.dtype and ref.shape == got.shape \
+        and ref.tobytes() == got.tobytes()
+
+
+def _pallas_executable() -> bool:
+    """Can a Mosaic pallas_call actually run here — real TPU, or a jax
+    build whose interpret mode can emulate the remote DMA/semaphore
+    primitives on CPU? jax 0.4.x ships the primitives without the
+    emulation, so tier-1 there validates pallas codegen through the
+    table-program simulator instead."""
+    import jax
+
+    from .. import pallas_ring
+
+    return jax.default_backend() == "tpu" \
+        or pallas_ring.interpret_available()
+
+
+def _validate_simulated(comm, sched: Schedule, op, dtype, *,
+                        nelems: int) -> bool:
+    """Bit-identity check of a pallas-lowered schedule through
+    ``pallas_lower.simulate`` — the sequential executor that shares the
+    kernel's table program, slot discipline and store gating — against
+    the mathematical reduction (exact for the power-of-two payloads
+    regardless of combine order). Covers every decision ``analyze``
+    bakes into the kernel when Mosaic execution is unavailable."""
+    import functools
+
+    import jax.numpy as jnp
+
+    from . import pallas_lower
+    from ...ops import lookup as op_lookup
+
+    op = op_lookup(op)
+    n = sched.nranks
+    if comm.size != n:
+        raise ArgumentError(
+            f"schedule {sched.name!r} compiled for {n} ranks, comm has "
+            f"{comm.size}")
+    data = jnp.asarray(
+        _payload(n, sched.nchunks * nelems, dtype,
+                 block_constant=False).reshape(n, sched.nchunks, nelems))
+    got = np.asarray(pallas_lower.simulate(sched, data, op))
+    red = functools.reduce(op.combine, [data[k] for k in range(n)])
+    if sched.op == "reduce_scatter":
+        # REDUCE_SCATTER_ALGOS contract: rank k's result is chunk k. A
+        # schedule that lands a different chunk fails right here.
+        ref = np.asarray(jnp.stack([red[k] for k in range(n)]))
+    else:
+        ref = np.asarray(jnp.stack([red] * n))
+    return ref.dtype == got.dtype and ref.shape == got.shape \
+        and ref.tobytes() == got.tobytes()
+
+
+#: Primitives whose lowered callable contains a Mosaic pallas_call.
+_MOSAIC_PRIMITIVES = ("pallas_ring", "quant_pallas")
+
+
+def _needs_vma_exemption(sched: Schedule) -> bool:
+    """True only when the lowered callable actually invokes a Mosaic
+    ``pallas_call``: its outputs mix varying and replicated values in a
+    way jax's vma tracking rejects, so those plans compile with
+    ``check_vma=False`` (jax's documented workaround — see
+    framework.compile_plan). Scoped to the known Mosaic primitives and
+    the pallas lowering strategy, not any name containing "pallas", so
+    every other schedule keeps full vma checking."""
+    return sched.meta.get("primitive", "") in _MOSAIC_PRIMITIVES \
+        or sched.meta.get("lowering") == "pallas"
+
+
 def validate_schedule(comm, sched: Schedule, op, dtype, *,
                       nelems: int = 192) -> bool:
     """Validity check for a lowered Schedule.
@@ -271,7 +405,15 @@ def validate_schedule(comm, sched: Schedule, op, dtype, *,
     exact on small integers. The int8 wire is lossy by design — its
     scale arithmetic (max/127) is not even stable across XLA fusion
     choices — so it validates against coll/quant's analytic worst-case
-    error bound instead, the same contract quant's own tests enforce."""
+    error bound instead, the same contract quant's own tests enforce.
+
+    Pallas-lowered and Mosaic-primitive schedules are held to the same
+    bit-identity bar on every dtype (bf16 included); only the vma
+    *plan check* is exempted for them (``_needs_vma_exemption``) — the
+    byte comparison itself never is. When the pallas kernel cannot
+    execute at all (CPU on a jax build without Mosaic interpret mode —
+    ``_pallas_executable``), the check runs through the table-program
+    simulator, which preserves the bit-identity bar on the codegen."""
     quantized = sched.meta.get("primitive", "").startswith("quant") \
         or any(s.kind in ANNOTATIONS for s in sched.steps)
     if quantized and sched.meta.get("wire", "int8") != "bf16":
@@ -280,12 +422,19 @@ def validate_schedule(comm, sched: Schedule, op, dtype, *,
             wire=sched.meta.get("wire", "int8"),
             block=sched.meta.get("block"), nelems=nelems,
             label=f"sched:{sched.digest()}")
-    is_pallas = "pallas" in sched.meta.get("primitive", "")
+    if sched.meta.get("lowering") == "pallas" and not _pallas_executable():
+        return _validate_simulated(comm, sched, op, dtype, nelems=nelems)
+    check_vma = not _needs_vma_exemption(sched)
+    if sched.op == "reduce_scatter":
+        return _validate_reduce_scatter(
+            comm, lower(sched), op, dtype, nelems=nelems,
+            label=f"sched:{sched.digest()}", check_vma=check_vma)
     return validate(
         comm, lower(sched), op, dtype, nelems=nelems,
         label=f"sched:{sched.digest()}",
-        check_vma=not is_pallas,
+        check_vma=check_vma,
     )
 
 
-__all__ = ["clear_lowered", "lower", "validate", "validate_schedule"]
+__all__ = ["STRATEGIES", "clear_lowered", "lower", "validate",
+           "validate_schedule"]

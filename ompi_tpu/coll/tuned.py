@@ -181,11 +181,17 @@ def _sched_algos() -> None:
     ALLREDUCE_ALGOS["sched_ring_seg"] = sched.allreduce_sched_ring_seg
     ALLREDUCE_ALGOS["sched_hier"] = sched.allreduce_sched_hier
     ALLREDUCE_ALGOS["sched_quant"] = sched.allreduce_sched_quant
+    ALLREDUCE_ALGOS["sched_pallas_ring"] = sched.allreduce_sched_pallas_ring
+    ALLREDUCE_ALGOS["sched_pallas_ring_seg"] = \
+        sched.allreduce_sched_pallas_ring_seg
+    REDUCE_SCATTER_ALGOS["sched_pallas_rs"] = sched.reduce_scatter_sched_pallas
 
 
 def is_pallas_algo(name: str) -> bool:
-    # quant_pallas is a Mosaic kernel too: same check_vma exemption.
-    return name.startswith("pallas") or name == "quant_pallas"
+    # quant_pallas is a Mosaic kernel too, as are the sched compiler's
+    # fused device_pallas-tier kernels: same check_vma exemption.
+    return name.startswith(("pallas", "sched_pallas")) \
+        or name == "quant_pallas"
 
 
 def is_quant_algo(name: str) -> bool:
@@ -236,12 +242,12 @@ _LAZY_ALGOS: dict[str, frozenset] = {
         "pallas_ring", "pallas_bidir", "pallas_rd", "pallas_ring_chunked",
         "pallas_rsag", "quant_ring", "quant_pallas",
         "sched_ring", "sched_rd", "sched_ring_seg", "sched_hier",
-        "sched_quant",
+        "sched_quant", "sched_pallas_ring", "sched_pallas_ring_seg",
     }),
     "bcast": frozenset({"pallas_binomial"}),
     "allgather": frozenset({"pallas_ring"}),
     "reduce": frozenset({"pallas_tree"}),
-    "reduce_scatter": frozenset({"pallas_ring"}),
+    "reduce_scatter": frozenset({"pallas_ring", "sched_pallas_rs"}),
     "gather": frozenset({"pallas_linear"}),
     "scatter": frozenset({"pallas_linear"}),
 }

@@ -68,5 +68,14 @@ def ensure() -> None:
                 )
 
             _pltpu.CompilerParams = CompilerParams
+
+        # Mosaic's TPU interpret mode (DMA + remote semaphore
+        # emulation) was named TPUInterpretParams before the 0.7
+        # rename. Builds with neither (0.4.x) simply cannot emulate
+        # the pallas kernels on CPU — pallas_ring.interpret_available()
+        # is the capability probe callers gate on.
+        if not hasattr(_pltpu, "InterpretParams") and hasattr(
+                _pltpu, "TPUInterpretParams"):
+            _pltpu.InterpretParams = _pltpu.TPUInterpretParams
     except Exception:
         pass

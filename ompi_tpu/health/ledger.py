@@ -88,10 +88,13 @@ HEALTHY, SUSPECT, QUARANTINED, PROBATION = (
     "healthy", "suspect", "quarantined", "probation",
 )
 
-#: The transport tiers, fastest first. "host" is the terminal plane
-#: (pure numpy + device_put) and is never quarantined — there must
-#: always be a routable tier.
-TIERS = ("device", "fastpath", "shm", "dcn", "fabric", "host")
+#: The transport tiers, fastest first. "device_pallas" is the sched
+#: compiler's fused-kernel tier (sched/pallas_lower) sitting above the
+#: hand-written device kernels; "host" is the terminal plane (pure
+#: numpy + device_put) and is never quarantined — there must always be
+#: a routable tier.
+TIERS = ("device_pallas", "device", "fastpath", "shm", "dcn", "fabric",
+         "host")
 
 GLOBAL_SCOPE = "global"
 

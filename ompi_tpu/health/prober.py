@@ -144,28 +144,52 @@ def probes() -> dict[str, str]:
 
 
 def ensure_builtin_probes() -> None:
-    """Register the device-tier canary (the only probe that needs no
-    component state: tunnel enumeration + a tiny device reduction).
+    """Register the built-in canaries that need no component state:
+    the device tier (tunnel enumeration + a tiny device reduction) and
+    the device_pallas tier (the sched compiler's codegen plane).
     Transport probes register at their components' selection seams."""
-    if "device" in _probes:
-        return
+    if "device" not in _probes:
+        def _device_canary() -> None:
+            import jax
+            import jax.numpy as jnp
+            import numpy as np
 
-    def _device_canary() -> None:
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
+            devs = jax.devices()  # tunnel enumeration: raises when dead
+            if not devs:
+                raise RuntimeError("no devices visible")
+            # tiny on-device op: the canary allreduce degenerate case —
+            # proves dispatch + transfer, costs microseconds
+            out = jax.device_get(jnp.sum(jnp.arange(8, dtype=jnp.int32)))
+            if int(np.asarray(out)) != 28:
+                raise RuntimeError(f"device canary miscomputed: {out!r}")
 
-        devs = jax.devices()  # tunnel enumeration: raises when dead
-        if not devs:
-            raise RuntimeError("no devices visible")
-        # tiny on-device op: the canary allreduce degenerate case —
-        # proves dispatch + transfer, costs microseconds
-        out = jax.device_get(jnp.sum(jnp.arange(8, dtype=jnp.int32)))
-        if int(np.asarray(out)) != 28:
-            raise RuntimeError(f"device canary miscomputed: {out!r}")
+        register_probe("device", _device_canary,
+                       description="tunnel enumeration + tiny device sum")
 
-    register_probe("device", _device_canary,
-                   description="tunnel enumeration + tiny device sum")
+    if "device_pallas" not in _probes:
+        def _device_pallas_canary() -> None:
+            import jax
+            import numpy as np
+
+            from ..coll.sched import ir, pallas_lower
+
+            if not jax.devices():
+                raise RuntimeError("no devices visible")
+            # the codegen plane: analyze + table-simulate a tiny ring
+            # program and check the reduction — proves the compiler
+            # end-to-end in microseconds on any backend (Mosaic
+            # execution itself is covered by the bench/validate paths
+            # on hardware; a canary must stay cheap and device-free)
+            sched = ir.with_lowering(ir.ring(4), "pallas")
+            data = np.ones((4, 4, 8), np.float32)
+            out = np.asarray(pallas_lower.simulate(sched, data, "sum"))
+            if out.shape != (4, 4, 8) or not np.all(out == 4.0):
+                raise RuntimeError(
+                    f"device_pallas canary miscomputed: {out.shape}")
+
+        register_probe("device_pallas", _device_pallas_canary,
+                       description="sched pallas codegen plane: analyze"
+                       " + simulate a tiny ring program")
 
 
 def probe_tier(tier: str, *, scope: str = ledger.GLOBAL_SCOPE) -> bool:

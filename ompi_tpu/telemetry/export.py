@@ -106,6 +106,12 @@ def prometheus_text(registry: counters.CounterRegistry = SPC,
         lines.extend(_control_plane_lines(registry, namespace))
     if health is None:
         health = _health_states()
+        # Guaranteed series for the sched compiler's fused-kernel tier:
+        # a fleet that has never routed device_pallas must still see
+        # its gauge (an absent series and a healthy tier are different
+        # facts). Live path only — explicit ``health`` dicts (golden
+        # renders, tests) stay byte-stable.
+        health.setdefault("global/device_pallas", "healthy")
     state_name = f"{namespace}_health_tier_state"
     if health:
         lines.append(f"# HELP {state_name} health-ledger tier state "
@@ -150,6 +156,17 @@ def _control_plane_lines(registry: counters.CounterRegistry,
         lines.append(f"# HELP {name} {help_text}")
         lines.append(f"# TYPE {name} counter")
         lines.append(f"{name} 0")
+    # the lowering-strategy selections as ONE labelled series (the
+    # flat per-strategy counters stay, this is the dashboard surface);
+    # every strategy label is guaranteed, zero before first selection
+    name = f"{namespace}_sched_lower_strategy_total"
+    lines.append(f"# HELP {name} schedule lowerings by strategy")
+    lines.append(f"# TYPE {name} counter")
+    from ..coll.sched import lower as _lower
+
+    for strategy in _lower.STRATEGIES:
+        val = snap.get(f"sched_lower_strategy_{strategy}", 0)
+        lines.append(f'{name}{{strategy="{strategy}"}} {_fmt(val)}')
     try:
         from ..health import ledger
 

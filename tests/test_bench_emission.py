@@ -175,6 +175,13 @@ def test_revival_sequencing_probe_fail_then_succeed():
     assert detail["pallas"]["compiled"] is True
     assert detail["persistent_start_us"] == 55.5
     assert out["value"] > 0
+    # the multi-ranks-per-chip staging row rides the device phase:
+    # partitioned HBM staging vs serialized per-rank puts
+    mr = detail["multirank_chip"]
+    assert "error" not in mr, mr
+    assert mr["ranks_per_chip"] == 8 and mr["bytes_per_rank"] > 0
+    assert mr["partitioned_gbps"] > 0 and mr["serialized_gbps"] > 0
+    assert mr["speedup_ratio_x"] > 0
 
 
 def test_new_rows_emit_schema_complete_on_probe_fail():
@@ -589,3 +596,117 @@ def test_daemon_rows_emit_schema_complete_on_probe_fail():
     for key in ("degradation_pct", "flood_p50_us",
                 "reject_to_admit_p50_ms", "evict_to_detach_ms"):
         assert benchgate.direction(key) == "lower"
+
+
+def test_medic_probe_cycle_drill_records_row():
+    """ISSUE PR14 tentpole: the bench preflight is a full medic
+    re-probe cycle, not a one-shot probe — QUARANTINE the device
+    tiers, drive the supervisor's tick schedule through the PROBATION
+    walk, confirm both restore to HEALTHY. A failed tunnel probe still
+    short-circuits (no drill against a dead tunnel)."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        assert bench._medic_probe_cycle(30.0) is False
+        assert "medic_probe_cycle" not in bench._PARTIAL["rows"]
+
+        bench._probe_device = lambda timeout_s=180.0: True
+        assert bench._medic_probe_cycle(30.0) is True
+        print("ROW " + json.dumps(
+            bench._PARTIAL["rows"]["medic_probe_cycle"]))
+    """)
+    r = _run(prog, timeout=120)
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = [l for l in r.stdout.splitlines() if l.startswith("ROW ")][0]
+    row = json.loads(line[4:])
+    assert "error" not in row, row
+    assert row["tiers"] == ["device", "device_pallas"]
+    assert row["full_restore"] is True
+    assert sorted(row["restored"]) == ["device", "device_pallas"]
+    # the restore walked through PROBATION — no straight-to-healthy jump
+    assert row["probation_walk"] == ["device", "device_pallas"]
+    assert row["cycle_ms"] >= 0
+
+
+def test_pallas_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR14 satellite 3: the pallas_sched_allreduce and
+    device_resurrection rows run end-to-end (real 8-rank subprocess
+    worker for the sched sweep, real supervisor drill for the
+    resurrection) inside the probe-failed host-only path and emit
+    schema-complete JSON — off TPU both carry degraded=true loudly
+    (the gate excuses them, never silently)."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the sweep so the schema check stays fast
+        os.environ["OMPI_TPU_BENCH_PALLAS_SIZES"] = "1024,65536"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench._telemetry_overhead_row = lambda: {"stub": True}
+        bench._watchtower_overhead_row = lambda: {"stub": True}
+        bench._straggler_detect_row = lambda: {"stub": True}
+        bench._sched_autotune_row = lambda: {"stub": True}
+        bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    ps = rows["pallas_sched_allreduce"]
+    assert "error" not in ps, ps
+    # bit-identity evidence: 3 generators x f32/bf16, all identical
+    assert ps["bit_identity"] == {"checked": 6, "ok": True}
+    if not ps["pallas_executable"]:
+        # no Mosaic execution on this box: the row says so loudly
+        assert ps["degraded"] is True
+        assert "interpret" in ps["degraded_reason"]
+    assert len(ps["sweep"]) == 2
+    for pt in ps["sweep"]:
+        assert pt["interpret_gbps"] > 0 and pt["interpret_p50_us"] > 0
+        if ps["pallas_executable"]:
+            assert pt["compiled_gbps"] > 0
+
+    dr = rows["device_resurrection"]
+    assert "error" not in dr, dr
+    assert dr["tiers"] == ["device", "device_pallas"]
+    assert dr["restored"] is True
+    assert dr["restore_ms"] > 0 and dr["first_good_row_ms"] > 0
+    assert dr["first_good_value_ok"] is True
+    assert dr["probation_walk"] == ["device", "device_pallas"]
+    # off TPU the row is degraded, never silently dropped
+    assert dr["degraded"] is True
+
+    # ratchet directions resolve from the key names: timings lower,
+    # throughputs higher
+    from ompi_tpu.tools import benchgate
+    for key in ("restore_ms", "first_good_row_ms", "interpret_p50_us"):
+        assert benchgate.direction(key) == "lower"
+    for key in ("interpret_gbps", "compiled_gbps", "speedup_ratio_x"):
+        assert benchgate.direction(key) == "higher"
