@@ -264,6 +264,7 @@ def ensure_rules() -> None:
         from . import healthseam  # noqa: F401
         from . import lifecycle  # noqa: F401
         from . import metricname  # noqa: F401
+        from . import overlapready  # noqa: F401
         from . import polling  # noqa: F401
         from . import quantuse  # noqa: F401
         from . import requests  # noqa: F401

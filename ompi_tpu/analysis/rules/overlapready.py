@@ -1,0 +1,123 @@
+"""Overlap-readiness rule.
+
+``overlapready``: the tile-granular overlap path (parallel/overlap +
+the partitioned part framework) only hides communication if the
+gradient/backward code actually feeds it readiness — a blocking
+``allreduce``/``allreduce_gradients`` call sitting in a gradient- or
+backward-named function serializes the whole reduction behind the
+backward pass, exactly the exposed-comm tail the T3-style machinery
+exists to remove. The rule flags blocking gradient-reduction call sites
+inside gradient/backward-named functions under ``parallel/`` and
+``models/`` that show no readiness evidence (a ``mark_ready`` /
+``Pready`` / schedule-capture / grad-marker reference) in the same
+function scope.
+
+Evidence that satisfies the rule, anywhere in the function: a call or
+identifier mentioning ``mark_ready``, ``pready``, ``parrived``,
+``grad_marker``, ``capture_ready`` or ``overlap`` (the overlap-session
+surface — e.g. ``overlap.capture_ready_schedule(grads)`` at the sync
+seam).
+
+Suppression: ``# commlint: allow(overlapready)`` on the flagged call
+(or its enclosing function's def line), for call sites that knowingly
+stay blocking (tiny trees, debug paths, delegation to an overlap-aware
+wrapper).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule, call_name
+
+#: Blocking gradient-reduction entry points (the coll vtable call, the
+#: dp-layer wrappers, and the bucketer's fused paths).
+_BLOCKING = frozenset({
+    "allreduce", "allreduce_gradients", "allreduce_tree",
+    "allreduce_pytree",
+})
+
+#: Function-name fragments marking gradient/backward code.
+_GRAD_FN_WORDS = ("grad", "backward", "bwd")
+
+#: Identifier substrings that count as readiness evidence.
+_EVIDENCE_WORDS = (
+    "mark_ready", "pready", "parrived", "grad_marker", "capture_ready",
+    "overlap",
+)
+
+
+def _scope_walk(fn: ast.AST) -> Iterable[ast.AST]:
+    """The function subtree, excluding nested function bodies (a nested
+    gradient helper is checked on its own)."""
+    stack: list[ast.AST] = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _idents(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+
+
+def _has_evidence(fn: ast.AST) -> bool:
+    for node in _scope_walk(fn):
+        for ident in _idents(node):
+            low = ident.lower()
+            if any(w in low for w in _EVIDENCE_WORDS):
+                return True
+    return False
+
+
+@COMMLINT.register
+class OverlapReadyRule(LintRule):
+    NAME = "overlapready"
+    PRIORITY = 44
+    DESCRIPTION = ("gradient/backward functions under parallel//models/ "
+                   "should feed the tile-overlap path, not block on a "
+                   "monolithic allreduce")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        rel = ctx.relpath.replace("\\", "/")
+        if "parallel/" not in rel and "models/" not in rel:
+            return
+        for fn in ast.walk(ctx.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            low = fn.name.lower()
+            if not any(w in low for w in _GRAD_FN_WORDS):
+                continue
+            blocking = [
+                n for n in _scope_walk(fn)
+                if isinstance(n, ast.Call)
+                and call_name(n) in _BLOCKING
+            ]
+            if not blocking:
+                continue
+            if _has_evidence(fn):
+                continue
+            if ctx.suppressed(fn.lineno, self.NAME):
+                continue
+            for call in blocking:
+                if ctx.suppressed(call.lineno, self.NAME):
+                    continue
+                yield self.finding(
+                    ctx, call,
+                    f"{fn.name}() blocks on {call_name(call)} with the "
+                    "partitioned overlap path available — no "
+                    "mark_ready/Pready/schedule-capture evidence in "
+                    "scope, so the whole reduction is exposed behind "
+                    "the backward pass; feed parallel/overlap (or "
+                    "annotate commlint: allow(overlapready))",
+                )

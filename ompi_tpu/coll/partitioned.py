@@ -19,10 +19,15 @@ a partitioned send over E elements agree on what "bucket k" means.
 
 from __future__ import annotations
 
+import itertools
+import math
+import time
+from contextlib import contextmanager
 from typing import Any, Callable
 
 import jax.numpy as jnp
 
+from ..core import progress as _progress
 from ..core.counters import SPC
 from ..core.errors import ArgumentError, RequestError
 from ..part.framework import block_range
@@ -31,6 +36,24 @@ SPC.counter(
     "part_coll_buckets_ready",
     "buckets handed to the coll layer by readiness order",
 )
+SPC.counter(
+    "part_tiles_ready_total",
+    "gradient tiles marked ready on partitioned allreduces",
+)
+
+
+@contextmanager
+def _batch_window():
+    """The fastpath dispatch-coalescing window when the shm fabric is
+    live (communicator.start_all idiom); transparent otherwise."""
+    from ..part.persist import _fabric_engine
+
+    eng = _fabric_engine()
+    if eng is None:
+        yield
+    else:
+        with eng.batch_dispatch():
+            yield
 
 
 class BucketedAllreduce:
@@ -116,6 +139,380 @@ class BucketedAllreduce:
         jax.block_until_ready(out)
         self._done = True
         return out
+
+
+class PartitionedAllreduce:
+    """Persistent tile-granular allreduce of one rank-major ``(size,
+    E)`` bucket over the part framework: one ``Psend_init`` /
+    ``Precv_init`` pair per peer bound ONCE at construction and re-armed
+    every step by ``start()`` (MPI_Start semantics), so the steady-state
+    step pays zero setup. ``ready(t, data)`` / ``ready_range(lo, hi,
+    data)`` stage a tile's values into the persistent wire buffers and
+    fire ``Pready`` on every peer inside one fastpath batch-dispatch
+    window; arrivals drain via ``Parrived`` from the progress engine
+    (``_pump`` is a registered progress callback), and the root
+    accumulates each tile the moment it lands from all peers — so the
+    reduction overlaps whatever compute is still producing later tiles.
+
+    Reduction plan: gather-to-root with eager per-tile combine, then one
+    ``comm.bcast`` of the reduced buffer fired from the drain callback
+    the moment the last tile lands (still overlapped when compute is
+    ongoing). Ordered combination is replaced by arrival-order
+    combination, hence the commutative-op requirement.
+
+    Wire tier: the bucket's precision is chosen by the SAME tuned
+    precedence as a monolithic allreduce of its size
+    (``tuned.decide_allreduce``: forced > rules > guards > cache >
+    priors). When the decision lands on a quantized algorithm and
+    coll/quant supports the op/dtype, tiles travel block-scaled int8 +
+    f32 scales (``coll_quant_block``); otherwise exact. Tiles are padded
+    to a uniform size (and, on the quant wire, to a scale-block
+    multiple) so tile t always owns wire range ``[t*W, (t+1)*W)`` — the
+    uniform mapping both sides derive independently.
+
+    Every instance is its own partitioned request pair, so a tile (and
+    the partition→transfer re-blocking under it) can never straddle two
+    gradient buckets — the bucketer's fusion boundary is the request
+    boundary.
+    """
+
+    #: Tag allocator for auto-tagged instances: below the user band most
+    #: tests use, one user tag per instance (all peers share it — the
+    #: derived-namespace matching is per (source, tag)).
+    _tags = itertools.count(768)
+
+    def __init__(self, comm, like, op: Any = "sum", tiles: int = 8,
+                 tag: int | None = None, root: int = 0,
+                 allow_quant: bool | None = None,
+                 label: str | None = None) -> None:
+        import jax
+        import numpy as np
+
+        from ..ops import lookup as op_lookup
+        from . import quant as _quant
+        from . import tuned as _tuned
+
+        arr = jnp.asarray(like)
+        if arr.ndim != 2 or arr.shape[0] != comm.size:
+            raise ArgumentError(
+                f"partitioned allreduce needs a rank-major (size, E) "
+                f"template, got shape {arr.shape}"
+            )
+        self._comm = comm
+        self._root = comm.check_rank(root)
+        self._op = op_lookup(op)
+        if not self._op.commutative:
+            raise ArgumentError(
+                f"partitioned allreduce combines tiles in arrival "
+                f"order; op {self._op.name!r} is not commutative"
+            )
+        self._elems = int(arr.shape[1])
+        if self._elems < 1:
+            raise ArgumentError("empty partitioned allreduce template")
+        self.tiles = max(1, min(int(tiles), self._elems))
+        self._dtype = np.dtype(str(arr.dtype))
+        self.label = label or f"cid{comm.cid}"
+
+        # Per-bucket wire tier under the normal tuned precedence.
+        nbytes = self._elems * self._dtype.itemsize
+        self.algo = _tuned.decide_allreduce(
+            self._op, nbytes, comm.size, arr.dtype,
+            allow_quant=allow_quant,
+        )
+        self.quant_wire = bool(
+            _tuned.is_quant_algo(self.algo)
+            and _quant.supports(self._op, arr.dtype)
+        )
+
+        # Uniform tile geometry over a padded element space. On the
+        # quant wire a tile rounds up to a scale-block multiple, which
+        # can leave trailing tiles empty — clamp the count so every
+        # tile owns at least one logical element.
+        et = math.ceil(self._elems / self.tiles)
+        if self.quant_wire:
+            block = _quant._block_var.value
+            et = block * math.ceil(et / block)
+            self._scales_per_tile = et // block
+            self._wire_tile = et + 4 * self._scales_per_tile  # bytes
+            wire_dtype = np.dtype(np.uint8)
+        else:
+            self._scales_per_tile = 0
+            self._wire_tile = et  # elements
+            wire_dtype = self._dtype
+        self.tiles = math.ceil(self._elems / et)
+        self.tile_elems = et
+        wire_len = self.tiles * self._wire_tile
+
+        # Persistent pairs, bound once: every peer sends its shard to
+        # root; root receives one partitioned request per peer.
+        self.tag = next(self._tags) if tag is None else int(tag)
+        self._peers = [r for r in range(comm.size) if r != self._root]
+        self._send_bufs = {
+            r: np.zeros(wire_len, wire_dtype) for r in self._peers
+        }
+        self._sreqs = {
+            r: comm.psend_init(self._send_bufs[r], self.tiles,
+                               self._root, self.tag, source=r)
+            for r in self._peers
+        }
+        wire_like = jax.ShapeDtypeStruct((wire_len,), wire_dtype)
+        self._rreqs = {
+            r: comm.precv_init(self.tiles, r, self.tag,
+                               dest=self._root, like=wire_like)
+            for r in self._peers
+        }
+        self._active = False
+        self._acc = None
+        self._reduce_done = False
+        self._result = None
+        self.trace_id = 0
+        self.t_first_ready = None
+        self.t_reduce_done = None
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "PartitionedAllreduce":
+        """Re-arm every persistent pair (one batch-dispatch window) and
+        reset per-step tile state."""
+        import numpy as np
+
+        from ..communicator import start_all
+        from ..trace import span as tspan
+
+        if self._active:
+            raise RequestError("start() on an active partitioned "
+                               "allreduce")
+        start_all(list(self._sreqs.values()) + list(self._rreqs.values()))
+        self._active = True
+        self._acc = np.zeros(self.tiles * self.tile_elems, np.float64)
+        self._have = [0] * self.tiles
+        self._ready = [False] * self.tiles
+        self._integrated = {r: [False] * self.tiles for r in self._peers}
+        self._tiles_reduced = 0
+        self._reduce_done = False
+        self._result = None
+        self.trace_id = tspan.coll_trace_id(self._comm.cid)
+        self.t_first_ready = None
+        self.t_reduce_done = None
+        _progress.register(self._pump)
+        return self
+
+    def tile_range(self, t: int) -> tuple[int, int]:
+        """Logical element range [lo, hi) of tile t (unpadded space)."""
+        if not 0 <= t < self.tiles:
+            raise ArgumentError(f"tile {t} out of range [0, {self.tiles})")
+        lo = t * self.tile_elems
+        return lo, min(lo + self.tile_elems, self._elems)
+
+    # -- producer side ----------------------------------------------------
+
+    def ready(self, t: int, data) -> None:
+        """Mark tile t produced: ``data`` is the rank-major ``(size,
+        hi-lo)`` slab of fresh values for the tile's element range."""
+        self.ready_range(t, t, data)
+
+    def ready_range(self, lo: int, hi: int, data) -> None:
+        """Pready_range analog (inclusive bounds): stage tiles lo..hi
+        and flag them on every peer in ONE batch-dispatch window."""
+        import numpy as np
+
+        from ..trace import span as tspan
+
+        if not self._active:
+            raise RequestError("ready() before start()")
+        llo, _ = self.tile_range(lo)
+        _, lhi = self.tile_range(hi)
+        if hi < lo:
+            raise ArgumentError(f"ready_range: hi {hi} < lo {lo}")
+        host = np.asarray(data)
+        if host.shape != (self._comm.size, lhi - llo):
+            raise ArgumentError(
+                f"tiles [{lo}, {hi}] slab must be "
+                f"({self._comm.size}, {lhi - llo}), got {host.shape}"
+            )
+        for t in range(lo, hi + 1):
+            if self._ready[t]:
+                raise RequestError(
+                    f"tile {t} already marked ready this step"
+                )
+        now = time.perf_counter()
+        if self.t_first_ready is None:
+            self.t_first_ready = now
+        with _batch_window():
+            for r in self._peers:
+                if self.quant_wire:
+                    wire = np.concatenate([
+                        self._encode_tile(host[r], t, llo)
+                        for t in range(lo, hi + 1)
+                    ])
+                elif lhi - llo == (hi - lo + 1) * self.tile_elems:
+                    # exact wire, no padding in range: stage the row
+                    # itself — no intermediate copy
+                    wire = host[r]
+                else:
+                    # exact wire: only the buffer's LAST tile is ever
+                    # short, so one zero-padded copy covers the range
+                    wire = np.zeros(
+                        (hi - lo + 1) * self.tile_elems, self._dtype)
+                    wire[: lhi - llo] = host[r]
+                sreq = self._sreqs[r]
+                sreq.stage(lo * self._wire_tile,
+                           (hi + 1) * self._wire_tile, wire)
+                sreq.pready_range(lo, hi)
+            for t in range(lo, hi + 1):
+                self._ready[t] = True
+                tlo, thi = self.tile_range(t)
+                self._combine(t, host[self._root, tlo - llo:thi - llo])
+                tspan.instant(
+                    "part.ready", cat="part", trace_id=self.trace_id,
+                    tile=t, bucket=self.label, tag=self.tag,
+                )
+        SPC.record("part_tiles_ready_total", hi - lo + 1)
+
+    def _encode_tile(self, row, t: int, base_lo: int):
+        """Wire image of one peer's tile t from ``row`` (the peer's
+        values for the staged logical range starting at base_lo)."""
+        import numpy as np
+
+        tlo, thi = self.tile_range(t)
+        seg = np.zeros(self.tile_elems, self._dtype)
+        seg[: thi - tlo] = row[tlo - base_lo: thi - base_lo]
+        if not self.quant_wire:
+            return seg
+        from . import quant as _quant
+
+        q, scales = _quant.quantize_block_scaled(jnp.asarray(seg))
+        return np.concatenate([
+            np.asarray(q, np.int8).view(np.uint8),
+            np.asarray(scales, np.float32).view(np.uint8),
+        ])
+
+    def _decode_tile(self, wire):
+        import numpy as np
+
+        if not self.quant_wire:
+            return np.asarray(wire, self._dtype)
+        from . import quant as _quant
+
+        raw = np.asarray(wire, np.uint8)
+        q = raw[: self.tile_elems].view(np.int8)
+        scales = raw[self.tile_elems:].view(np.float32)
+        return np.asarray(_quant.dequantize_block_scaled(
+            jnp.asarray(q), jnp.asarray(scales)))
+
+    # -- consumer side (progress-engine drain) ----------------------------
+
+    def _combine(self, t: int, vals) -> None:
+        import numpy as np
+
+        lo = t * self.tile_elems
+        v = np.asarray(vals, np.float64).reshape(-1)
+        # Unpadded-length ops only: the accumulator's pad region (the
+        # final tile's tail) stays zero from start() and is trimmed
+        # before use, so it never needs combining.
+        view = self._acc[lo: lo + v.size]
+        if self._have[t] == 0:
+            view[:] = v
+        else:
+            view[:] = self._op.np_reduce(view, v)
+        self._have[t] += 1
+        if self._have[t] == self._comm.size:
+            from ..trace import span as tspan
+
+            self._tiles_reduced += 1
+            tspan.instant(
+                "part.arrived", cat="part", trace_id=self.trace_id,
+                tile=t, bucket=self.label, tag=self.tag,
+            )
+            if self._tiles_reduced == self.tiles:
+                self._finish_reduce()
+
+    def _pump(self) -> int:
+        """Progress callback: one drain sweep per peer, then integrate
+        newly arrived tiles (eager reduction under remaining compute)."""
+        if not self._active or self._reduce_done:
+            return 0
+        n = 0
+        for r in self._peers:
+            rreq = self._rreqs[r]
+            # The part component's own progress callback runs the
+            # probe-then-recv sweep; this callback only integrates.
+            arrived = rreq.arrived_partitions()
+            mine = self._integrated[r]
+            for t in range(self.tiles):
+                if arrived[t] and not mine[t]:
+                    vals = self._decode_tile(rreq.partition_view(t))
+                    mine[t] = True
+                    n += 1
+                    self._combine(t, vals)
+                    if self._reduce_done:
+                        return n
+        return n
+
+    def _finish_reduce(self) -> None:
+        """All tiles combined: cut the padding, broadcast the reduced
+        buffer back through the coll vtable (fired from the drain, so it
+        still overlaps any remaining producer compute)."""
+        import numpy as np
+
+        self.t_reduce_done = time.perf_counter()
+        reduced = self._acc[: self._elems].astype(self._dtype)
+        stacked = np.zeros((self._comm.size, self._elems), self._dtype)
+        stacked[self._root] = reduced
+        self._result = self._comm.bcast(jnp.asarray(stacked), self._root)
+        # Flag AFTER the result lands: a concurrent waiter released by
+        # this flag must never observe a half-built result.
+        self._reduce_done = True
+
+    @property
+    def reduced(self) -> bool:
+        """True once every tile has been combined and the reduced
+        buffer broadcast — the consumer-side hook: per-bucket apply
+        compute may start here while later buckets still reduce."""
+        return bool(self._reduce_done)
+
+    def poll(self) -> bool:
+        """Drive one progress round and report :attr:`reduced`.
+
+        Routed through the engine's multi-waiter wait loop so a
+        consumer thread polling buckets never pumps the drain sweep
+        concurrently with a producer-side ``wait()`` — one pumper, the
+        rest sleep on completion notifications."""
+        if not self._reduce_done:
+            _progress.ENGINE.progress_until(
+                lambda: self._reduce_done, timeout=0.0)
+        return bool(self._reduce_done)
+
+    def wait(self, timeout: float = 60.0):
+        """Drive progress until every tile is reduced and every
+        persistent sub-request has completed (so start() can re-arm);
+        returns the replicated rank-major ``(size, E)`` result."""
+        if not self._active:
+            raise RequestError("wait() before start()")
+        missing = [t for t in range(self.tiles) if not self._ready[t]]
+        if missing:
+            raise RequestError(
+                f"wait() before ready() on tiles {missing}"
+            )
+        deadline = time.monotonic() + timeout
+        if not _progress.ENGINE.progress_until(
+                lambda: self._reduce_done, timeout=timeout):
+            raise RequestError(
+                f"partitioned allreduce {self.label}: tiles "
+                f"{self._tiles_reduced}/{self.tiles} reduced before "
+                f"timeout"
+            )
+        pend = list(self._sreqs.values()) + list(self._rreqs.values())
+        if not _progress.ENGINE.progress_until(
+                lambda: all(r._poll() or r.done for r in pend),
+                timeout=max(0.0, deadline - time.monotonic())):
+            raise RequestError(
+                f"partitioned allreduce {self.label}: sub-requests "
+                "incomplete at timeout"
+            )
+        _progress.unregister(self._pump)
+        self._active = False
+        return self._result
 
 
 def bucketed_allreduce(

@@ -218,32 +218,46 @@ class PartitionedRequest(Request):
     def pready(self, partition: int) -> None:
         """MPI_Pready: mark one send partition filled; the component may
         drain it (and any transfer it completes) immediately."""
+        self._pready_burst([partition])
+
+    def pready_range(self, lo: int, hi: int) -> None:
+        """MPI_Pready_range: inclusive bounds, matching the MPI binding.
+        The whole range is validated up front and handed to the
+        component as ONE burst (one drain sweep / dispatch window), not
+        partition-at-a-time."""
+        self._check_partition(lo)
+        self._check_partition(hi)
+        if hi < lo:
+            raise ArgumentError(f"Pready_range: hi {hi} < lo {lo}")
+        self._pready_burst(list(range(lo, hi + 1)))
+
+    def pready_list(self, partitions: Sequence[int]) -> None:
+        """MPI_Pready_list — same burst contract as pready_range."""
+        self._pready_burst(list(partitions))
+
+    def _pready_burst(self, partitions: Sequence[int]) -> None:
+        """Validate a Pready burst ATOMICALLY, then flag and hand the
+        whole set to the component in one call. A duplicate anywhere in
+        the burst (against this cycle's flags or within the burst
+        itself) raises BEFORE any partition is flagged, so an erroneous
+        overlapping Pready_range can never double-send a transfer."""
         if not self.sending:
             raise RequestError("Pready on a receive-side partitioned request")
         if self.state is not RequestState.ACTIVE:
             raise RequestError("Pready on a partitioned request that is "
                                "not active (call start() first)")
-        p = self._check_partition(partition)
-        if self._flagged[p]:
-            raise RequestError(
-                f"Pready: partition {p} already marked ready this cycle"
-            )
-        self._flagged[p] = True
-        self._partition_ready(p)
-
-    def pready_range(self, lo: int, hi: int) -> None:
-        """MPI_Pready_range: inclusive bounds, matching the MPI binding."""
-        self._check_partition(lo)
-        self._check_partition(hi)
-        if hi < lo:
-            raise ArgumentError(f"Pready_range: hi {hi} < lo {lo}")
-        for p in range(lo, hi + 1):
-            self.pready(p)
-
-    def pready_list(self, partitions: Sequence[int]) -> None:
-        """MPI_Pready_list."""
+        seen = set()
+        for partition in partitions:
+            p = self._check_partition(partition)
+            if self._flagged[p] or p in seen:
+                raise RequestError(
+                    f"Pready: partition {p} already marked ready this "
+                    "cycle"
+                )
+            seen.add(p)
         for p in partitions:
-            self.pready(p)
+            self._flagged[p] = True
+        self._partitions_ready(list(partitions))
 
     def parrived(self, partition: int) -> bool:
         """MPI_Parrived: has this receive partition fully arrived?"""
@@ -262,6 +276,13 @@ class PartitionedRequest(Request):
         return super().start()
 
     # -- component hooks --------------------------------------------------
+
+    def _partitions_ready(self, partitions: list) -> None:
+        """Burst hook: every partition is already flagged. Components
+        override to coalesce the burst (one probe sweep, one dispatch
+        window); the default degrades to partition-at-a-time."""
+        for p in partitions:
+            self._partition_ready(p)
 
     def _partition_ready(self, partition: int) -> None:
         raise NotImplementedError

@@ -41,6 +41,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..core import jax_compat
 from ..parallel import dp as dp_mod
+from ..parallel import overlap as overlap_mod
 
 jax_compat.ensure()
 from ..parallel import ep as ep_mod
@@ -203,13 +204,19 @@ def _block(x, bp, layer: int, cfg: ModelConfig, use_moe: bool):
 
 
 def _stage_fn(stage_blocks, x, cfg: ModelConfig):
-    """Apply this stage's layers_per_stage blocks to (B, T_local, D)."""
+    """Apply this stage's layers_per_stage blocks to (B, T_local, D).
+
+    Each block's input carries a grad_marker: its backward rule fires
+    once every gradient inside the block has been produced, so the
+    captured order is the true per-layer backprop tile schedule
+    (parallel/overlap replays it for tile-granular Pready firing)."""
     for layer in range(cfg.layers_per_stage):
         use_moe = (
             cfg.n_experts > 0
             and cfg.moe_every > 0
             and (layer % cfg.moe_every) == (cfg.moe_every - 1)
         )
+        x = overlap_mod.grad_marker(x, f"blk{layer}")
         x = _block(x, stage_blocks, layer, cfg, use_moe)
     return x
 
@@ -225,8 +232,11 @@ def _forward_loss(params, tokens, targets, cfg: ModelConfig):
     ntp = lax.axis_size("tp")
     T = S // ntp  # local sequence shard
 
-    # Embed + positional, then shard the sequence over tp.
-    x = params["embed"][tokens] + params["pos"][None, :S]
+    # Embed + positional, then shard the sequence over tp. The marker's
+    # backward rule fires last — embed/pos grads close the backprop.
+    x = overlap_mod.grad_marker(
+        params["embed"][tokens] + params["pos"][None, :S], "embed"
+    )
     tp_idx = lax.axis_index("tp")
     x = lax.dynamic_slice_in_dim(x, tp_idx * T, T, axis=1)  # (B, T, D)
 
@@ -294,6 +304,9 @@ def _sync_grads(grads, cfg: ModelConfig):
         name: lax.psum(g, "tp") if name in _TP_REPLICATED else g
         for name, g in grads["blocks"].items()
     }
+    # Capture the readiness schedule of the exact tree handed to the dp
+    # reduction — the tile order parallel/overlap's mark_ready replays.
+    pre = overlap_mod.capture_ready_schedule(pre)
     return _dp.allreduce_gradients(pre, "dp")
 
 

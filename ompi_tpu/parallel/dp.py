@@ -18,11 +18,16 @@ import jax
 
 from ..ops import SUM
 from . import bucketer
+from . import overlap as _overlap
 
 
 def allreduce_gradients(grads: Any, axis_name: str = "dp") -> Any:
     """Mean-free allreduce (sum) of a gradient pytree over the dp axis,
-    fused into size-capped buckets (one collective per bucket)."""
+    fused into size-capped buckets (one collective per bucket). The
+    readiness schedule is captured at trace time so host-side overlap
+    sessions (parallel/overlap) can replay production tile-by-tile in
+    true backward order."""
+    grads = _overlap.capture_ready_schedule(grads)
     return bucketer.allreduce_tree(grads, axis_name, SUM)
 
 
@@ -31,7 +36,8 @@ def mean_gradients(grads: Any, axis_name: str = "dp") -> Any:
     from jax import lax
 
     n = lax.axis_size(axis_name)
-    summed = bucketer.allreduce_tree(grads, axis_name, SUM)
+    # delegates to the overlap-aware sum above
+    summed = allreduce_gradients(grads, axis_name)  # commlint: allow(overlapready)
     return jax.tree.map(lambda g: g / n, summed)
 
 

@@ -76,6 +76,26 @@ SPC.counter("part_partitions_arrived", "receive partitions completed")
 SPC.counter("part_transfers_sent", "internal partitioned transfers sent")
 SPC.counter("part_transfers_received",
             "internal partitioned transfers drained")
+SPC.counter("part_drain_sweeps",
+            "probe-then-recv sweeps over missing transfers")
+SPC.counter("part_overlap_window_coalesced_total",
+            "Pready bursts whose transfers rode one fastpath "
+            "batch-dispatch window")
+
+
+def _fabric_engine():
+    """The fastpath fabric engine when ob1 + shm are live (the
+    communicator.start_all coalescing idiom) — else None."""
+    from ..core.errors import ComponentError
+    from ..pml.framework import PML
+
+    try:
+        eng = getattr(PML.component("ob1"), "_fabric", None)
+    except ComponentError:
+        return None
+    if eng is not None and getattr(eng, "shm", None) is not None:
+        return eng
+    return None
 
 
 def _transfer_count(total_elems: int, itemsize: int) -> int:
@@ -156,17 +176,82 @@ class PersistPartSend(PartitionedRequest):
         self.buffer = value
 
     def _start(self) -> None:
-        import jax.numpy as jnp
+        import numpy as np
 
-        self._flat = jnp.reshape(jnp.asarray(self.buffer), (-1,))
+        if isinstance(self.buffer, np.ndarray):
+            # Keep a VIEW for numpy buffers: stage() writes (the MPI
+            # "fill your partition region, then Pready it" pattern)
+            # land in place and are picked up at fire time, zero-copy.
+            self._flat = np.reshape(self.buffer, (-1,))
+        else:
+            import jax.numpy as jnp
+
+            self._flat = jnp.reshape(jnp.asarray(self.buffer), (-1,))
         self._fired = [False] * self._ntransfers
         self._inner = []
 
-    def _partition_ready(self, partition: int) -> None:
-        SPC.record("part_partitions_flagged")
-        for k in range(self._ntransfers):
-            if not self._fired[k] and self._covered(k):
+    def stage(self, lo: int, hi: int, values) -> None:
+        """Fill elements ``[lo, hi)`` of the ACTIVE send buffer before
+        marking the covering partitions ready — the functional analog of
+        writing into the registered MPI buffer region. Rejected once any
+        partition overlapping the region is flagged (its transfer may
+        already be on the wire)."""
+        import numpy as np
+
+        if self.state is not RequestState.ACTIVE:
+            raise RequestError("stage() on a partitioned request that is "
+                               "not active (call start() first)")
+        if not 0 <= lo < hi <= self._elems:
+            raise ArgumentError(
+                f"stage range [{lo}, {hi}) outside [0, {self._elems})"
+            )
+        for p in range(self.partitions):
+            plo, phi = block_range(p, self.partitions, self._elems)
+            if phi <= lo:
+                continue
+            if plo >= hi:
+                break
+            if self._flagged[p]:
+                raise RequestError(
+                    f"stage([{lo}, {hi})) overlaps partition {p} already "
+                    "marked ready this cycle"
+                )
+        flat_vals = np.reshape(np.asarray(values), (-1,))
+        if flat_vals.size != hi - lo:
+            raise ArgumentError(
+                f"stage([{lo}, {hi})) expects {hi - lo} elements, got "
+                f"{flat_vals.size}"
+            )
+        if isinstance(self._flat, np.ndarray):
+            self._flat[lo:hi] = flat_vals
+        else:
+            import jax.numpy as jnp
+
+            self._flat = self._flat.at[lo:hi].set(
+                jnp.asarray(flat_vals, dtype=self._flat.dtype))
+
+    def _partitions_ready(self, partitions: list) -> None:
+        """One burst: scan for newly covered transfers ONCE, then fire
+        them all through a single fastpath batch-dispatch window — a
+        Pready_range landing inside one window costs one descriptor
+        sweep + one doorbell per destination, not a wake per tile."""
+        SPC.record("part_partitions_flagged", len(partitions))
+        fire = [k for k in range(self._ntransfers)
+                if not self._fired[k] and self._covered(k)]
+        if not fire:
+            return
+        eng = _fabric_engine() if len(fire) > 1 else None
+        if eng is not None:
+            SPC.record("part_overlap_window_coalesced_total")
+            with eng.batch_dispatch():
+                for k in fire:
+                    self._fire(k)
+        else:
+            for k in fire:
                 self._fire(k)
+
+    def _partition_ready(self, partition: int) -> None:
+        self._partitions_ready([partition])
 
     def _covered(self, k: int) -> bool:
         """Is every partition overlapping transfer k's range flagged?"""
@@ -253,6 +338,9 @@ class PersistPartRecv(PartitionedRequest):
         returns the number drained (progress-engine event count)."""
         if self.state is not RequestState.ACTIVE:
             return 0
+        if len(self._got) == self._ntransfers:
+            return 0
+        SPC.record("part_drain_sweeps")
         n = 0
         for k in range(self._ntransfers):
             if k in self._got:
@@ -318,8 +406,18 @@ class PersistPartRecv(PartitionedRequest):
         ))
 
     def _partition_arrived(self, partition: int) -> bool:
+        if self._arrived_parts[partition]:
+            # Already accounted — no probe sweep for a tile the caller
+            # polls again (the per-Pready probe-syscall fix: a burst of
+            # Parrived polls costs ONE sweep, not one per tile).
+            return True
         self._drain()
         return self._part_done(partition)
+
+    def arrived_partitions(self) -> tuple:
+        """Snapshot of per-partition arrival flags (no probe sweep) —
+        consumers polling many tiles drain once, then read this."""
+        return tuple(self._arrived_parts)
 
     def partition_view(self, partition: int):
         """The arrived partition's elements as a flat array — the MPI-4

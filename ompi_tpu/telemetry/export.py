@@ -139,6 +139,11 @@ GUARANTEED_COUNTERS = (
      "ticks a cache key's live p50 exceeded drift_ratio x baseline"),
     ("sched_retune_suppressed",
      "due retunes suppressed by hysteresis/cooldown/budget"),
+    ("part_tiles_ready_total",
+     "gradient tiles marked ready on partitioned allreduces"),
+    ("part_overlap_window_coalesced_total",
+     "Pready bursts whose transfers rode one fastpath batch-dispatch "
+     "window"),
 )
 
 

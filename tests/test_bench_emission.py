@@ -207,6 +207,7 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
@@ -264,6 +265,7 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -338,6 +340,7 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -401,6 +404,7 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -475,6 +479,7 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -537,6 +542,7 @@ def test_daemon_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -655,6 +661,7 @@ def test_pallas_rows_emit_schema_complete_on_probe_fail():
         bench._osc_epoch_2proc = lambda: {"stub": True}
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -710,3 +717,87 @@ def test_pallas_rows_emit_schema_complete_on_probe_fail():
         assert benchgate.direction(key) == "lower"
     for key in ("interpret_gbps", "compiled_gbps", "speedup_ratio_x"):
         assert benchgate.direction(key) == "higher"
+
+
+def test_overlap_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR15 satellite 4: the transformer-scale part_overlap row
+    (threaded backward/reduce/apply pipeline over a real 8-rank
+    DpOverlapSession) and the dp_step_overlap_pct row run inside the
+    probe-failed host-only path and emit schema-complete JSON — the
+    overlap fraction, the exposed tail, and the vs-blocking ratchet."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the pipeline so the schema check stays fast
+        os.environ["OMPI_TPU_BENCH_OVERLAP_LAYERS"] = "3"
+        os.environ["OMPI_TPU_BENCH_OVERLAP_LAYER_KB"] = "256"
+        os.environ["OMPI_TPU_BENCH_OVERLAP_TRIALS"] = "1"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench._telemetry_overhead_row = lambda: {"stub": True}
+        bench._watchtower_overhead_row = lambda: {"stub": True}
+        bench._straggler_detect_row = lambda: {"stub": True}
+        bench._sched_autotune_row = lambda: {"stub": True}
+        bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._pallas_sched_row = lambda: {"stub": True}
+        bench._device_resurrection_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    po = rows["part_overlap"]
+    assert "error" not in po, po
+    assert po["layers"] == 3 and po["bytes"] == 3 * 256 * 1024
+    assert po["buckets"] >= 1 and po["tiles"] >= po["buckets"]
+    assert po["comm_only_ms"] > 0 and po["blocking_s"] > 0
+    assert po["overlapped_s"] > 0 and po["speedup"] > 0
+    assert po["ratchet_min_speedup"] == 2.0
+    # the shrunken 3-layer drill still pipelines: overlapped strictly
+    # beats blocking (the 2.0 ratchet itself rides the full-size run
+    # via the "pass" field + benchgate's speedup series)
+    assert po["speedup"] > 1.0, po
+
+    ov = rows["dp_step_overlap_pct"]
+    assert "error" not in ov, ov
+    assert 0.0 <= ov["overlap_pct"] <= 100.0
+    assert ov["exposed_comm_ms"] >= 0.0
+    assert ov["comm_window_s"] > 0 and ov["backward_window_s"] > 0
+    assert ov["tiles"] == po["tiles"] and ov["buckets"] == po["buckets"]
+    assert ov["bwd_order_replayed"] is True
+
+    # ratchet directions resolve from the key names: the overlap
+    # fraction and speedup ratchet higher, the exposed tail and comm
+    # cost lower; calibration-dependent *_s fields carry no direction
+    from ompi_tpu.tools import benchgate
+    for key in ("speedup", "overlap_pct"):
+        assert benchgate.direction(key) == "higher"
+    for key in ("exposed_comm_ms", "comm_only_ms",
+                "monolithic_allreduce_ms"):
+        assert benchgate.direction(key) == "lower"
+    for key in ("blocking_s", "overlapped_s", "comm_window_s",
+                "backward_window_s"):
+        assert benchgate.direction(key) is None

@@ -1,0 +1,450 @@
+"""Tile-granular compute/comm overlap (ISSUE PR15): the
+PartitionedAllreduce building block, the DpOverlapSession training-step
+surface, the traced-side grad_marker capture, the overlapready lint
+rule, and the per-tile commtrace evidence.
+
+T3 reference (arxiv 2401.16677): track backprop tile completion, fire
+sub-operation collectives as tiles land, drain under remaining compute.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.core.errors import ArgumentError, RequestError
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def base():
+    return ompi_tpu.init()
+
+
+def _rank_major(base, elems, seed=0, scale=1.0):
+    rng = np.random.default_rng(seed)
+    return base.put_rank_major(
+        (rng.random((base.size, elems)) * scale).astype(np.float32))
+
+
+# -- PartitionedAllreduce ---------------------------------------------------
+
+def test_partitioned_allreduce_out_of_order_matches_oracle(base):
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 50, seed=1)
+    oracle = np.asarray(base.allreduce(x))
+    pa = PartitionedAllreduce(base, x, tiles=5, tag=700)
+    pa.start()
+    host = np.asarray(x)
+    for t in (3, 0, 4, 1, 2):          # production order is arbitrary
+        lo, hi = pa.tile_range(t)
+        pa.ready(t, host[:, lo:hi])
+    np.testing.assert_allclose(np.asarray(pa.wait()), oracle, rtol=1e-6)
+
+
+def test_partitioned_allreduce_restart_reuses_persistent_pairs(base):
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    a = _rank_major(base, 24, seed=2)
+    b = np.asarray(a) + 5.0
+    pa = PartitionedAllreduce(base, a, tiles=3, tag=701)
+    for step, x in enumerate((np.asarray(a), b)):
+        pa.start()
+        for t in range(3):
+            lo, hi = pa.tile_range(t)
+            pa.ready(t, x[:, lo:hi])
+        got = np.asarray(pa.wait())
+        np.testing.assert_allclose(
+            got, np.asarray(base.allreduce(x)), rtol=1e-6)
+
+
+def test_partitioned_allreduce_duplicate_tile_raises_no_double_send(
+        base):
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 30, seed=3)
+    host = np.asarray(x)
+    pa = PartitionedAllreduce(base, x, tiles=3, tag=702)
+    pa.start()
+    lo, hi = pa.tile_range(0)
+    pa.ready(0, host[:, lo:hi])
+    with pytest.raises(RequestError):
+        pa.ready(0, host[:, lo:hi])           # duplicate this step
+    with pytest.raises(RequestError):
+        pa.ready_range(0, 1, host[:, : pa.tile_range(1)[1]])
+    for t in (1, 2):
+        tl, th = pa.tile_range(t)
+        pa.ready(t, host[:, tl:th])
+    # the duplicate never double-combined: result still exact
+    np.testing.assert_allclose(
+        np.asarray(pa.wait()), np.asarray(base.allreduce(x)), rtol=1e-6)
+
+
+def test_partitioned_allreduce_readiness_before_start_raises(base):
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 16, seed=4)
+    pa = PartitionedAllreduce(base, x, tiles=2, tag=703)
+    with pytest.raises(RequestError):
+        pa.ready(0, np.asarray(x)[:, :8])
+    with pytest.raises(RequestError):
+        pa.wait(timeout=0.1)
+
+
+def test_partitioned_allreduce_uneven_last_tile(base):
+    """Element count not divisible by the tile size: the final tile is
+    short, rides a zero-padded wire image, and the pad is trimmed."""
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 29, seed=5)      # 29 over 8-elem tiles: 4 tiles
+    host = np.asarray(x)
+    pa = PartitionedAllreduce(base, x, tiles=4, tag=704)
+    assert pa.tile_range(3)[1] - pa.tile_range(3)[0] < pa.tile_elems
+    pa.start()
+    for t in (3, 1, 0, 2):
+        lo, hi = pa.tile_range(t)
+        pa.ready(t, host[:, lo:hi])
+    np.testing.assert_allclose(
+        np.asarray(pa.wait()), np.asarray(base.allreduce(x)), rtol=1e-6)
+
+
+def test_partitioned_allreduce_quant_wire(base):
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+    from ompi_tpu.core import config
+
+    # per-bucket tier selection rides the tuned precedence: drop the
+    # quant size floor so this small bucket lands on the quant wire
+    old = config.get("coll_quant_min_bytes")
+    config.set("coll_quant_min_bytes", 64)
+    try:
+        x = _rank_major(base, 512, seed=6, scale=2.0)
+        host = np.asarray(x)
+        pa = PartitionedAllreduce(base, x, tiles=4, tag=705,
+                                  allow_quant=True)
+        assert pa.quant_wire
+        assert pa.tiles >= 2             # scale-block rounding kept tiles
+        exact = PartitionedAllreduce(base, x, tiles=4, tag=715,
+                                     allow_quant=False)
+        assert not exact.quant_wire      # per-bucket veto
+    finally:
+        config.set("coll_quant_min_bytes", old)
+    pa.start()
+    for t in range(pa.tiles):
+        lo, hi = pa.tile_range(t)
+        pa.ready(t, host[:, lo:hi])
+    got = np.asarray(pa.wait())
+    oracle = np.asarray(base.allreduce(x))
+    # int8 block-scaled wire: same tolerance class as the quant coll
+    np.testing.assert_allclose(got, oracle, rtol=0.15, atol=0.15)
+
+
+def test_partitioned_poll_and_reduced_flag(base):
+    """poll()/reduced give a consumer thread per-bucket completion
+    visibility before wait(): nothing reduced while tiles are missing,
+    reduced as soon as the last tile drains."""
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 20, seed=7)
+    host = np.asarray(x)
+    pa = PartitionedAllreduce(base, x, tiles=2, tag=706)
+    pa.start()
+    assert not pa.poll() and not pa.reduced
+    pa.ready(0, host[:, : pa.tile_range(0)[1]])
+    pa.poll()
+    assert not pa.reduced                 # tile 1 still missing
+    lo, hi = pa.tile_range(1)
+    pa.ready(1, host[:, lo:hi])
+    deadline = time.time() + 30
+    while not pa.poll() and time.time() < deadline:
+        pass
+    assert pa.reduced
+    np.testing.assert_allclose(
+        np.asarray(pa.wait()), np.asarray(base.allreduce(x)), rtol=1e-6)
+
+
+# -- DpOverlapSession -------------------------------------------------------
+
+def _template(base, sizes):
+    rng = np.random.default_rng(11)
+    return {
+        f"p{i}": base.put_rank_major(
+            rng.standard_normal((base.size, n)).astype(np.float32))
+        for i, n in enumerate(sizes)
+    }
+
+
+def test_plan_partition_never_straddles_buckets(base):
+    """The re-blocking invariant the ISSUE names: every leaf piece maps
+    inside exactly one bucket, piece offsets tile the bucket exactly,
+    and each bucket is ONE partitioned request — so no partition (tile)
+    can straddle a bucketer fusion boundary by construction."""
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _template(base, [300, 500, 200, 700])
+    sess = DpOverlapSession(base, grads, bucket_bytes=2048,
+                            tile_bytes=512, progress_thread=False)
+    assert len(sess._pas) == len(sess.plan.buckets)
+    per_bucket: dict = {}
+    for leaf_id, pieces in sess.plan.leaf_pieces.items():
+        for pc in pieces:
+            assert 0 <= pc.bucket_lo < pc.bucket_hi \
+                <= sess.plan.buckets[pc.bucket].elems
+            per_bucket.setdefault(pc.bucket, []).append(
+                (pc.bucket_lo, pc.bucket_hi))
+    for b, spans in per_bucket.items():
+        spans.sort()
+        assert spans[0][0] == 0
+        for (alo, ahi), (blo, bhi) in zip(spans, spans[1:]):
+            assert ahi == blo            # gap- and overlap-free tiling
+        assert spans[-1][1] == sess.plan.buckets[b].elems
+        # the partitioned request covers THIS bucket exactly
+        assert sess._pas[b]._elems == sess.plan.buckets[b].elems
+
+
+def test_session_end_to_end_threaded_consumer(base):
+    """The training-step pipeline: a producer marks leaves in reverse
+    (backward) order while a consumer thread polls per-bucket completion
+    and 'applies' buckets as reductions land. The reassembled tree must
+    match the monolithic allreduce leaf-for-leaf, and the report's
+    overlap accounting must be sane."""
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _template(base, [400, 150, 600, 250])
+    sess = DpOverlapSession(base, grads, bucket_bytes=4096,
+                            tile_bytes=1024)
+    names = sorted(grads)
+    applied: list = []
+    for _ in range(2):                   # two steps: persistent re-arm
+        sess.begin_step()
+        del applied[:]
+        stop = threading.Event()
+
+        def consumer():
+            seen = set()
+            while not stop.is_set() or len(seen) < len(sess._pas):
+                for b in sess.poll():
+                    if b not in seen:
+                        seen.add(b)
+                        applied.append(b)
+                time.sleep(1e-3)
+
+        tc = threading.Thread(target=consumer)
+        tc.start()
+        for nm in reversed(names):
+            time.sleep(2e-3)
+            sess.mark_ready(nm, grads[nm])
+        out, rep = sess.finish()
+        stop.set()
+        tc.join(timeout=30)
+        assert sorted(applied) == list(range(len(sess._pas)))
+        assert 0.0 <= rep.overlap_pct <= 100.0
+        assert rep.exposed_comm_ms >= 0.0
+        assert rep.tiles == sum(pa.tiles for pa in sess._pas)
+        for nm in names:
+            np.testing.assert_allclose(
+                np.asarray(out[nm]),
+                np.asarray(base.allreduce(grads[nm])), rtol=1e-4,
+                atol=1e-5)
+
+
+def test_session_mark_slices_and_overlap_validation(base):
+    """Slice-granular marks: a leaf fed in chunks completes exactly
+    once; an overlapping or duplicate mark raises atomically (nothing
+    from the bad call staged or fired)."""
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _template(base, [512])
+    sess = DpOverlapSession(base, grads, bucket_bytes=1024,
+                            tile_bytes=256, progress_thread=False)
+    sess.begin_step()
+    host = np.asarray(grads["p0"])
+    with pytest.raises(ArgumentError):
+        sess.mark_ready("nosuch", host)
+    sess.mark_ready("p0", host[:, :200], slice=(0, 200))
+    with pytest.raises(RequestError):
+        # [100, 300) overlaps the already-marked [0, 200)
+        sess.mark_ready("p0", host[:, 100:300], slice=(100, 300))
+    with pytest.raises(RequestError):
+        sess.mark_ready("p0", host[:, :200], slice=(0, 200))
+    sess.mark_ready("p0", host[:, 200:], slice=(200, 512))
+    out, _ = sess.finish()
+    np.testing.assert_allclose(
+        np.asarray(out["p0"]),
+        np.asarray(base.allreduce(grads["p0"])), rtol=1e-4, atol=1e-5)
+    with pytest.raises(RequestError):
+        sess.mark_ready("p0", host)      # no step open
+
+
+def test_session_finish_with_unready_tiles_raises(base):
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _template(base, [128, 128])
+    sess = DpOverlapSession(base, grads, bucket_bytes=512,
+                            tile_bytes=256, progress_thread=False)
+    sess.begin_step()
+    sess.mark_ready("p0", grads["p0"])
+    with pytest.raises(RequestError):
+        sess.finish()
+
+
+# -- traced-side capture ----------------------------------------------------
+
+def test_grad_marker_captures_backward_order(base):
+    import jax
+    import jax.numpy as jnp
+
+    from ompi_tpu.parallel import overlap as ovl
+
+    ovl.reset_capture()
+
+    def loss(ws, x):
+        h = x
+        for i in range(3):
+            h = ovl.grad_marker(h, f"l{i}")
+            h = jnp.tanh(h * ws[i])
+        return jnp.sum(h)
+
+    # argnums includes x so no marker's bwd rule is dead-code-eliminated
+    jax.grad(loss, argnums=(0, 1))(
+        [jnp.float32(1.0)] * 3, jnp.ones((4,), jnp.float32))
+    assert ovl.backward_order() == ("l2", "l1", "l0")
+
+    sched = ovl.capture_ready_schedule({"a": 1, "b": 2})
+    assert sched == {"a": 1, "b": 2}     # pass-through
+    assert ovl.last_schedule() == {
+        "leaf_paths": ("['a']", "['b']"),
+        "bwd_order": ("l2", "l1", "l0"),
+    }
+    ovl.reset_capture()
+    assert ovl.backward_order() == ()
+    assert ovl.last_schedule() is None
+
+
+# -- overlapready lint rule -------------------------------------------------
+
+def test_overlapready_rule_fires_evidence_and_allow(tmp_path):
+    from ompi_tpu.analysis import lint
+
+    par = tmp_path / "parallel"
+    par.mkdir()
+    (par / "bad.py").write_text(textwrap.dedent("""
+        def sync_gradients(comm, grads):
+            return comm.allreduce(grads)
+    """))
+    (par / "good.py").write_text(textwrap.dedent("""
+        def sync_gradients(comm, sess, grads):
+            for nm, g in grads.items():
+                sess.mark_ready(nm, g)
+            return comm.allreduce(meta_only)
+    """))
+    (par / "allowed.py").write_text(textwrap.dedent("""
+        def backward_reduce(comm, grads):
+            # tiny tree, knowingly blocking
+            return comm.allreduce(grads)  # commlint: allow(overlapready)
+    """))
+    (par / "notgrad.py").write_text(textwrap.dedent("""
+        def broadcast_params(comm, params):
+            return comm.allreduce(params)
+    """))
+    other = tmp_path / "coll"
+    other.mkdir()
+    (other / "elsewhere.py").write_text(textwrap.dedent("""
+        def mean_gradients(comm, grads):
+            return comm.allreduce(grads)
+    """))
+    rep = lint.lint_tree(str(tmp_path), select="overlapready")
+    paths = [f.path for f in rep.findings]
+    assert any("bad.py" in p for p in paths)
+    assert not any("good.py" in p for p in paths)
+    assert not any("allowed.py" in p for p in paths)
+    assert not any("notgrad.py" in p for p in paths)    # not grad-named
+    assert not any("elsewhere.py" in p for p in paths)  # path-scoped
+
+
+def test_overlapready_registered_and_selfcheck_clean():
+    from ompi_tpu.analysis import lint
+    from ompi_tpu.analysis.rules import ensure_rules, COMMLINT
+
+    ensure_rules()
+    assert "overlapready" in COMMLINT.component_names()
+    rep = lint.lint_tree(
+        os.path.join(HERE, "ompi_tpu"), select="overlapready")
+    assert not rep.findings, [
+        f"{f.path}:{f.line} {f.message}" for f in rep.findings]
+
+
+# -- per-tile commtrace evidence (2-rank merged Perfetto drill) -------------
+
+_RANK_PROG = """
+import os, sys
+import numpy as np
+import ompi_tpu
+from ompi_tpu.trace import recorder
+from ompi_tpu.core import config
+config.set("trace_base_dir", sys.argv[1])
+world = ompi_tpu.init()
+from ompi_tpu.parallel.overlap import DpOverlapSession
+rng = np.random.default_rng(5)
+grads = {
+    "w": world.put_rank_major(
+        rng.standard_normal((world.size, 96)).astype(np.float32)),
+    "b": world.put_rank_major(
+        rng.standard_normal((world.size, 32)).astype(np.float32)),
+}
+sess = DpOverlapSession(world, grads, bucket_bytes=256, tile_bytes=128,
+                        progress_thread=False)
+sess.begin_step()
+for nm in ("b", "w"):
+    sess.mark_ready(nm, grads[nm])
+sess.finish()
+ompi_tpu.finalize()
+"""
+
+
+def test_two_rank_part_spans_share_trace_ids(tmp_path):
+    """The ISSUE's checkable claim: per-tile part.ready spans are
+    visible in the merged Perfetto export of a 2-rank drill, tagged
+    with the owning collective's trace ID on BOTH ranks."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", XLA_FLAGS="")
+    for rank in (0, 1):
+        env["OMPI_TPU_TRACE_RANK"] = str(rank)
+        r = subprocess.run(
+            [sys.executable, "-c", _RANK_PROG, str(tmp_path)],
+            capture_output=True, text=True, timeout=240, cwd=HERE,
+            env=env,
+        )
+        assert r.returncode == 0, r.stderr[-2000:]
+    merged = tmp_path / "merged.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.trace",
+         "--dir", str(tmp_path), "-o", str(merged), "--timeline"],
+        capture_output=True, text=True, timeout=120, cwd=HERE,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    out = json.loads(merged.read_text())
+    ready = [e for e in out["traceEvents"]
+             if e.get("cat") == "part" and e["name"] == "part.ready"]
+    arrived = [e for e in out["traceEvents"]
+               if e.get("cat") == "part" and e["name"] == "part.arrived"]
+    assert ready and arrived
+    by_rank: dict = {}
+    for e in ready:
+        tile = (e["args"]["bucket"], e["args"]["tile"])
+        by_rank.setdefault(e["pid"], {})[tile] = e["args"]["trace_id"]
+    assert set(by_rank) == {0, 1}
+    # every tile's readiness span carries the SAME collective trace ID
+    # on both ranks (deterministic per-communicator derivation)
+    assert by_rank[0] == by_rank[1]
+    # arrivals share the ready spans' trace-ID namespace
+    ready_ids = set(by_rank[0].values())
+    assert {e["args"]["trace_id"] for e in arrived} <= ready_ids

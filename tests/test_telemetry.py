@@ -169,6 +169,23 @@ def test_prometheus_name_sanitization():
     assert "ompi_tpu_weird-" not in text
 
 
+def test_part_overlap_counters_guaranteed_in_live_exposition():
+    # The per-tile readiness counters must be scrapeable before the
+    # first overlapped step (an absent series and an idle overlap path
+    # are different facts to a dashboard).  Live SPC path only.
+    text = export.prometheus_text()
+    for series in ("ompi_tpu_part_tiles_ready_total",
+                   "ompi_tpu_part_overlap_window_coalesced_total"):
+        assert f"# TYPE {series} counter" in text
+        # present either at zero (guaranteed line) or with a live value
+        assert any(ln.startswith(f"{series} ")
+                   for ln in text.splitlines()), series
+    # hand-built registries stay byte-stable: no guaranteed lines
+    reg = counters.CounterRegistry()
+    cold = export.prometheus_text(reg, health={})
+    assert "part_tiles_ready_total" not in cold
+
+
 # -- JSON snapshot schema (satellite 3: round-trip) -------------------------
 
 def test_json_snapshot_roundtrip(tmp_path):
