@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import itertools
 import math
+import threading
 import time
 from contextlib import contextmanager
 from typing import Any, Callable
@@ -262,6 +263,13 @@ class PartitionedAllreduce:
             for r in self._peers
         }
         self._active = False
+        # Serializes tile accumulation: the producer thread combines its
+        # own contribution inside ready_range() while drain sweeps
+        # (progress callbacks, possibly on several threads — test()/
+        # test_all() pump the engine without the pumper lock) combine
+        # peer arrivals. RLock so a nested pump under _finish_reduce's
+        # bcast can never self-deadlock.
+        self._lock = threading.RLock()
         self._acc = None
         self._reduce_done = False
         self._result = None
@@ -407,25 +415,36 @@ class PartitionedAllreduce:
 
         lo = t * self.tile_elems
         v = np.asarray(vals, np.float64).reshape(-1)
-        # Unpadded-length ops only: the accumulator's pad region (the
-        # final tile's tail) stays zero from start() and is trimmed
-        # before use, so it never needs combining.
-        view = self._acc[lo: lo + v.size]
-        if self._have[t] == 0:
-            view[:] = v
-        else:
-            view[:] = self._op.np_reduce(view, v)
-        self._have[t] += 1
-        if self._have[t] == self._comm.size:
+        # The producer thread (ready_range's root contribution) and the
+        # drain side race here; the _have check-then-act and the
+        # _tiles_reduced tally must be atomic or a contribution — or
+        # the final count that fires _finish_reduce — is silently lost.
+        with self._lock:
+            # Unpadded-length ops only: the accumulator's pad region
+            # (the final tile's tail) stays zero from start() and is
+            # trimmed before use, so it never needs combining.
+            view = self._acc[lo: lo + v.size]
+            if self._have[t] == 0:
+                view[:] = v
+            else:
+                view[:] = self._op.np_reduce(view, v)
+            self._have[t] += 1
+            tile_done = self._have[t] == self._comm.size
+            if tile_done:
+                self._tiles_reduced += 1
+            all_done = tile_done and self._tiles_reduced == self.tiles
+        if tile_done:
             from ..trace import span as tspan
 
-            self._tiles_reduced += 1
             tspan.instant(
                 "part.arrived", cat="part", trace_id=self.trace_id,
                 tile=t, bucket=self.label, tag=self.tag,
             )
-            if self._tiles_reduced == self.tiles:
-                self._finish_reduce()
+        if all_done:
+            # Exactly one thread observes the final increment. The
+            # bcast runs OUTSIDE the lock so progress pumped under it
+            # never contends with a concurrent combiner.
+            self._finish_reduce()
 
     def _pump(self) -> int:
         """Progress callback: one drain sweep per peer, then integrate
@@ -441,8 +460,14 @@ class PartitionedAllreduce:
             mine = self._integrated[r]
             for t in range(self.tiles):
                 if arrived[t] and not mine[t]:
+                    # Claim under the lock: direct ENGINE.progress()
+                    # callers bypass the pumper lock, so two sweeps can
+                    # run concurrently — a tile must integrate once.
+                    with self._lock:
+                        if mine[t]:
+                            continue
+                        mine[t] = True
                     vals = self._decode_tile(rreq.partition_view(t))
-                    mine[t] = True
                     n += 1
                     self._combine(t, vals)
                     if self._reduce_done:
@@ -495,24 +520,43 @@ class PartitionedAllreduce:
                 f"wait() before ready() on tiles {missing}"
             )
         deadline = time.monotonic() + timeout
-        if not _progress.ENGINE.progress_until(
-                lambda: self._reduce_done, timeout=timeout):
-            raise RequestError(
-                f"partitioned allreduce {self.label}: tiles "
-                f"{self._tiles_reduced}/{self.tiles} reduced before "
-                f"timeout"
-            )
-        pend = list(self._sreqs.values()) + list(self._rreqs.values())
-        if not _progress.ENGINE.progress_until(
-                lambda: all(r._poll() or r.done for r in pend),
-                timeout=max(0.0, deadline - time.monotonic())):
-            raise RequestError(
-                f"partitioned allreduce {self.label}: sub-requests "
-                "incomplete at timeout"
-            )
+        try:
+            if not _progress.ENGINE.progress_until(
+                    lambda: self._reduce_done, timeout=timeout):
+                raise RequestError(
+                    f"partitioned allreduce {self.label}: tiles "
+                    f"{self._tiles_reduced}/{self.tiles} reduced before "
+                    f"timeout"
+                )
+            pend = list(self._sreqs.values()) + list(self._rreqs.values())
+            if not _progress.ENGINE.progress_until(
+                    lambda: all(r._poll() or r.done for r in pend),
+                    timeout=max(0.0, deadline - time.monotonic())):
+                raise RequestError(
+                    f"partitioned allreduce {self.label}: sub-requests "
+                    "incomplete at timeout"
+                )
+        finally:
+            # Success and timeout alike: the drain callback must never
+            # outlive the step (a leaked _pump registration pins the
+            # instance in the engine forever) and _active must drop so
+            # start() can re-arm once the fabric drains.
+            _progress.unregister(self._pump)
+            self._active = False
+        return self._result
+
+    def abort(self) -> None:
+        """Tear down an armed step without waiting for completion:
+        unregister the drain callback and deactivate so the instance is
+        reusable. Any in-flight wire traffic is abandoned to the fabric
+        and the step's partial reduction state discarded — re-arming via
+        start() is only safe once the persistent sub-requests have
+        drained to completion (DESIGN.md §20, abandoned-tile hazards).
+        No-op when no step is open."""
+        if not self._active:
+            return
         _progress.unregister(self._pump)
         self._active = False
-        return self._result
 
 
 def bucketed_allreduce(

@@ -162,6 +162,10 @@ class DpOverlapSession:
                     f"overlap session needs rank-major (size, ...) "
                     f"leaves, got shape {shape}"
                 )
+        # Full template shapes, kept separately from the plan's PER-RANK
+        # shapes: a 1-D (size,) leaf plans as a per-rank (1,) proxy, and
+        # reassembly must restore the original (size,) — not (size, 1).
+        self._template_shapes = [tuple(np.shape(l)) for l in leaves]
         per_rank = [
             jax.ShapeDtypeStruct(np.shape(l)[1:] or (1,),
                                  jnp.asarray(l).dtype)
@@ -405,28 +409,36 @@ class DpOverlapSession:
 
     def finish(self) -> tuple:
         """Backward pass over: wait out the tail, reassemble the reduced
-        pytree, and report the step's overlap accounting."""
+        pytree, and report the step's overlap accounting.
+
+        Unready tiles raise WITHOUT tearing anything down — the step
+        stays open, so the caller can mark the missing leaves and call
+        finish() again (or :meth:`abort_step` to give up). A reduction
+        failure (e.g. a bucket's wait timeout) tears the step down."""
         if not self._active:
             raise RequestError("finish() before begin_step()")
+        unfired = [
+            (b, t) for b, fired in enumerate(self._fired)
+            for t in range(len(fired)) if not fired[t]
+        ]
+        if unfired:
+            raise RequestError(
+                f"finish() with unready tiles {unfired[:8]} — every "
+                "gradient leaf must be mark_ready()'d (the step stays "
+                "open: mark the rest and finish() again, or "
+                "abort_step())"
+            )
         self._t_bwd_end = time.perf_counter()
         try:
-            unfired = [
-                (b, t) for b, fired in enumerate(self._fired)
-                for t in range(len(fired)) if not fired[t]
-            ]
-            if unfired:
-                raise RequestError(
-                    f"finish() with unready tiles {unfired[:8]} — "
-                    "every gradient leaf must be mark_ready()'d"
-                )
             self._drain_fire_q()
             reduced = [np.asarray(pa.wait()) for pa in self._pas]
-        finally:
-            if self._pump_thread is not None:
-                self._pump_stop.set()
-                self._pump_thread.join()
-                self._pump_thread = None
-                self._pump_stop = None
+        except BaseException:  # commlint: allow(broadexcept)
+            # cleanup-then-reraise: ANY reduction failure (timeout,
+            # revoke, interrupt) must not leak the pump thread or the
+            # buckets' progress callbacks
+            self.abort_step()
+            raise
+        self._stop_pump()
         self._active = False
         t_done = max(pa.t_reduce_done for pa in self._pas)
         t_first = min(pa.t_first_ready for pa in self._pas)
@@ -438,6 +450,27 @@ class DpOverlapSession:
             buckets=len(self._pas),
         )
         return self._reassemble(reduced), self._report
+
+    def abort_step(self) -> None:
+        """Tear down an open step without completing it: stop the pump
+        thread, abort every bucket's partitioned pair (dropping their
+        progress callbacks), and close the step so the session is not
+        left with a leaked callback or a live thread. In-flight wire
+        state is abandoned (DESIGN.md §20); re-arming this session is
+        only safe once the fabric has drained. No-op between steps."""
+        if not self._active:
+            return
+        self._stop_pump()
+        for pa in self._pas:
+            pa.abort()
+        self._active = False
+
+    def _stop_pump(self) -> None:
+        if self._pump_thread is not None:
+            self._pump_stop.set()
+            self._pump_thread.join()
+            self._pump_thread = None
+            self._pump_stop = None
 
     def last_report(self) -> Optional[OverlapReport]:
         return self._report
@@ -475,7 +508,7 @@ class DpOverlapSession:
                                           piece.bucket_hi]
                 )
             out_leaves.append(
-                jnp.asarray(flat.reshape((size,) + tuple(shape)))
+                jnp.asarray(flat.reshape(self._template_shapes[i]))
             )
         return jax.tree.unflatten(self.plan.treedef, out_leaves)
 

@@ -171,6 +171,75 @@ def test_partitioned_poll_and_reduced_flag(base):
         np.asarray(pa.wait()), np.asarray(base.allreduce(x)), rtol=1e-6)
 
 
+def test_partitioned_concurrent_pump_is_exact(base):
+    """The producer's root contribution (ready_range -> _combine) races
+    the drain sweep: hammer _pump from a second thread — deliberately
+    bypassing the engine's pumper lock, as direct progress() callers do
+    — while tiles are marked. A lost combine or a lost _tiles_reduced
+    increment shows up as a wrong sum or a wait() timeout."""
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 2048, seed=8)
+    host = np.asarray(x)
+    oracle = np.asarray(base.allreduce(x))
+    pa = PartitionedAllreduce(base, x, tiles=16, tag=720)
+    for _ in range(4):
+        pa.start()
+        stop = threading.Event()
+
+        def spin():
+            while not stop.is_set():
+                pa._pump()
+
+        th = threading.Thread(target=spin)
+        th.start()
+        try:
+            for t in range(pa.tiles):
+                lo, hi = pa.tile_range(t)
+                pa.ready(t, host[:, lo:hi])
+            got = np.asarray(pa.wait(timeout=30.0))
+        finally:
+            stop.set()
+            th.join()
+        np.testing.assert_allclose(got, oracle, rtol=1e-6)
+
+
+def test_partitioned_wait_timeout_unregisters_and_rearms(base):
+    """A wait() timeout must not leak the drain callback into the
+    progress engine or leave the pair un-rearmable: after the raise the
+    instance is inactive and unregistered, and once the abandoned wire
+    traffic drains, start() re-arms for an exact step."""
+    from ompi_tpu.core import progress as _progress
+    from ompi_tpu.coll.partitioned import PartitionedAllreduce
+
+    x = _rank_major(base, 64, seed=9)
+    host = np.asarray(x)
+    pa = PartitionedAllreduce(base, x, tiles=4, tag=721)
+    pa.start()
+    for t in range(pa.tiles):
+        lo, hi = pa.tile_range(t)
+        pa.ready(t, host[:, lo:hi])
+    orig = _progress.ENGINE.progress_until
+    _progress.ENGINE.progress_until = lambda *a, **k: False
+    try:
+        with pytest.raises(RequestError):
+            pa.wait(timeout=0.05)
+    finally:
+        _progress.ENGINE.progress_until = orig
+    assert not pa._active
+    assert pa._pump not in _progress.ENGINE._callbacks
+    # abandoned cycle drains through the fabric, then the pair re-arms
+    pend = list(pa._sreqs.values()) + list(pa._rreqs.values())
+    assert _progress.ENGINE.progress_until(
+        lambda: all(r._poll() or r.done for r in pend), timeout=30)
+    pa.start()
+    for t in range(pa.tiles):
+        lo, hi = pa.tile_range(t)
+        pa.ready(t, host[:, lo:hi])
+    np.testing.assert_allclose(
+        np.asarray(pa.wait()), np.asarray(base.allreduce(x)), rtol=1e-6)
+
+
 # -- DpOverlapSession -------------------------------------------------------
 
 def _template(base, sizes):
@@ -285,15 +354,80 @@ def test_session_mark_slices_and_overlap_validation(base):
 
 
 def test_session_finish_with_unready_tiles_raises(base):
+    """The unready-tiles error leaves the step OPEN: marking the
+    missing leaves and finishing again completes the step exactly —
+    the error must not brick the session or leak progress callbacks."""
     from ompi_tpu.parallel.overlap import DpOverlapSession
 
     grads = _template(base, [128, 128])
     sess = DpOverlapSession(base, grads, bucket_bytes=512,
-                            tile_bytes=256, progress_thread=False)
+                            tile_bytes=256, tag_base=860,
+                            progress_thread=False)
     sess.begin_step()
     sess.mark_ready("p0", grads["p0"])
     with pytest.raises(RequestError):
         sess.finish()
+    sess.mark_ready("p1", grads["p1"])       # step still open: recover
+    out, _ = sess.finish()
+    for nm in ("p0", "p1"):
+        np.testing.assert_allclose(
+            np.asarray(out[nm]),
+            np.asarray(base.allreduce(grads[nm])), rtol=1e-4, atol=1e-5)
+
+
+def test_session_abort_step_tears_down_cleanly(base):
+    """abort_step() on a half-marked step: the step closes, no bucket's
+    drain callback stays registered in the progress engine, and the
+    session reports no step open."""
+    from ompi_tpu.core import progress as _progress
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _template(base, [96, 96])
+    sess = DpOverlapSession(base, grads, bucket_bytes=512,
+                            tile_bytes=128, tag_base=880)
+    sess.begin_step()
+    sess.mark_ready("p0", grads["p0"])
+    sess.abort_step()
+    assert not sess._active
+    assert sess._pump_thread is None
+    for pa in sess._pas:
+        assert not pa._active
+        assert pa._pump not in _progress.ENGINE._callbacks
+    with pytest.raises(RequestError):
+        sess.finish()                        # no step open
+    sess.abort_step()                        # idempotent between steps
+
+
+def test_session_one_dim_leaf_keeps_template_shape(base):
+    """A rank-major (size,) leaf (per-rank scalar — e.g. a bias of one
+    element) must come back shaped (size,), not (size, 1): the reduced
+    pytree has to match the gradient template leaf-for-leaf or
+    elementwise optimizer updates silently broadcast."""
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    rng = np.random.default_rng(23)
+    grads = {
+        "scalar": base.put_rank_major(
+            rng.standard_normal((base.size,)).astype(np.float32)),
+        "w": base.put_rank_major(
+            rng.standard_normal((base.size, 40)).astype(np.float32)),
+    }
+    sess = DpOverlapSession(base, grads, bucket_bytes=256,
+                            tile_bytes=64, tag_base=900,
+                            progress_thread=False)
+    sess.begin_step()
+    sess.mark_ready("scalar", grads["scalar"])
+    sess.mark_ready("w", grads["w"])
+    out, _ = sess.finish()
+    assert np.shape(out["scalar"]) == (base.size,)
+    assert np.shape(out["w"]) == (base.size, 40)
+    host = np.asarray(grads["scalar"])
+    np.testing.assert_allclose(
+        np.asarray(out["scalar"]),
+        np.full(base.size, host.sum(), np.float32), rtol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(out["w"]),
+        np.asarray(base.allreduce(grads["w"])), rtol=1e-4, atol=1e-5)
 
 
 # -- traced-side capture ----------------------------------------------------
