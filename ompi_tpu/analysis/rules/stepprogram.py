@@ -1,0 +1,120 @@
+"""Step-program compilation rule.
+
+``stepprogram``: since the training step's comm became the sched
+compilation unit (coll/sched/stepprogram), code under ``parallel/``
+should bind ONE compiled step program and let its executor own the
+per-bucket collective flows — a Python loop constructing per-bucket
+collectives by hand recreates exactly the stitched-together shape the
+program compiler replaced: the autotuner can't see across buckets, the
+Pallas backend emits one kernel per bucket, and the step pays one
+progress callback and one broadcast tail per bucket.
+
+The rule flags ``for``/``while`` loops under ``parallel/`` whose body
+constructs a partitioned/bucketed collective flow
+(``PartitionedAllreduce``, ``BucketedAllreduce``, ``ShardedAllreduce``,
+``psend_init``/``precv_init`` pairs) when the enclosing scope shows no
+program-compilation evidence — an identifier mentioning
+``compile_step``, ``Program``, ``CompiledStep``, ``StepExecutor`` or
+``stepprogram`` (the compiled-step surface).
+
+Suppression: ``# commlint: allow(stepprogram)`` on the flagged
+construction call (or the loop's / enclosing function's first line),
+for loops that knowingly predate or sit outside the compiled-step path
+(bring-up shims, comparison arms).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule, call_name
+from .overlapready import _scope_walk
+
+#: Per-bucket collective flow constructors (the surface the step
+#: executor owns now).
+_CONSTRUCTORS = frozenset({
+    "PartitionedAllreduce", "BucketedAllreduce", "ShardedAllreduce",
+    "psend_init", "precv_init",
+})
+
+#: Identifier substrings that count as program-compilation evidence.
+_EVIDENCE_WORDS = (
+    "compile_step", "Program", "CompiledStep", "StepExecutor",
+    "stepprogram",
+)
+
+
+def _has_program_evidence(scope: ast.AST) -> bool:
+    for node in _scope_walk(scope):
+        for ident in _idents(node):
+            if any(w in ident for w in _EVIDENCE_WORDS):
+                return True
+    return False
+
+
+def _idents(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+    elif isinstance(node, (ast.Import, ast.ImportFrom)):
+        for alias in node.names:
+            yield alias.name
+
+
+@COMMLINT.register
+class StepProgramRule(LintRule):
+    NAME = "stepprogram"
+    PRIORITY = 46
+    DESCRIPTION = ("per-bucket collective construction loops under "
+                   "parallel/ should bind a compiled step program, not "
+                   "stitch collectives together in Python")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        rel = ctx.relpath.replace("\\", "/")
+        if "parallel/" not in rel:
+            return
+        # evidence scope: the enclosing function (or the module for
+        # top-level loops)
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        owner: dict = {}
+        for scope in scopes:
+            for node in _scope_walk(scope):
+                owner[id(node)] = scope
+        for loop in ast.walk(ctx.tree):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            builds = [
+                n for n in ast.walk(loop)
+                if isinstance(n, ast.Call)
+                and call_name(n) in _CONSTRUCTORS
+            ]
+            if not builds:
+                continue
+            scope = owner.get(id(loop), ctx.tree)
+            if _has_program_evidence(scope):
+                continue
+            lines = [loop.lineno]
+            if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                lines.append(scope.lineno)
+            if any(ctx.suppressed(ln, self.NAME) for ln in lines):
+                continue
+            for call in builds:
+                if ctx.suppressed(call.lineno, self.NAME):
+                    continue
+                yield self.finding(
+                    ctx, call,
+                    f"loop constructs {call_name(call)} per bucket with "
+                    "no compile_step/Program evidence in scope — the "
+                    "step's comm should compile to ONE sched program "
+                    "(coll/sched/stepprogram.compile_step) whose "
+                    "executor owns the per-bucket flows; bind a "
+                    "compiled step (or annotate commlint: "
+                    "allow(stepprogram))",
+                )

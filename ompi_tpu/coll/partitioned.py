@@ -185,7 +185,10 @@ class PartitionedAllreduce:
     def __init__(self, comm, like, op: Any = "sum", tiles: int = 8,
                  tag: int | None = None, root: int = 0,
                  allow_quant: bool | None = None,
-                 label: str | None = None) -> None:
+                 label: str | None = None,
+                 tile_elems: int | None = None,
+                 defer_bcast: bool = False,
+                 auto_pump: bool = True) -> None:
         import jax
         import numpy as np
 
@@ -213,6 +216,13 @@ class PartitionedAllreduce:
         self.tiles = max(1, min(int(tiles), self._elems))
         self._dtype = np.dtype(str(arr.dtype))
         self.label = label or f"cid{comm.cid}"
+        # Step-program executor hooks: a compiled step defers the
+        # per-bucket bcast (the executor fires ONE merged broadcast for
+        # the whole step) and owns a single merged drain callback
+        # instead of one engine registration per bucket.
+        self._defer_bcast = bool(defer_bcast)
+        self._auto_pump = bool(auto_pump)
+        self._local = None
 
         # Per-bucket wire tier under the normal tuned precedence.
         nbytes = self._elems * self._dtype.itemsize
@@ -228,8 +238,13 @@ class PartitionedAllreduce:
         # Uniform tile geometry over a padded element space. On the
         # quant wire a tile rounds up to a scale-block multiple, which
         # can leave trailing tiles empty — clamp the count so every
-        # tile owns at least one logical element.
-        et = math.ceil(self._elems / self.tiles)
+        # tile owns at least one logical element. A caller (the sharded
+        # ZeRO flow) may pin tile_elems so shard-local tiles stay
+        # aligned with the enclosing bucket's tile boundaries.
+        if tile_elems is not None:
+            et = max(1, min(int(tile_elems), self._elems))
+        else:
+            et = math.ceil(self._elems / self.tiles)
         if self.quant_wire:
             block = _quant._block_var.value
             et = block * math.ceil(et / block)
@@ -299,10 +314,12 @@ class PartitionedAllreduce:
         self._tiles_reduced = 0
         self._reduce_done = False
         self._result = None
+        self._local = None
         self.trace_id = tspan.coll_trace_id(self._comm.cid)
         self.t_first_ready = None
         self.t_reduce_done = None
-        _progress.register(self._pump)
+        if self._auto_pump:
+            _progress.register(self._pump)
         return self
 
     def tile_range(self, t: int) -> tuple[int, int]:
@@ -482,12 +499,26 @@ class PartitionedAllreduce:
 
         self.t_reduce_done = time.perf_counter()
         reduced = self._acc[: self._elems].astype(self._dtype)
+        if self._defer_bcast:
+            # Step-program mode: hold the root-local reduced buffer and
+            # let the owning executor broadcast every bucket of the step
+            # in ONE merged collective once all nodes finish.
+            self._local = reduced
+            self._reduce_done = True
+            return
         stacked = np.zeros((self._comm.size, self._elems), self._dtype)
         stacked[self._root] = reduced
         self._result = self._comm.bcast(jnp.asarray(stacked), self._root)
         # Flag AFTER the result lands: a concurrent waiter released by
         # this flag must never observe a half-built result.
         self._reduce_done = True
+
+    def local_reduced(self):
+        """Root-local reduced 1-D buffer (defer_bcast mode): the step
+        executor's input to the merged broadcast."""
+        if not self._reduce_done:
+            raise RequestError("local_reduced() before reduction done")
+        return self._local
 
     @property
     def reduced(self) -> bool:

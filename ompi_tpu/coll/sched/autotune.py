@@ -312,6 +312,159 @@ def tune(nranks: int, *, comm=None, opname: str = "allreduce",
     return out
 
 
+# ---------------------------------------------------------------------------
+# program-level choices (the step as the compilation unit)
+# ---------------------------------------------------------------------------
+
+SPC.counter(
+    "sched_program_tile_overrides_total",
+    "bucket tile geometries taken from the winner cache instead of "
+    "the static default when compiling a step program",
+)
+SPC.counter(
+    "sched_program_compiles_total",
+    "whole-step comm programs compiled",
+)
+
+#: Power-of-two tile-size sweep for the per-bucket geometry model.
+PROGRAM_TILE_CANDIDATES = (64 << 10, 128 << 10, 256 << 10, 512 << 10,
+                           1 << 20)
+
+#: Per-tile dispatch cost vs per-byte tail-exposure cost (relative
+#: units, host transport): every tile pays a stage + Pready burst +
+#: drain sweep, while a larger final tile only lengthens the exposed
+#: tail — so the model leans toward few large tiles and the overlap
+#: granularity stays bucket-level.
+_PROG_TILE_A = 6000.0   # per tile
+_PROG_TILE_B = 0.02     # per byte of tile exposure
+
+#: RS/AG-vs-allreduce decision: gather-to-root pays one persistent
+#: pair per peer and the full bucket through the root's wire; the
+#: ZeRO-style split pays n× the pair setup but 1/n of the per-root
+#: wire. Crossover ~ _PROG_PAIR_GAMMA·n/_PROG_WIRE_BETA bytes.
+_PROG_PAIR_GAMMA = 4000.0  # per persistent pair armed per step
+_PROG_WIRE_BETA = 1e-3     # per bucket byte through one root
+
+
+def program_tile_bytes(nbytes: int, nranks: int, seed: int) -> int:
+    """Deterministic model winner for one bucket's tile size: argmin
+    over the power-of-two sweep of per-tile dispatch cost plus tail
+    exposure, seed-jittered for stable tie-breaks (crc32, not hash())."""
+    best, best_cost = PROGRAM_TILE_CANDIDATES[0], float("inf")
+    for t in PROGRAM_TILE_CANDIDATES:
+        tiles = max(1, -(-int(nbytes) // t))
+        cost = (_PROG_TILE_A * tiles + _PROG_TILE_B * min(t, nbytes)
+                + zlib.crc32(f"{seed}:tile:{t}".encode()) % 997 * 1e-9)
+        if cost < best_cost:
+            best, best_cost = t, cost
+    return best
+
+
+def program_node_choice(nbytes: int, nranks: int, seed: int) -> str:
+    """'allreduce' (gather-to-root + merged bcast) vs 'rs_ag' (ZeRO-
+    style reduce-scatter + allgather pair) for one bucket, by the
+    pair-setup/root-wire cost model."""
+    n = max(2, nranks)
+    cost_ar = (_PROG_PAIR_GAMMA * (n - 1)
+               + _PROG_WIRE_BETA * nbytes * (n - 1)
+               + zlib.crc32(f"{seed}:ar".encode()) % 997 * 1e-9)
+    cost_rs = (_PROG_PAIR_GAMMA * n * (n - 1)
+               + _PROG_WIRE_BETA * nbytes * (n - 1) / n
+               + zlib.crc32(f"{seed}:rs".encode()) % 997 * 1e-9)
+    return "allreduce" if cost_ar <= cost_rs else "rs_ag"
+
+
+def program_choices(bucket_nbytes: Sequence[int], nranks: int, *,
+                    dtypes: Optional[Sequence] = None,
+                    seed: Optional[int] = None,
+                    topo_fp: Optional[str] = None,
+                    tile_bytes=None,
+                    node_choices: Optional[Sequence] = None) -> list:
+    """Program-level search for one training step: per bucket, the
+    tile geometry (caller > winner cache > model, in that precedence),
+    the RS/AG-vs-allreduce schedule decision, and the cross-bucket
+    interleave rank. Deterministic for a fixed (buckets, nranks, seed,
+    cache state) — these choices feed the program digest, so same-seed
+    controllers must compute byte-identical answers.
+
+    Returns one dict per bucket: {"choice", "tile_bytes",
+    "tile_source", "interleave"} where interleave is the bucket's arm
+    position (biggest buckets first — their wire time is the hardest
+    to hide, so they enter the fabric earliest).
+    """
+    seed = _seed_var.value if seed is None else seed
+    if topo_fp is None:
+        topo_fp = fingerprint()
+    sizes = [int(b) for b in bucket_nbytes]
+    out: list[dict] = []
+    for i, nbytes in enumerate(sizes):
+        dtype = (dtypes[i] if dtypes is not None else "float32")
+        if tile_bytes is not None:
+            tb = (tile_bytes[i] if isinstance(tile_bytes, (list, tuple))
+                  else tile_bytes)
+            tb, src = int(tb), "caller"
+        else:
+            ent = _cache.CACHE.get(_cache.cache_key(
+                "allreduce", nbytes, nranks, dtype, topo_fp))
+            if ent and ent.get("tile_bytes"):
+                tb, src = int(ent["tile_bytes"]), "cache"
+                SPC.record("sched_program_tile_overrides_total")
+            else:
+                tb, src = program_tile_bytes(nbytes, nranks, seed), "model"
+        if node_choices is not None and node_choices[i]:
+            choice = str(node_choices[i])
+        else:
+            choice = program_node_choice(nbytes, nranks, seed)
+        out.append({"choice": choice, "tile_bytes": tb,
+                    "tile_source": src, "interleave": i})
+    # Cross-bucket interleave: arm biggest-first, index as tie-break
+    # (stable and seed-independent so the order never fights the
+    # digest contract).
+    order = sorted(range(len(sizes)), key=lambda i: (-sizes[i], i))
+    for pos, i in enumerate(order):
+        out[i]["interleave"] = pos
+    return out
+
+
+def tune_step(nranks: int, bucket_nbytes: Sequence[int], *,
+              dtype="float32", seed: Optional[int] = None,
+              topo_fp: Optional[str] = None, save: bool = False) -> dict:
+    """Persist model-mode tile-geometry winners for a step's bucket
+    sizes into the winner cache (the program-level analog of tune()):
+    later compile_step calls on any same-seed controller pick these
+    entries up as 'cache'-sourced overrides. Existing algorithm
+    winners on a key are preserved (tile_bytes rides the entry)."""
+    from ...trace import span as tspan
+
+    seed = _seed_var.value if seed is None else seed
+    if topo_fp is None:
+        topo_fp = fingerprint()
+    keys = []
+    for nbytes in {int(b) for b in bucket_nbytes}:
+        key = _cache.cache_key("allreduce", nbytes, nranks, dtype,
+                               topo_fp)
+        tb = program_tile_bytes(nbytes, nranks, seed)
+        ent = _cache.CACHE.get(key)
+        if ent is None:
+            _cache.CACHE.put(key, "native", source="model",
+                             tile_bytes=tb)
+        else:
+            _cache.CACHE.put(
+                key, ent["algorithm"],
+                schedule=ent.get("schedule", ""),
+                source=ent.get("source", "model"),
+                tile_bytes=tb)
+        tspan.instant("sched.tune_step_tile", cat="sched", key=key,
+                      tile_bytes=tb, seed=seed)
+        keys.append(key)
+    out = {"keys": sorted(keys), "seed": seed, "topo_fp": topo_fp,
+           "digest": _cache.CACHE.digest(), "path": None}
+    if save and keys:
+        out["path"] = _cache.CACHE.save(
+            _cache.default_path(topo_fp, nranks))
+    return out
+
+
 #: sched_* algorithm name -> ir generator name.
 SCHED_GENERATOR = {
     "sched_ring": "ring",
@@ -361,6 +514,8 @@ def reset_fingerprint() -> None:
 
 
 __all__ = [
-    "DEFAULT_SIZES", "candidates", "fingerprint", "model_cost",
-    "measure_cost", "reset_fingerprint", "tune",
+    "DEFAULT_SIZES", "PROGRAM_TILE_CANDIDATES", "candidates",
+    "fingerprint", "model_cost", "measure_cost", "program_choices",
+    "program_node_choice", "program_tile_bytes", "reset_fingerprint",
+    "tune", "tune_step",
 ]

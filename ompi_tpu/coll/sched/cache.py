@@ -113,9 +113,12 @@ class ScheduleCache:
             source: str = "autotune", tune_ms: Optional[float] = None,
             score: Optional[float] = None,
             frontier: Optional[list] = None,
-            baseline_p50_us: Optional[float] = None) -> None:
+            baseline_p50_us: Optional[float] = None,
+            tile_bytes: Optional[int] = None) -> None:
         ent = {"algorithm": algorithm, "schedule": schedule,
                "source": source, "version": 1}
+        if tile_bytes is not None:
+            ent["tile_bytes"] = int(tile_bytes)
         if tune_ms is not None:
             ent["tune_ms"] = round(float(tune_ms), 3)
         if score is not None:
@@ -132,7 +135,8 @@ class ScheduleCache:
              source: str = "retune", tune_ms: Optional[float] = None,
              score: Optional[float] = None,
              frontier: Optional[list] = None,
-             baseline_p50_us: Optional[float] = None) -> int:
+             baseline_p50_us: Optional[float] = None,
+             tile_bytes: Optional[int] = None) -> int:
         """Install a new winner as a **version-bumped** entry: the
         prior winner survives one level deep under ``"previous"`` so a
         bad retune can be rolled back. Never mutates the old entry in
@@ -141,6 +145,8 @@ class ScheduleCache:
         invalidates. Returns the new version number."""
         new = {"algorithm": algorithm, "schedule": schedule,
                "source": source}
+        if tile_bytes is not None:
+            new["tile_bytes"] = int(tile_bytes)
         if tune_ms is not None:
             new["tune_ms"] = round(float(tune_ms), 3)
         if score is not None:
@@ -154,6 +160,11 @@ class ScheduleCache:
             if old is None:
                 new["version"] = 1
             else:
+                # a retune must not silently drop the step-program tile
+                # geometry tuned onto this key: carry it forward unless
+                # the bump supplies a fresh one
+                if "tile_bytes" in old and "tile_bytes" not in new:
+                    new["tile_bytes"] = old["tile_bytes"]
                 new["version"] = int(old.get("version", 1)) + 1
                 new["previous"] = {
                     "algorithm": old.get("algorithm", ""),
@@ -237,7 +248,13 @@ class ScheduleCache:
                 "entries": {
                     k: {"algorithm": e["algorithm"],
                         "schedule": e.get("schedule", ""),
-                        "version": int(e.get("version", 1))}
+                        "version": int(e.get("version", 1)),
+                        # semantic only when tuned: program tile
+                        # geometry changes what executes, so it joins
+                        # the digest — but only when present, keeping
+                        # pre-program caches' digests byte-stable
+                        **({"tile_bytes": int(e["tile_bytes"])}
+                           if "tile_bytes" in e else {})}
                     for k, e in sorted(self._entries.items())
                 },
             }

@@ -285,6 +285,33 @@ def reduce_scatter(nranks: int,
     return sched
 
 
+def allgather(nranks: int,
+              order: Optional[Sequence[int]] = None) -> Schedule:
+    """The allgather phase of the ring on its own: n-1 rounds over n
+    chunks, starting from the reduce_scatter ownership convention
+    (rank order[p] owns fully-reduced chunk order[p]). After the last
+    round every rank holds all n chunks — the second half of a
+    ZeRO-style RS/AG pair."""
+    order = _order_or_identity(nranks, order)
+    n = nranks
+    steps: list[Step] = []
+    for k in range(n - 1):
+        for p in range(n):
+            succ = order[(p + 1) % n]
+            pred = order[(p - 1) % n]
+            steps.append(Step(k, "send", order[p], succ,
+                              order[(p - k) % n]))
+            steps.append(Step(k, "copy", order[p], pred,
+                              order[(p - k - 1) % n]))
+    sched = Schedule(
+        name="allgather", op="allgather", nranks=nranks,
+        nchunks=nranks, steps=tuple(steps),
+        meta={"tier": "device", "lowering": "interpret", "order": order},
+    )
+    check(sched)
+    return sched
+
+
 def with_lowering(sched: Schedule, lowering: str, **meta) -> Schedule:
     """The same step program under a different lowering directive (and
     optional extra meta). The digest changes with it — a pallas-lowered
@@ -374,6 +401,119 @@ def quantized_wire(nranks: int, wire: str = "int8", block: int = 128,
     return sched
 
 
+# ---------------------------------------------------------------------------
+# multi-collective programs
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class ProgramNode:
+    """One named sub-collective of a step Program.
+
+    ``deps`` are names of other nodes whose completion gates this
+    node's start — the readiness-dependency edge set the overlap
+    executor honors (a ZeRO allgather depends on its reduce-scatter;
+    a bucket allreduce depends on nothing but its own gradient tiles).
+    """
+
+    name: str
+    schedule: Schedule
+    deps: tuple = ()
+
+    def render(self) -> str:
+        dep = ",".join(self.deps) if self.deps else "-"
+        head = f"node {self.name} deps={dep}"
+        body = "\n".join("  " + ln
+                         for ln in self.schedule.render().splitlines())
+        return f"{head}\n{body}"
+
+
+@dataclass(frozen=True)
+class Program:
+    """A whole-step communication program: named sub-collectives with
+    explicit readiness dependencies between them (GC3's compilation
+    unit lifted from one collective to the training step). ``meta``
+    carries program-level compile decisions (per-node tile bytes,
+    interleave order, RS/AG-vs-allreduce choices) so they reach the
+    digest — two programs with the same nodes but different tile
+    geometry are different compiled artifacts."""
+
+    name: str
+    nranks: int
+    nodes: tuple = ()
+    meta: dict = field(default_factory=dict)
+
+    def node(self, name: str) -> ProgramNode:
+        for n in self.nodes:
+            if n.name == name:
+                return n
+        raise KeyError(name)
+
+    def render(self) -> str:
+        head = (f"program {self.name} nranks={self.nranks} "
+                f"nodes={len(self.nodes)}")
+        extra = " ".join(f"{k}={self.meta[k]}"
+                         for k in sorted(self.meta)
+                         if isinstance(self.meta[k],
+                                       (str, int, float, bool)))
+        if extra:
+            head = f"{head} {extra}"
+        return "\n".join([head] + [n.render() for n in self.nodes])
+
+    def digest(self) -> str:
+        return hashlib.sha256(self.render().encode()).hexdigest()[:16]
+
+
+def check_program(prog: Program) -> None:
+    """Program well-formedness: every sub-schedule checks, node names
+    are unique, dependency edges resolve to earlier-declared or
+    existing nodes, the dep graph is acyclic, and all nodes agree on
+    the rank count."""
+    names: set[str] = set()
+    for node in prog.nodes:
+        if node.name in names:
+            raise ScheduleError(f"duplicate program node {node.name!r}")
+        names.add(node.name)
+        if node.schedule.nranks != prog.nranks:
+            raise ScheduleError(
+                f"node {node.name!r} nranks={node.schedule.nranks} "
+                f"!= program nranks={prog.nranks}")
+        check(node.schedule)
+    for node in prog.nodes:
+        for d in node.deps:
+            if d not in names:
+                raise ScheduleError(
+                    f"node {node.name!r} depends on unknown node {d!r}")
+            if d == node.name:
+                raise ScheduleError(f"node {node.name!r} depends on itself")
+    # cycle check: iteratively peel nodes whose deps are all peeled
+    remaining = {n.name: set(n.deps) for n in prog.nodes}
+    while remaining:
+        ready = [k for k, deps in remaining.items()
+                 if not deps & set(remaining)]
+        if not ready:
+            raise ScheduleError(
+                f"dependency cycle among program nodes: "
+                f"{sorted(remaining)}")
+        for k in ready:
+            del remaining[k]
+
+
+def zero_pair(name: str, nranks: int,
+              order: Optional[Sequence[int]] = None
+              ) -> tuple[ProgramNode, ProgramNode]:
+    """A ZeRO-style reduce-scatter + allgather node pair: ``<name>.rs``
+    reduces shard order[p] onto rank order[p], ``<name>.ag`` (gated on
+    the rs) circulates the reduced shards back out. Together they move
+    the same bytes as a ring allreduce but expose the shard-owner
+    boundary as a schedulable dependency edge."""
+    rs = ProgramNode(name=f"{name}.rs",
+                     schedule=reduce_scatter(nranks, order=order))
+    ag = ProgramNode(name=f"{name}.ag",
+                     schedule=allgather(nranks, order=order),
+                     deps=(f"{name}.rs",))
+    return rs, ag
+
+
 #: Generator registry for the CLI (`tools/sched dump --name ...`).
 GENERATORS = {
     "ring": ring,
@@ -382,6 +522,7 @@ GENERATORS = {
     "hierarchical": hierarchical,
     "quantized_wire": quantized_wire,
     "reduce_scatter": reduce_scatter,
+    "allgather": allgather,
 }
 
 
@@ -402,14 +543,15 @@ def generate(name: str, nranks: int, **params) -> Schedule:
     if name == "quantized_wire":
         return gen(nranks, params.get("wire", "int8"),
                    params.get("block", 128), order=params.get("order"))
-    if name in ("ring", "reduce_scatter"):
+    if name in ("ring", "reduce_scatter", "allgather"):
         return gen(nranks, order=params.get("order"))
     return gen(nranks)
 
 
 __all__ = [
-    "ANNOTATIONS", "GENERATORS", "KINDS", "Schedule", "ScheduleError",
-    "Step", "check", "generate", "hierarchical", "quantized_wire",
+    "ANNOTATIONS", "GENERATORS", "KINDS", "Program", "ProgramNode",
+    "Schedule", "ScheduleError", "Step", "allgather", "check",
+    "check_program", "generate", "hierarchical", "quantized_wire",
     "recursive_doubling", "reduce_scatter", "ring", "segmented_ring",
-    "with_lowering",
+    "with_lowering", "zero_pair",
 ]

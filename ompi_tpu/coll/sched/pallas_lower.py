@@ -57,12 +57,13 @@ from typing import Callable
 import numpy as np
 
 from ...core.errors import ArgumentError
-from .ir import ANNOTATIONS, Schedule
+from .ir import ANNOTATIONS, Schedule, Step, check as _check
 
 #: collective_id namespace: 0-11 belong to the hand-written coll
 #: kernels (pallas_ring, pallas_shift, quant, ...); the sched compiler
-#: owns 12 (allreduce programs) and 13 (reduce-scatter programs).
-_COLLECTIVE_ID = {"allreduce": 12, "reduce_scatter": 13}
+#: owns 12 (allreduce programs), 13 (reduce-scatter programs) and
+#: 14 (allgather programs — the AG half of a ZeRO-style step node).
+_COLLECTIVE_ID = {"allreduce": 12, "reduce_scatter": 13, "allgather": 14}
 
 #: compiled-wrapper memo keyed by schedule digest (kernel analysis is
 #: pure python; jit caching happens downstream in compile_plan).
@@ -169,10 +170,69 @@ def analyze(sched: Schedule) -> _Program:
                     f"schedule {sched.name!r}: rank {k} never receives "
                     f"chunks {sorted(set(range(sched.nchunks)) - seen[k])}"
                     f" — the output would be partial")
+    if sched.op == "allgather":
+        # A rank never receives its own chunk: it reaches the output at
+        # the stage/re-stage rounds instead, so completeness is
+        # received ∪ staged.
+        for k in range(n):
+            own = {int(t_schunk[r, k]) for r in range(rounds) if brk[r]}
+            missing = set(range(sched.nchunks)) - (seen[k] | own)
+            if missing:
+                raise ArgumentError(
+                    f"schedule {sched.name!r}: rank {k} neither receives"
+                    f" nor stages chunks {sorted(missing)} — the output "
+                    f"would be partial")
     return _Program(op=sched.op, nranks=n, nchunks=sched.nchunks,
                     rounds=rounds, mode=tuple(mode), last=tuple(last),
                     brk=tuple(brk), t_dst=t_dst, t_src=t_src,
                     t_schunk=t_schunk, t_rchunk=t_rchunk)
+
+
+def fuse_schedules(name: str, scheds) -> Schedule:
+    """Chain same-op, same-rank-count dense schedules into ONE table
+    program: member i's chunks occupy the id range ``[base_i, base_i +
+    nchunks_i)`` and its rounds follow member i-1's. The first round of
+    each member is a segment boundary — every rank re-stages a chunk it
+    has never received, exactly ``segmented_ring``'s structure, which
+    ``analyze`` already accepts as a chain-break re-stage — so a whole
+    step program's worth of ring collectives compiles to a single
+    fused kernel instead of one per bucket.
+
+    Reduce-scatter members are rejected: the RS kernel's output
+    contract is one chunk per rank, which a multi-segment table would
+    silently violate.
+    """
+    scheds = list(scheds)
+    if not scheds:
+        raise ArgumentError("fuse_schedules needs at least one schedule")
+    op, n = scheds[0].op, scheds[0].nranks
+    if op == "reduce_scatter":
+        raise ArgumentError(
+            "fuse_schedules: reduce_scatter programs keep per-node "
+            "kernels (single-chunk output contract)")
+    for s in scheds:
+        if s.op != op or s.nranks != n:
+            raise ArgumentError(
+                f"fuse_schedules: member {s.name!r} is "
+                f"(op={s.op!r}, nranks={s.nranks}), group is "
+                f"(op={op!r}, nranks={n})")
+    steps: list[Step] = []
+    chunk_base = round_base = 0
+    for s in scheds:
+        for st in s.steps:
+            steps.append(Step(st.round + round_base, st.kind, st.rank,
+                              st.peer, st.chunk + chunk_base))
+        chunk_base += s.nchunks
+        round_base += s.rounds()
+    fused = Schedule(
+        name=name, op=op, nranks=n, nchunks=chunk_base,
+        steps=tuple(steps),
+        meta={"tier": "device_pallas", "lowering": "pallas",
+              "segments": len(scheds)},
+    )
+    _check(fused)
+    analyze(fused)  # enforce the dense/chained/round-uniform contract
+    return fused
 
 
 def compile_schedule(sched: Schedule) -> Callable:
@@ -234,6 +294,12 @@ def simulate(sched, data, op):
         if r >= 1 and prog.brk[r]:
             for k in range(n):
                 comm[k][slot] = data[k, int(prog.t_schunk[r, k])]
+        if prog.op == "allgather" and prog.brk[r]:
+            # Own chunk never travels: it reaches the output at the
+            # stage round, mirroring the kernel's out-write.
+            for k in range(n):
+                c = int(prog.t_schunk[r, k])
+                out[k][c] = data[k, c]
         # All round-r sends read their source slot before any round-r
         # arrival lands (the credit discipline guarantees this order on
         # device; here a snapshot does).
@@ -290,6 +356,10 @@ def _kernel(axis_name: str, op, prog: _Program,
                 # at round r-1 and the next remote write into it (round
                 # r+1) is still credit-gated, so a plain store is safe.
                 comm_buf[slot] = x_ref[t_schunk[r, me]]
+        if prog.op == "allgather" and prog.brk[r]:
+            # A rank's own chunk never travels the ring: the staged
+            # value IS its final value, written straight to the output.
+            out_ref[t_schunk[r, me]] = x_ref[t_schunk[r, me]]
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[slot],
             dst_ref=comm_buf.at[nslot],
@@ -417,4 +487,5 @@ def _make_wrapper(prog: _Program, name: str) -> Callable:
     return run
 
 
-__all__ = ["analyze", "clear_compiled", "compile_schedule", "simulate"]
+__all__ = ["analyze", "clear_compiled", "compile_schedule",
+           "fuse_schedules", "simulate"]

@@ -13,6 +13,24 @@ the installed jax.
 from __future__ import annotations
 
 
+def jaxpr_ordering_available() -> bool:
+    """True when this jax exposes the closed-jaxpr equation/outvar
+    surface (``make_jaxpr`` → ``.jaxpr.eqns`` / ``.jaxpr.outvars``)
+    that the overlap readiness capture derives gradient production
+    order from — jax's own scheduling of the compiled backward, the
+    same order its donation/effects machinery observes. Gated because
+    the jaxpr internals are not a stable API across jax versions."""
+    try:
+        import jax
+
+        closed = jax.make_jaxpr(lambda x: x * 2.0)(1.0)
+        return (hasattr(closed, "jaxpr")
+                and hasattr(closed.jaxpr, "eqns")
+                and hasattr(closed.jaxpr, "outvars"))
+    except Exception:  # commlint: allow(broadexcept)
+        return False
+
+
 def ensure() -> None:
     """Idempotent: install `jax.shard_map` / `jax.lax.axis_size` if
     this jax predates them."""

@@ -83,10 +83,26 @@ class OverlapPlan:
     treedef: Any
     leaf_shapes: list            # per-rank shapes
     leaf_dtypes: list
+    # Per-bucket tile geometry. plan_overlap seeds it from its
+    # tile_bytes argument; a session compiling a step program stamps
+    # the autotuned geometry (winner-cache override included) back
+    # here, so the plan always names the geometry that executes.
+    tiles: Optional[list] = None
+    tile_elems: Optional[list] = None
+    tile_sources: Optional[list] = None
+
+
+def _tile_geometry(elems: int, nbytes: int, tile_bytes: int) -> tuple:
+    """(tiles, tile_elems) for one bucket — the same uniform rounding
+    PartitionedAllreduce applies."""
+    tiles = max(1, min(-(-nbytes // max(1, tile_bytes)), elems))
+    te = -(-elems // tiles)
+    return -(-elems // te), te
 
 
 def plan_overlap(per_rank_leaves: list, treedef,
-                 bucket_bytes: Optional[int] = None) -> OverlapPlan:
+                 bucket_bytes: Optional[int] = None,
+                 tile_bytes: Optional[int] = None) -> OverlapPlan:
     """Build the overlap plan over PER-RANK leaves (shapes only). The
     bucket composition is exactly ``bucketer.plan_buckets`` — fusion
     boundaries are shared with the non-overlapped path."""
@@ -100,6 +116,9 @@ def plan_overlap(per_rank_leaves: list, treedef,
             )
             off += hi - lo
     paths = [f"leaf{i}" for i in range(len(per_rank_leaves))]
+    tb = _tile_bytes_var.value if tile_bytes is None else int(tile_bytes)
+    geom = [_tile_geometry(b.elems, b.elems * b.dtype.itemsize, tb)
+            for b in plans]
     return OverlapPlan(
         buckets=plans,
         leaf_pieces=pieces,
@@ -107,6 +126,9 @@ def plan_overlap(per_rank_leaves: list, treedef,
         treedef=treedef,
         leaf_shapes=[tuple(np.shape(l)) for l in per_rank_leaves],
         leaf_dtypes=[jnp.asarray(l).dtype for l in per_rank_leaves],
+        tiles=[g[0] for g in geom],
+        tile_elems=[g[1] for g in geom],
+        tile_sources=["default"] * len(plans),
     )
 
 
@@ -141,6 +163,17 @@ class DpOverlapSession:
 
     Leaves are rank-major ``(size, ...)`` buffers (the driver-model
     SPMD view, same convention as ``bucketer.allreduce_pytree``).
+
+    The session's comm is ONE compiled step program
+    (:func:`ompi_tpu.coll.sched.stepprogram.compile_step`): the bucket
+    list compiles into a multi-collective ``Program`` — per-bucket tile
+    geometry from the autotuner's precedence (explicit ``tile_bytes`` >
+    winner cache > model), RS/AG-vs-allreduce as a schedule decision
+    (pin per bucket via ``node_choices``), cross-bucket interleave —
+    and a :class:`~ompi_tpu.coll.sched.stepprogram.StepExecutor` binds
+    it to live transport. ``step_program=False`` drops back to the
+    PR 15 per-bucket behaviour (one broadcast and one progress
+    callback per bucket) — kept as the bench's comparison arm.
     """
 
     def __init__(self, comm, template: Any, op: Any = SUM,
@@ -148,8 +181,11 @@ class DpOverlapSession:
                  tile_bytes: Optional[int] = None,
                  allow_quant: Optional[bool] = None,
                  tag_base: int = 820,
-                 progress_thread: bool = True) -> None:
-        from ..coll.partitioned import PartitionedAllreduce
+                 progress_thread: bool = True,
+                 step_program: bool = True,
+                 node_choices: Optional[list] = None,
+                 seed: Optional[int] = None) -> None:
+        from ..coll.sched.stepprogram import StepExecutor, compile_step
 
         leaves, treedef = jax.tree.flatten(template)
         if not leaves:
@@ -184,20 +220,28 @@ class DpOverlapSession:
         }
         self._comm = comm
         self._op = op
-        tile_bytes = (_tile_bytes_var.value
-                      if tile_bytes is None else tile_bytes)
-        self._pas = []
-        self._stage: list = []
-        for b_idx, bucket in enumerate(self.plan.buckets):
-            nbytes = bucket.elems * bucket.dtype.itemsize
-            tiles = max(1, -(-nbytes // max(1, tile_bytes)))
-            like = np.zeros((size, bucket.elems), bucket.dtype)
-            self._pas.append(PartitionedAllreduce(
-                comm, like, op=op, tiles=tiles,
-                tag=tag_base + b_idx, allow_quant=allow_quant,
-                label=f"b{b_idx}",
-            ))
-            self._stage.append(like)
+        # Compile the step: the bucket list becomes one multi-
+        # collective Program, and its executor owns every per-bucket
+        # flow. Explicit tile_bytes wins; otherwise the autotuner
+        # consults the winner cache, then the model — never a static
+        # default.
+        self.compiled = compile_step(
+            size, [(b.elems, b.dtype) for b in self.plan.buckets],
+            tile_bytes=tile_bytes, seed=seed,
+            node_choices=node_choices)
+        self._exec = StepExecutor(
+            comm, self.compiled, op=op, allow_quant=allow_quant,
+            tag_base=tag_base, legacy=not step_program)
+        self._pas = self._exec.bindings
+        # Stamp the compiled geometry back into the plan so the plan
+        # names what executes (the winner-cache override regression
+        # hook).
+        self.plan.tiles = [n.tiles for n in self.compiled.nodes]
+        self.plan.tile_elems = [n.tile_elems for n in self.compiled.nodes]
+        self.plan.tile_sources = [n.tile_source
+                                  for n in self.compiled.nodes]
+        self._stage = [np.zeros((size, b.elems), b.dtype)
+                       for b in self.plan.buckets]
         self._covered = None
         self._fired = None
         self._active = False
@@ -218,15 +262,12 @@ class DpOverlapSession:
     # -- step lifecycle ---------------------------------------------------
 
     def begin_step(self) -> "DpOverlapSession":
-        """Re-arm every bucket's persistent pair (one dispatch window)
-        and reset tile coverage."""
-        from ..coll.partitioned import _batch_window
-
+        """Re-arm the compiled step program (every node flow, one
+        dispatch window, compiled interleave order) and reset tile
+        coverage."""
         if self._active:
             raise RequestError("begin_step() inside an open step")
-        with _batch_window():
-            for pa in self._pas:
-                pa.start()
+        self._exec.begin_step()
         self._covered = [
             np.zeros(pa.tiles, np.int64) for pa in self._pas
         ]
@@ -431,7 +472,7 @@ class DpOverlapSession:
         self._t_bwd_end = time.perf_counter()
         try:
             self._drain_fire_q()
-            reduced = [np.asarray(pa.wait()) for pa in self._pas]
+            reduced = [np.asarray(r) for r in self._exec.wait_all()]
         except BaseException:  # commlint: allow(broadexcept)
             # cleanup-then-reraise: ANY reduction failure (timeout,
             # revoke, interrupt) must not leak the pump thread or the
@@ -461,8 +502,7 @@ class DpOverlapSession:
         if not self._active:
             return
         self._stop_pump()
-        for pa in self._pas:
-            pa.abort()
+        self._exec.abort()
         self._active = False
 
     def _stop_pump(self) -> None:
@@ -592,3 +632,50 @@ def capture_ready_schedule(tree: Any) -> Any:
 
 def last_schedule() -> Optional[dict]:
     return _LAST_SCHEDULE
+
+
+# ---------------------------------------------------------------------------
+# Readiness order from jax's own program ordering (effects/donation)
+# ---------------------------------------------------------------------------
+
+def jaxpr_backward_order(grad_fn, *args) -> tuple:
+    """Gradient-leaf production order read off jax's OWN program order:
+    trace ``grad_fn`` (a function returning the gradient pytree) to a
+    jaxpr and rank each output leaf by the index of the equation that
+    produces it. ``eval_jaxpr`` executes equations in exactly this
+    order — it is the schedule jax's donation/effects machinery
+    sequences against — so leaf i ranking before leaf j means leaf i's
+    gradient materializes first in the compiled backward.
+
+    Returns leaf indices (into the flattened gradient pytree) in
+    production order. Requires
+    :func:`ompi_tpu.core.jax_compat.jaxpr_ordering_available`.
+    """
+    closed = jax.make_jaxpr(grad_fn)(*args)
+    jaxpr = closed.jaxpr
+    pos: dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.outvars:
+            pos[v] = i
+    ranks = []
+    for leaf_idx, v in enumerate(jaxpr.outvars):
+        # constants / passed-through inputs rank first (produced
+        # before any equation runs); Literal outputs have no var
+        ranks.append((pos.get(v, -1), leaf_idx))
+    return tuple(i for _, i in sorted(ranks))
+
+
+def readiness_order(grad_fn=None, args: tuple = ()) -> tuple:
+    """The overlap session's readiness source: ``("jaxpr", order)``
+    from jax's real program ordering when the installed jax exposes it
+    (jax_compat-gated), else ``("marker", backward_order())`` — the
+    custom-VJP :func:`grad_marker` capture. Both name the same thing:
+    the sequence gradients materialize in during the backward pass."""
+    from ..core import jax_compat
+
+    if grad_fn is not None and jax_compat.jaxpr_ordering_available():
+        try:
+            return ("jaxpr", jaxpr_backward_order(grad_fn, *args))
+        except Exception:  # commlint: allow(broadexcept)
+            pass  # fall back to the marker capture
+    return ("marker", backward_order())

@@ -144,6 +144,11 @@ GUARANTEED_COUNTERS = (
     ("part_overlap_window_coalesced_total",
      "Pready bursts whose transfers rode one fastpath batch-dispatch "
      "window"),
+    ("sched_program_tile_overrides_total",
+     "bucket tile geometries taken from the winner cache instead of "
+     "the static default when compiling a step program"),
+    ("sched_program_compiles_total",
+     "whole-step comm programs compiled"),
 )
 
 
