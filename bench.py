@@ -2960,6 +2960,144 @@ def _admission_eviction_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_FLEET_SIM_WORKER = r"""
+import json, os, sys, logging
+logging.disable(logging.WARNING)
+os.environ["JAX_PLATFORMS"] = "cpu"
+from ompi_tpu.sim import FleetSim, Scenario
+
+sc = Scenario.from_dict(json.loads(sys.argv[1]))
+rep = FleetSim(sc).run()
+rep.pop("digests", None)
+rep.pop("per_class", None)
+print("FLEETSIM " + json.dumps(rep, sort_keys=True))
+"""
+
+
+def _run_fleet_sim(scenario: dict, timeout: int = 420) -> dict:
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    here = os.path.dirname(os.path.abspath(__file__))
+    p = subprocess.run(
+        [sys.executable, "-c", _FLEET_SIM_WORKER,
+         json.dumps(scenario)],
+        capture_output=True, text=True, env=env, cwd=here,
+        timeout=timeout,
+    )
+    if p.returncode != 0:
+        return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+    for line in p.stdout.splitlines():
+        if line.startswith("FLEETSIM "):
+            return json.loads(line[len("FLEETSIM "):])
+    return {"error": "no FLEETSIM line"}
+
+
+def _fleet_sim_scale_row() -> dict:
+    """armada at pod scale: the chaos scenario (host loss + persistent
+    straggler + scavenger flood) over the REAL control planes at 1024
+    simulated ranks and >=100 tenants, offered 10k req/s through real
+    bulkhead admission under virtual time. Reports engine throughput
+    (events/s of wall), admission handle() throughput, lifeboat
+    recovery p50 across the tenant fleet, and watchtower retune
+    convergence (sampler ticks from first fault to last retune)."""
+    import os
+
+    try:
+        ranks = int(os.environ.get("OMPI_TPU_BENCH_SIM_RANKS", "1024"))
+        tenants = int(os.environ.get("OMPI_TPU_BENCH_SIM_TENANTS",
+                                     "100"))
+        rps = float(os.environ.get("OMPI_TPU_BENCH_SIM_RPS", "10000"))
+        duration = float(os.environ.get("OMPI_TPU_BENCH_SIM_DURATION",
+                                        "8"))
+        rep = _run_fleet_sim({
+            "name": "bench_scale", "seed": 1024, "nranks": ranks,
+            "duration_s": duration, "tenants": tenants,
+            "base_rps": rps, "pump_interval_s": 0.05,
+            "faults": [
+                # host h covers ranks 4h..4h+3: keep the lost host and
+                # the straggler rank disjoint or the straggler dies
+                # before it can straggle
+                {"at": duration * 0.25,
+                 "spec": f"host_loss@fleet:host={ranks // 16}"},
+                {"at": duration * 0.35,
+                 "spec": f"straggler@fleet:rank={ranks // 2},mult=8"},
+                {"at": duration * 0.5,
+                 "spec": "flood@daemon:rate=30,key=sub"},
+            ],
+        })
+        if "error" in rep:
+            return rep
+        return {
+            "ranks": rep["nranks"],
+            "tenants": rep["tenants"],
+            "virtual_s": rep["virtual_s"],
+            "wall_s": rep["wall_s"],
+            "events": rep["events"],
+            "events_per_s": rep["events_per_s"],
+            "offered_rps": rps,
+            "submits": rep["submits"],
+            "admits": rep["admits"],
+            "rejects": rep["rejects"],
+            "admission_handle_per_s": rep["admission_handle_per_s"],
+            "recoveries": rep["recoveries"],
+            "recovery_p50_ms": rep["recovery_p50_ms"],
+            "retunes": rep["retunes"],
+            "retune_convergence_ticks":
+                rep["retune_convergence_ticks"],
+            "world_size_after": rep["world_size"],
+            "pass": (rep["recoveries"] > 0 and rep["retunes"] > 0
+                     and rep["errors"] == 0),
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _fleet_sim_determinism_row() -> dict:
+    """The replay contract, proven the strong way: the same seeded
+    chaos scenario run in TWO separate subprocesses (fresh interpreter
+    state each) must produce byte-identical merged decision-log
+    digests — ledger transitions, watchtower decisions, lifeboat
+    epochs, daemon admissions, sched winners, faultline firings."""
+    import os
+
+    try:
+        ranks = int(os.environ.get("OMPI_TPU_BENCH_SIM_DET_RANKS",
+                                   "256"))
+        sc = {
+            "name": "bench_determinism", "seed": 7, "nranks": ranks,
+            "duration_s": 6.0, "tenants": 20, "base_rps": 400.0,
+            "faults": [
+                {"at": 1.5,
+                 "spec": f"host_loss@fleet:host={ranks // 16}"},
+                {"at": 2.0,
+                 "spec": f"straggler@fleet:rank={ranks // 2},mult=8"},
+                {"at": 2.5, "spec": "flood@daemon:rate=20,key=sub"},
+                {"at": 3.0, "spec": "quarantine@coll:tier=dcn,heal_s=1.5"},
+            ],
+        }
+        a = _run_fleet_sim(sc)
+        b = _run_fleet_sim(sc)
+        for rep in (a, b):
+            if "error" in rep:
+                return rep
+        match = a["digest"] == b["digest"]
+        return {
+            "ranks": ranks,
+            "runs": 2,
+            "digest_a": a["digest"],
+            "digest_b": b["digest"],
+            "digests_match": match,
+            "replay_match_ratio_x": 1.0 if match else 0.0,
+            "events": a["events"],
+            "pass": match,
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def _host_rows() -> dict:
     """Every host-side (tunnel-independent) row, each with r4
     comparison values where r4 measured the same thing. Cached: on
@@ -3045,6 +3183,10 @@ def _host_rows() -> dict:
     rows["tenant_isolation"] = _tenant_isolation_row()
     _set_phase("admission/eviction (reject -> retry-after -> admit)")
     rows["admission_eviction"] = _admission_eviction_row()
+    _set_phase("fleet sim at scale (1024 ranks, chaos scenario)")
+    rows["fleet_sim_scale"] = _fleet_sim_scale_row()
+    _set_phase("fleet sim determinism (two-subprocess replay)")
+    rows["fleet_sim_determinism"] = _fleet_sim_determinism_row()
     return rows
 
 

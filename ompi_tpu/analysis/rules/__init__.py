@@ -271,6 +271,7 @@ def ensure_rules() -> None:
         from . import retuneaudit  # noqa: F401
         from . import revokecheck  # noqa: F401
         from . import schedcutoff  # noqa: F401
+        from . import simclock  # noqa: F401
         from . import stepprogram  # noqa: F401
         from . import tags  # noqa: F401
         from . import tenantscope  # noqa: F401

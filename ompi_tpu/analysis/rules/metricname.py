@@ -43,8 +43,8 @@ KNOWN_PREFIXES = frozenset({
     "fp",
     "ft", "health", "hier", "init", "io", "memchecker", "monitoring",
     "mpit", "mtl", "nbc", "op", "osc", "parallel", "part", "pml",
-    "pmpi", "quant", "sanitizer", "sched", "shmem", "sm", "telemetry",
-    "topo", "trace", "vprotocol",
+    "pmpi", "quant", "sanitizer", "sched", "shmem", "sim", "sm",
+    "telemetry", "topo", "trace", "vprotocol",
 })
 
 _SNAKE = re.compile(r"^[a-z][a-z0-9]*(_[a-z0-9]+)*$")

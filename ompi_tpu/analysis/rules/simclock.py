@@ -1,0 +1,91 @@
+"""Virtual-clock seam coverage rule.
+
+``simclock``: control-plane decision paths — the armada simulator
+itself, the health subsystem (ledger cooldowns, prober scheduling,
+sentinel deadlines), bulkhead QoS admission, and the telemetry
+sampler — must read time through the ``core/clock`` seam
+(``clock.monotonic`` / ``clock.sleep`` / ``clock.wait_event``), never
+``time.time`` / ``time.monotonic`` / ``time.sleep`` directly. A
+direct call is invisible to an installed ``SimClock``: under the
+fleet simulator that code path would mix real seconds into a virtual
+timeline, silently breaking both the time compression (a 10-minute
+scenario stalls on real sleeps) and the same-seed replay contract (a
+decision keyed on wall time differs across runs).
+
+Meters stay real by design: ``time.perf_counter`` (phase timings,
+events/s) and ``time.time_ns`` (sample timestamps — data, not
+decisions) are not flagged.
+
+Suppression: ``# commlint: allow(simclock)`` on the offending line,
+for the rare path that genuinely wants wall time (e.g. the seam's own
+default implementation).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule
+
+#: Path fragments whose files the rule audits (decision paths wired
+#: through the core/clock seam).
+_SCOPE_DIRS = ("sim/", "health/")
+_SCOPE_FILES = ("daemon/qos.py", "telemetry/sampler.py")
+
+#: ``time.<attr>`` calls that bypass the seam. perf_counter and
+#: time_ns are meters/timestamps, deliberately absent.
+_BANNED_ATTRS = frozenset({"time", "monotonic", "sleep"})
+
+#: The seam module itself delegates to ``time`` when no sim clock is
+#: installed — that is the one sanctioned direct caller.
+_EXEMPT_FILES = ("core/clock.py",)
+
+
+def _in_scope(relpath: str) -> bool:
+    p = relpath.replace("\\", "/")
+    if any(p.endswith(x) for x in _EXEMPT_FILES):
+        return False
+    if any(p.endswith(x) for x in _SCOPE_FILES):
+        return True
+    return any(f"/{d}" in p or p.startswith(d) for d in _SCOPE_DIRS)
+
+
+def _banned_time_call(node: ast.AST):
+    """The offending attr name when ``node`` is ``time.<banned>(...)``."""
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    if isinstance(fn, ast.Attribute) and fn.attr in _BANNED_ATTRS \
+            and isinstance(fn.value, ast.Name) and fn.value.id == "time":
+        return fn.attr
+    return None
+
+
+@COMMLINT.register
+class SimClockRule(LintRule):
+    NAME = "simclock"
+    PRIORITY = 30
+    DESCRIPTION = ("control-plane decision paths must read time "
+                   "through the core/clock seam, not time.* directly")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        if not _in_scope(ctx.relpath):
+            return
+        for node in ast.walk(ctx.tree):
+            attr = _banned_time_call(node)
+            if attr is None:
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            yield self.finding(
+                ctx, node,
+                f"direct time.{attr}() in a clock-seam decision path "
+                "— this is invisible to an installed SimClock and "
+                "breaks virtual-time compression and same-seed "
+                "replay; use core.clock."
+                f"{'monotonic' if attr != 'sleep' else 'sleep'}() "
+                "(or allow() if wall time is genuinely intended)",
+            )

@@ -35,9 +35,9 @@ the fallback target of every quant tier is the plain-precision chain
 from __future__ import annotations
 
 import threading
-import time
 from typing import Optional
 
+from ..core import clock
 from ..core import config
 from ..core.counters import SPC
 from ..core.logging import get_logger
@@ -135,7 +135,7 @@ def is_open(op: str, algo: str) -> bool:
         if t is None or t.state == CLOSED:
             return False
         if t.state == OPEN:
-            elapsed_ms = (time.monotonic() - t.opened_at) * 1e3
+            elapsed_ms = (clock.monotonic() - t.opened_at) * 1e3
             if elapsed_ms < _cooldown.value:
                 return True
             t.state = HALF_OPEN
@@ -172,7 +172,7 @@ def record_failure(op: str, algo: str) -> None:
                     NEXT_TIER.get(algo, TERMINAL), _cooldown.value,
                 )
             t.state = OPEN
-            t.opened_at = time.monotonic()
+            t.opened_at = clock.monotonic()
             t.probing = False
 
 

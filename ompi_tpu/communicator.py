@@ -56,6 +56,16 @@ def _next_cid() -> int:
         return next(_cid_counter)
 
 
+def reset_cids_for_testing() -> None:
+    """Restart cid allocation at 0 (sim/test isolation). Only safe
+    when no communicator from the previous epoch is still in use:
+    decision logs key on cids, so deterministic replay needs each run
+    to allocate the same ids."""
+    global _cid_counter
+    with _cid_lock:
+        _cid_counter = itertools.count(0)
+
+
 class Communicator(HasAttributes, HasErrhandler):
     """A communication context over an ordered set of rank-devices."""
 

@@ -33,8 +33,9 @@ and one-shot retry of a flaky callable::
 from __future__ import annotations
 
 import random
-import time
 from typing import Callable, Optional, Tuple, Type
+
+from . import clock
 
 __all__ = ["Backoff", "retry"]
 
@@ -51,9 +52,9 @@ class Backoff:
               delays land in [0.5*d, d]); drawn from a seeded RNG so
               the schedule is reproducible.
     timeout:  seconds from *now* to the deadline (None = unbounded).
-    deadline: absolute time.monotonic() deadline; overrides timeout.
+    deadline: absolute clock.monotonic() deadline; overrides timeout.
     seed:     jitter RNG seed — fixed default keeps runs deterministic.
-    sleep_fn: injectable sleeper (tests).
+    sleep_fn: injectable sleeper (tests); defaults to the clock seam.
     """
 
     def __init__(self, *, initial: float = 0.001, maximum: float = 0.25,
@@ -61,7 +62,7 @@ class Backoff:
                  timeout: Optional[float] = None,
                  deadline: Optional[float] = None,
                  seed: int = 0,
-                 sleep_fn: Callable[[float], None] = time.sleep) -> None:
+                 sleep_fn: Optional[Callable[[float], None]] = None) -> None:
         if initial <= 0:
             raise ValueError(f"initial must be > 0, got {initial}")
         if factor < 1.0:
@@ -73,12 +74,12 @@ class Backoff:
         self._factor = factor
         self._jitter = jitter
         self._rng = random.Random(seed)
-        self._sleep = sleep_fn
+        self._sleep = sleep_fn if sleep_fn is not None else clock.sleep
         self.attempts = 0
         if deadline is not None:
             self.deadline: Optional[float] = deadline
         elif timeout is not None:
-            self.deadline = time.monotonic() + timeout
+            self.deadline = clock.monotonic() + timeout
         else:
             self.deadline = None
 
@@ -88,7 +89,7 @@ class Backoff:
         """Seconds until the deadline (inf when unbounded)."""
         if self.deadline is None:
             return float("inf")
-        return self.deadline - time.monotonic()
+        return self.deadline - clock.monotonic()
 
     @property
     def expired(self) -> bool:

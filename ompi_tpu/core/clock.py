@@ -1,0 +1,87 @@
+"""Injectable control-plane clock — the simulator seam.
+
+Every timing decision in the control plane (backoff deadlines, probe
+scheduling, sampler ticks, ledger cooldowns, drift windows) used to
+read ``time.monotonic()`` directly. That is correct on a live fleet
+and fatal for a discrete-event simulator: virtual time cannot advance
+a deadline the module pinned to the wall clock at import. This module
+is the single indirection point — control-plane code calls
+``clock.monotonic()`` / ``clock.sleep()`` / ``clock.wait_event()``
+and, when nothing is installed, gets *exactly* ``time.monotonic`` /
+``time.sleep`` / ``Event.wait`` semantics: the seam is inert in
+production (one module-global read and a None check per call).
+
+``ompi_tpu.sim`` installs a virtual clock for the duration of a run
+(`install()` / `uninstall()`); nothing else should. The installed
+object must provide::
+
+    monotonic() -> float          # virtual seconds, monotone
+    sleep(seconds: float) -> None # advance virtual time
+    wait_event(event, timeout) -> bool   # Event.wait under virtual time
+
+Data-plane hot paths (progress sweeps, wire ops) intentionally stay
+on the raw ``time`` module — the simulator never executes them, and
+the seam's extra global read has no business in a per-step loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+__all__ = [
+    "monotonic", "sleep", "wait_event", "install", "uninstall",
+    "installed",
+]
+
+#: the installed virtual clock, or None for wall time. A plain global
+#: (not thread-local): the simulator owns every control-plane thread
+#: it drives, and production never installs anything.
+_clock = None
+
+
+def monotonic() -> float:
+    """``time.monotonic()`` or the installed clock's virtual now."""
+    c = _clock
+    if c is None:
+        return time.monotonic()
+    return c.monotonic()
+
+
+def sleep(seconds: float) -> None:
+    """``time.sleep`` or a virtual-time advance."""
+    c = _clock
+    if c is None:
+        time.sleep(seconds)
+    else:
+        c.sleep(seconds)
+
+
+def wait_event(event: threading.Event, timeout: Optional[float]) -> bool:
+    """``event.wait(timeout)`` under the active clock. Virtual clocks
+    may give real worker threads a short grace to finish before
+    charging the full virtual timeout."""
+    c = _clock
+    if c is None:
+        return event.wait(timeout)
+    return c.wait_event(event, timeout)
+
+
+def install(clock_obj) -> None:
+    """Install a virtual clock (simulator only; not re-entrant)."""
+    global _clock
+    if _clock is not None and _clock is not clock_obj:
+        raise RuntimeError("a clock is already installed")
+    _clock = clock_obj
+
+
+def uninstall() -> None:
+    """Return to wall time (idempotent)."""
+    global _clock
+    _clock = None
+
+
+def installed() -> bool:
+    """True when a virtual clock is driving the control plane."""
+    return _clock is not None

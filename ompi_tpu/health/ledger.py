@@ -52,9 +52,9 @@ from __future__ import annotations
 
 import hashlib
 import threading
-import time
 from typing import Callable, Optional
 
+from ..core import clock
 from ..core import config
 from ..core.counters import SPC
 from ..core.logging import get_logger
@@ -190,7 +190,7 @@ class Ledger:
                       scope=scope, prev=frm, cause=cause)
         if to_state == QUARANTINED:
             if frm != QUARANTINED:
-                e.quarantined_at = time.monotonic()
+                e.quarantined_at = clock.monotonic()
             SPC.record("health_quarantines")
             logger.warning("health: tier %r QUARANTINED (scope=%s, "
                            "cause=%s)", tier, scope, cause)
@@ -199,7 +199,7 @@ class Ledger:
             if e.quarantined_at:
                 SPC.record_latency(
                     "health_time_to_restore",
-                    time.monotonic() - e.quarantined_at,
+                    clock.monotonic() - e.quarantined_at,
                 )
             e.quarantined_at = 0.0
             logger.warning("health: tier %r restored to HEALTHY "
@@ -339,7 +339,7 @@ class Ledger:
             if e is None or e.state != QUARANTINED:
                 return False
             if not e.quarantined_at or (
-                    (time.monotonic() - e.quarantined_at) * 1e3
+                    (clock.monotonic() - e.quarantined_at) * 1e3
                     < _quarantine_ms.value):
                 return False
             e.successes = 0
@@ -376,7 +376,7 @@ class Ledger:
                 if (not prober.running()
                         or not prober.has_probe(tier)) \
                         and e.quarantined_at and (
-                        (time.monotonic() - e.quarantined_at) * 1e3
+                        (clock.monotonic() - e.quarantined_at) * 1e3
                         >= _quarantine_ms.value):
                     # lazy in-band cooldown: admit the next call as
                     # the probe (PR-5 breaker semantics, tier-wide)

@@ -52,6 +52,7 @@ from __future__ import annotations
 import threading
 from typing import Callable, Optional
 
+from ..core import clock
 from ..core import config
 from ..core.backoff import Backoff
 from ..core.counters import SPC
@@ -257,9 +258,7 @@ class Supervisor(threading.Thread):
     # one scheduling quantum; split out so tests can drive the
     # supervisor synchronously without the thread
     def tick(self) -> None:
-        import time as _time
-
-        now = _time.monotonic()
+        now = clock.monotonic()
         quarantined = ledger.LEDGER.quarantined_tiers()
         for (scope, tier) in quarantined:
             if not has_probe(tier):
@@ -283,7 +282,7 @@ class Supervisor(threading.Thread):
             bo = ent[0]
             delay = bo.next_delay()
             bo.attempts += 1
-            ent[1] = _time.monotonic() + delay
+            ent[1] = clock.monotonic() + delay
         # a tier that left quarantine drops its backoff; PROBATION
         # tiers keep probing every tick until the ledger settles
         live = set(quarantined)
@@ -341,7 +340,7 @@ class Supervisor(threading.Thread):
             wait_s = (max(0.01, _reprobe_initial_ms.value / 2e3)
                       if busy else
                       max(0.05, _interval_ms.value / 1e3 / 8))
-            self._stop_ev.wait(wait_s)
+            clock.wait_event(self._stop_ev, wait_s)
         logger.info("health supervisor stopped")
 
 

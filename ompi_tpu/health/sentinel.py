@@ -32,9 +32,9 @@ and wedge-prone deployments arm it.
 from __future__ import annotations
 
 import threading
-import time
 from typing import Any, Callable, Optional
 
+from ..core import clock
 from ..core import config
 from ..core.counters import SPC
 from ..core.errors import OmpiTpuError
@@ -73,7 +73,7 @@ def beat() -> None:
     """Stamp the heartbeat (called from ProgressEngine.progress once
     per sweep — one attribute store, no lock)."""
     global _last_beat
-    _last_beat = time.monotonic()
+    _last_beat = clock.monotonic()
 
 
 def install() -> None:
@@ -92,7 +92,7 @@ def heartbeat_age() -> float:
     """Seconds since the last progress sweep (inf before the first)."""
     if not _last_beat:
         return float("inf")
-    return time.monotonic() - _last_beat
+    return clock.monotonic() - _last_beat
 
 
 def heartbeat_stalled() -> bool:
@@ -125,7 +125,7 @@ def run_bounded(fn: Callable[[], Any], deadline_s: float, *,
     t = threading.Thread(target=_worker, daemon=True,
                          name=f"ompi-tpu-sentinel:{what}")
     t.start()
-    if not done.wait(deadline_s):
+    if not clock.wait_event(done, deadline_s):
         SPC.record("health_stalls")
         from ..trace import span as tspan
 

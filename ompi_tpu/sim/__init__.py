@@ -1,0 +1,20 @@
+"""armada: pod-scale deterministic fleet simulator.
+
+A discrete-event harness that drives the *real* control planes —
+health ledger/Supervisor, Watchtower, lifeboat recovery, bulkhead
+Daemon+QoS, sched autotune/cache/retune — at 1024-4096 simulated
+ranks under a virtual clock (`core/clock` seam) and a seeded event
+queue. No data-plane bytes move: collectives are costed by the sched
+cost model per schedule, faults are faultline-grammar specs, and
+every run folds the component decision logs into one merged digest
+that is byte-identical across same-seed replays (docs/SIM.md).
+"""
+
+from .clock import SimClock
+from .engine import FleetSim, Scenario
+from .events import EventQueue
+from .topology import FleetTopology
+from .traffic import TrafficModel
+
+__all__ = ["SimClock", "FleetSim", "Scenario", "EventQueue",
+           "FleetTopology", "TrafficModel"]

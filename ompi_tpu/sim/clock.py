@@ -1,0 +1,97 @@
+"""Virtual monotonic clock for the armada simulator.
+
+`SimClock` implements the `core/clock` protocol (monotonic / sleep /
+wait_event) over a virtual timeline the event engine advances
+explicitly. Installed via `core.clock.install`, every control-plane
+deadline — backoff schedules, ledger cooldowns, supervisor re-probe
+scheduling, watchtower/sampler tick budgets, breaker cooldowns —
+reads simulated seconds, so a 10-minute fleet scenario runs in
+milliseconds of wall time and two same-seed runs see the *same*
+timeline.
+
+`wait_event` is the one place real and virtual time meet: sentinel's
+`run_bounded` parks on a real `threading.Event` set by a real worker
+thread (sim probes are plain functions that return quickly). The
+virtual clock grants a short *real* grace for the worker to finish;
+only if the worker is still running after the grace does the wait
+charge the full virtual timeout and report a stall — a wedged sim
+probe times out in virtual time exactly like a wedged canary would
+on hardware.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core import clock as _seam
+
+__all__ = ["SimClock"]
+
+
+class SimClock:
+    """Virtual monotonic clock (seconds). Thread-compatible: the
+    engine is single-threaded, but sentinel workers may read
+    `monotonic()` concurrently — a float read is atomic under the
+    GIL and the engine only advances between events."""
+
+    #: real seconds granted to worker threads in wait_event before the
+    #: wait is charged to virtual time (see module doc)
+    REAL_GRACE_S = 1.0
+
+    def __init__(self, start: float = 0.0) -> None:
+        self._now = float(start)
+        self._mu = threading.Lock()
+
+    # -- core/clock protocol -------------------------------------------
+
+    def monotonic(self) -> float:
+        return self._now
+
+    def sleep(self, seconds: float) -> None:
+        self.advance(seconds)
+
+    def wait_event(self, event: threading.Event,
+                   timeout: Optional[float]) -> bool:
+        if event.is_set():
+            return True
+        if timeout is None:
+            # unbounded wait has no virtual semantics; fall back to a
+            # real wait (nothing in the control plane does this today)
+            return event.wait(None)
+        if event.wait(self.REAL_GRACE_S):
+            return True
+        # worker still running after the real grace: the virtual
+        # deadline lapses — a stall, exactly like hardware
+        self.advance(timeout)
+        return event.is_set()
+
+    # -- engine surface ------------------------------------------------
+
+    def advance(self, seconds: float) -> None:
+        """Move the timeline forward (negative advances are clamped:
+        the clock is monotonic by contract)."""
+        if seconds > 0:
+            with self._mu:
+                self._now += seconds
+
+    def advance_to(self, t: float) -> None:
+        """Jump to an absolute virtual instant (never backwards)."""
+        with self._mu:
+            if t > self._now:
+                self._now = t
+
+    # -- installation --------------------------------------------------
+
+    def install(self) -> "SimClock":
+        _seam.install(self)
+        return self
+
+    def uninstall(self) -> None:
+        _seam.uninstall()
+
+    def __enter__(self) -> "SimClock":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()

@@ -1,0 +1,600 @@
+"""armada engine: the discrete-event loop driving real control planes.
+
+`FleetSim` wires a modeled `FleetTopology` (fake procs, real
+fingerprint) into the *real* subsystems — a real `Communicator`
+world, a real bulkhead `Daemon` with QoS admission, the real health
+`Supervisor` tick, the real `Watchtower` controller, the real
+lifeboat recovery pipeline, the real sched autotune/cache — and runs
+them under a `SimClock` + seeded `EventQueue`. Collectives never move
+bytes: an admitted request schedules a completion event at
+`topology.collective_time_s` (the autotuner's alpha-beta closed form
+gated by the slowest participant), and the completion feeds the same
+`SPC` histograms the watchtower drifts against in production.
+
+Faults reuse the faultline plan grammar (`action@layer:k=v`):
+
+    host_loss@fleet:host=H          four ranks die -> PROC_FAILED
+                                    fan-out -> lifeboat shrink
+    rank_kill@fleet:rank=R          one rank dies
+    straggler@fleet:rank=R,mult=M   persistent slow rank -> z-score
+                                    findings -> watchtower penalties
+    quarantine@coll:tier=T,heal_s=S operator quarantine; a sim probe
+                                    heals it after S virtual seconds
+                                    through the real PROBATION ladder
+    flood@daemon:rate=N[,key=sub]   armed as a REAL ft.inject plan:
+    hog@daemon:bytes=N[,key=sub]    the daemon amplifies it natively
+
+Determinism: every decision is a pure function of the scenario
+(seed, topology, traffic, faults). Wall-clock appears only in meters
+(events/s, recovery phase timings) — never in a decision log — so
+the merged decision-log digest is byte-identical across same-seed
+replays in separate processes.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import time
+from dataclasses import asdict, dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from .clock import SimClock
+from .events import (COLLECTIVE_DONE, END, FAULT, PUMP, SAMPLER_TICK,
+                     SUBMIT, SUPERVISOR_TICK, EventQueue)
+from .topology import FleetTopology
+from .traffic import TrafficModel
+
+__all__ = ["Scenario", "FleetSim", "parse_fault"]
+
+#: tiers a quarantine@coll fault may name (mirrors health.ledger.TIERS
+#: without importing it at module load)
+_SIM_PROBE_TIERS = ("device", "device_pallas", "fastpath", "shm",
+                    "dcn", "fabric")
+
+
+@dataclass
+class Scenario:
+    """One reproducible fleet run. Everything that influences a
+    decision is in here; everything else is a meter."""
+
+    name: str = "default"
+    seed: int = 0
+    nranks: int = 1024
+    chips_per_host: int = 4
+    duration_s: float = 20.0
+    tenants: int = 16
+    base_rps: float = 200.0
+    pump_interval_s: float = 0.02
+    supervisor_interval_s: float = 0.5
+    sampler_interval_s: float = 1.0
+    #: [{"at": 5.0, "spec": "host_loss@fleet:host=3"}, ...]
+    faults: list = field(default_factory=list)
+    #: winner-cache keys re-pinned to compiled sched algos so the
+    #: straggler reshaping path has schedules to retune
+    pin_sched_keys: int = 2
+    max_events: int = 2_000_000
+
+    def to_dict(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Scenario":
+        known = {f for f in cls.__dataclass_fields__}
+        extra = set(d) - known
+        if extra:
+            raise ValueError(f"unknown scenario fields: {sorted(extra)}")
+        return cls(**d)
+
+
+def parse_fault(spec: str) -> tuple[str, str, dict]:
+    """Split an ``action@layer:k=v,...`` fault spec (the faultline
+    grammar) into (action, layer, kv). Values parse as int when they
+    look like one, float otherwise, string as the fallback."""
+    head, _, tail = spec.strip().partition(":")
+    action, at, layer = head.partition("@")
+    if not at or not action or not layer:
+        raise ValueError(f"fault spec {spec!r}: expected action@layer")
+    kv: dict[str, Any] = {}
+    if tail:
+        for part in tail.split(","):
+            k, eq, v = part.partition("=")
+            if not eq:
+                raise ValueError(f"fault spec {spec!r}: bad kv {part!r}")
+            try:
+                kv[k] = int(v)
+            except ValueError:
+                try:
+                    kv[k] = float(v)
+                except ValueError:
+                    kv[k] = v
+    return action, layer, kv
+
+
+class FleetSim:
+    """One scenario run over the real control planes (see module
+    doc). Construct, `run()`, read the report; each run resets the
+    process-wide control-plane singletons it drives."""
+
+    #: cvar overrides active for the run (saved/restored around it)
+    _CVAR_OVERRIDES = {
+        "telemetry_watchtower_enable": True,
+        "telemetry_straggler_enable": True,
+        "health_base_enable": True,
+    }
+
+    def __init__(self, scenario: Scenario) -> None:
+        self.scenario = scenario
+        self.clock = SimClock()
+        self.queue = EventQueue()
+        self.topology = FleetTopology(
+            scenario.nranks, chips_per_host=scenario.chips_per_host,
+            seed=scenario.seed)
+        self.traffic = TrafficModel(
+            tenants=scenario.tenants, base_rps=scenario.base_rps,
+            duration_s=scenario.duration_s, seed=scenario.seed)
+        self.world = None
+        self.daemon = None
+        self.supervisor = None
+        self.watchtower = None
+        self._sessions: dict[str, int] = {}
+        self._armed_specs: list[str] = []
+        self._sim_probe_faults: dict[str, float] = {}  # tier -> heal_at
+        self._registered_probes: list[str] = []
+        self._saved_cvars: dict[str, Any] = {}
+        self._need_tenant_recovery = False
+        # meters
+        self.m = {
+            "submits": 0, "admits": 0, "rejects": 0, "errors": 0,
+            "collectives": 0, "recoveries": 0, "supervisor_ticks": 0,
+            "sampler_ticks": 0, "faults": 0, "retunes": 0,
+            "penalties": 0,
+        }
+        self.recovery_ms: list[float] = []
+        self._handle_wall_s = 0.0
+        self._first_fault_tick: Optional[int] = None
+        self._last_retune_tick: Optional[int] = None
+        self._nominal_coll_s = 1e-3
+
+    # -- lifecycle ------------------------------------------------------
+
+    def run(self) -> dict:
+        t0 = time.perf_counter()
+        self._apply_cvars()
+        self._reset_control_planes()
+        self.clock.install()
+        try:
+            self._setup()
+            self._seed_events()
+            self._loop()
+            report = self._report()
+        finally:
+            self.clock.uninstall()
+            self._teardown()
+        report["wall_s"] = round(time.perf_counter() - t0, 4)
+        report["events_per_s"] = round(
+            self.queue.popped / max(1e-9, report["wall_s"]), 1)
+        return report
+
+    def _apply_cvars(self) -> None:
+        # the overridden cvars register at their owners' import time —
+        # pull those modules in before looking any of them up
+        from ..core import config
+        from ..daemon import service as _service  # noqa: F401
+        from ..health import ledger as _ledger  # noqa: F401
+        from ..telemetry import straggler as _straggler  # noqa: F401
+        from ..telemetry import watchtower as _wt  # noqa: F401
+
+        overrides = dict(self._CVAR_OVERRIDES)
+        overrides["daemon_base_max_sessions"] = \
+            self.scenario.tenants + 8
+        for name, val in overrides.items():
+            var = config.VARS.lookup(name)
+            if var is None:
+                raise RuntimeError(
+                    f"sim cvar override {name!r} is not registered — "
+                    f"a silent skip here would run the wrong fleet")
+            self._saved_cvars[name] = var.value
+            config.set(name, val)
+
+    def _restore_cvars(self) -> None:
+        from ..core import config
+
+        for name, val in self._saved_cvars.items():
+            config.set(name, val)
+        self._saved_cvars.clear()
+
+    def _reset_control_planes(self) -> None:
+        """Fresh process-wide state: same starting line every run —
+        the other half of the determinism contract."""
+        import gc
+
+        from .. import communicator
+        from ..coll.sched import cache as scache, retune
+        from ..core.counters import SPC
+        from ..ft import elastic, inject, lifeboat
+        from ..health import ledger
+        from ..telemetry import straggler, watchtower
+
+        # flush dead comms out of the weak registry, then restart cid
+        # allocation: decision logs embed cids, so a replayed run must
+        # allocate the same ids a fresh process would
+        gc.collect()
+        communicator.reset_cids_for_testing()
+        inject.disarm()
+        ledger.reset()
+        straggler.reset_for_testing()
+        watchtower.reset_for_testing()
+        retune.reset_for_testing()
+        scache.CACHE.clear()
+        lifeboat.reset()
+        elastic.reset()
+        SPC.reset_for_testing()
+
+    def _setup(self) -> None:
+        from ..coll.sched import autotune
+        from ..coll.sched import cache as scache
+        from ..daemon import protocol
+        from ..daemon.service import Daemon
+        from ..ft import lifeboat
+        from ..health import prober
+        from ..telemetry import watchtower
+
+        sc = self.scenario
+        self.world = self.topology.world()
+        fp = self.topology.fingerprint()
+        autotune.tune(sc.nranks, mode="model", topo_fp=fp, save=False)
+        # pin a few winners to compiled sched algos: production pins
+        # schedule-compiler winners; the straggler reshaping path
+        # needs schedules whose shape topology penalties can change
+        from ..core.counters import SPC
+
+        keys = sorted(scache.CACHE.entries())
+        for i, key in enumerate(keys[:max(0, sc.pin_sched_keys)]):
+            algo = "sched_hier" if i % 2 == 0 else "sched_ring_seg"
+            scache.CACHE.put(key, algo, source="sim_pin")
+            SPC.record("sim_sched_pins")
+        lifeboat.enable()
+        self.supervisor = prober.Supervisor(seed=sc.seed)
+        self.watchtower = watchtower.get()
+        self.watchtower.seed = sc.seed
+        self.watchtower.interval_ms = int(sc.sampler_interval_s * 1e3)
+        # in-process lane: the sim feeds handle() directly; the shm
+        # lane's native connect poll would block real time every pump
+        self.daemon = Daemon(self.world, name="armada", seed=sc.seed,
+                             lane="local")
+        for tenant, qos in self.traffic.tenant_specs():
+            r = self.daemon.handle(protocol.Message(
+                protocol.ATTACH, tenant=tenant, body={"qos": qos}))
+            if r.kind != protocol.ATTACHED:
+                raise RuntimeError(
+                    f"sim setup: attach {tenant} failed: {r.kind} "
+                    f"{r.body}")
+            self._sessions[tenant] = r.session
+        self._nominal_coll_s = self.topology.collective_time_s(
+            "ring", 64 << 10)
+
+    def _teardown(self) -> None:
+        from ..health import prober
+
+        for tier in self._registered_probes:
+            prober.unregister_probe(tier)
+        self._registered_probes.clear()
+        # drop every communicator this run created: a later run's
+        # PROC_FAILED fan-out must not see (and revoke+log) comms from
+        # this one — stale revokes would poison its decision log
+        if self.daemon is not None:
+            self.daemon.stop()
+        self.daemon = None
+        self.world = None
+        self._sessions.clear()
+        self._restore_cvars()
+        # leave the process-wide control planes as pristine as we
+        # found them: the chaos this run injected (elastic failure
+        # registry, ledger quarantines, watchtower penalties, armed
+        # fault plans) must not leak into whatever runs in this
+        # process next
+        self._reset_control_planes()
+
+    # -- event seeding --------------------------------------------------
+
+    def _seed_events(self) -> None:
+        sc = self.scenario
+        for tenant, _qos in self.traffic.tenant_specs():
+            at, nbytes = self.traffic.next_arrival(tenant, 0.0)
+            if at < sc.duration_s:
+                self.queue.push(at, SUBMIT, tenant=tenant,
+                                nbytes=nbytes, organic=True)
+        t = sc.pump_interval_s
+        while t < sc.duration_s:
+            self.queue.push(t, PUMP)
+            t += sc.pump_interval_s
+        t = sc.supervisor_interval_s
+        while t < sc.duration_s:
+            self.queue.push(t, SUPERVISOR_TICK)
+            t += sc.supervisor_interval_s
+        t = sc.sampler_interval_s
+        while t < sc.duration_s:
+            self.queue.push(t, SAMPLER_TICK)
+            t += sc.sampler_interval_s
+        for f in sc.faults:
+            self.queue.push(float(f["at"]), FAULT, spec=f["spec"])
+        self.queue.push(sc.duration_s, END)
+
+    # -- the loop -------------------------------------------------------
+
+    def _loop(self) -> None:
+        handlers = {
+            SUBMIT: self._on_submit,
+            COLLECTIVE_DONE: self._on_coll_done,
+            PUMP: self._on_pump,
+            SUPERVISOR_TICK: self._on_supervisor,
+            SAMPLER_TICK: self._on_sampler,
+            FAULT: self._on_fault,
+        }
+        max_events = self.scenario.max_events
+        while self.queue:
+            ev = self.queue.pop()
+            self.clock.advance_to(ev.at)
+            if ev.kind == END:
+                break
+            if self.queue.popped > max_events:
+                raise RuntimeError(
+                    f"sim exceeded max_events={max_events} "
+                    f"(runaway scenario?)")
+            handlers[ev.kind](ev)
+
+    # -- handlers -------------------------------------------------------
+
+    def _on_submit(self, ev) -> None:
+        from ..daemon import protocol
+
+        sc = self.scenario
+        tenant = ev.data["tenant"]
+        nbytes = ev.data["nbytes"]
+        sid = self._sessions.get(tenant)
+        if sid is None:
+            return
+        now = self.clock.monotonic()
+        # zero-stride broadcast: admission sees the real byte count,
+        # no data-plane allocation happens (op=nop never executes it)
+        payload = np.broadcast_to(np.float32(0.0), (nbytes // 4,))
+        msg = protocol.Message(protocol.SUBMIT, tenant=tenant,
+                               session=sid, body={"op": "nop",
+                                                  "payload": payload})
+        self.m["submits"] += 1
+        t0 = time.perf_counter()
+        reply = self.daemon.handle(msg)
+        self._handle_wall_s += time.perf_counter() - t0
+        if reply.kind == protocol.ADMIT:
+            self.m["admits"] += 1
+            entries = self._winner_for(nbytes)
+            done_at = now + self.topology.collective_time_s(
+                entries, nbytes)
+            self.queue.push(done_at, COLLECTIVE_DONE, tenant=tenant,
+                            nbytes=nbytes, issued=now)
+        elif reply.kind == protocol.REJECT:
+            self.m["rejects"] += 1
+        else:
+            # EVICTED / ERROR: the session's comm is gone — recovery
+            # is the pump's job; the request itself is lost
+            self.m["errors"] += 1
+            self._need_tenant_recovery = True
+        if ev.data.get("organic"):
+            at, nb = self.traffic.next_arrival(tenant, now)
+            if at < sc.duration_s:
+                self.queue.push(at, SUBMIT, tenant=tenant, nbytes=nb,
+                                organic=True)
+
+    def _winner_for(self, nbytes: int) -> str:
+        from ..coll.sched import cache as scache
+
+        key = scache.cache_key(
+            "allreduce", nbytes, self.scenario.nranks,
+            dtype="float32", topo_fp=self.topology.fingerprint())
+        ent = scache.CACHE.entries().get(key)
+        return ent["algorithm"] if ent else "ring"
+
+    def _on_coll_done(self, ev) -> None:
+        from ..coll.sched import cache as scache
+        from ..core.counters import SPC
+
+        self.m["collectives"] += 1
+        lat = max(1e-9, self.clock.monotonic() - ev.data["issued"])
+        bucket = scache.size_bucket(ev.data["nbytes"])
+        SPC.record_latency("coll_allreduce", lat)
+        SPC.record_latency(f"coll_allreduce_b{bucket}", lat)
+
+    def _on_pump(self, ev) -> None:
+        self.daemon.pump(1)
+        if self._need_tenant_recovery:
+            self._recover_tenants()
+
+    def _recover_tenants(self) -> None:
+        from ..ft import lifeboat
+
+        self._need_tenant_recovery = False
+        if lifeboat.revoked(self.world):
+            t0 = time.perf_counter()
+            self.world = lifeboat.recover(
+                self.world, quiesce_timeout=0.05,
+                seed=self.scenario.seed)
+            self.recovery_ms.append((time.perf_counter() - t0) * 1e3)
+            self.m["recoveries"] += 1
+        for tenant in sorted(self._sessions):
+            t = self.daemon.tenants.get(tenant)
+            if t is None:
+                continue
+            hit = any(
+                s.state == "revoked" or lifeboat.revoked(s.comm)
+                for s in t.sessions.values())
+            if not hit:
+                continue
+            t0 = time.perf_counter()
+            self.daemon.recover_tenant(tenant)
+            self.recovery_ms.append((time.perf_counter() - t0) * 1e3)
+            self.m["recoveries"] += 1
+
+    def _on_supervisor(self, ev) -> None:
+        self.m["supervisor_ticks"] += 1
+        self.supervisor.tick()
+
+    def _on_sampler(self, ev) -> None:
+        from ..core.counters import SPC
+        from ..telemetry import straggler
+        from ..tools import mpit
+
+        self.m["sampler_ticks"] += 1
+        straggler.analyze(self._fleet_snaps())
+        mpit.check_watches()
+        before = len(self.watchtower.log())
+        self.watchtower.tick({"hists": SPC.histogram_snapshots()})
+        fresh = self.watchtower.log()[before:]
+        retuned = sum(1 for e in fresh if e.get("action") == "retune")
+        if retuned:
+            self.m["retunes"] += retuned
+            self._last_retune_tick = self.m["sampler_ticks"]
+        self.m["penalties"] += sum(
+            1 for e in fresh if e.get("action") == "penalty")
+
+    def _fleet_snaps(self) -> dict[int, dict]:
+        """The per-rank sample dicts rank 0's straggler detector
+        merges in production: each live rank reports a coll p50
+        shaped by its modeled latency factor."""
+        base = self._nominal_coll_s
+        snaps = {}
+        for r in self.topology.live_ranks():
+            p50 = base * self.topology.rank_factor(r)
+            snaps[r] = {"hists": {"coll_allreduce":
+                                  {"p50": p50, "count": 8}},
+                        "counters": {}, "peers": {}, "health": {}}
+        return snaps
+
+    # -- faults ---------------------------------------------------------
+
+    def _on_fault(self, ev) -> None:
+        self.m["faults"] += 1
+        if self._first_fault_tick is None:
+            self._first_fault_tick = self.m["sampler_ticks"]
+        action, layer, kv = parse_fault(ev.data["spec"])
+        if layer == "fleet" and action == "host_loss":
+            self._kill_ranks(
+                self.topology.fail_host(int(kv["host"])))
+        elif layer == "fleet" and action == "rank_kill":
+            rank = int(kv["rank"])
+            self.topology._dead.add(rank)
+            self._kill_ranks([rank])
+        elif layer == "fleet" and action == "straggler":
+            if kv.get("clear"):
+                self.topology.clear_straggler(int(kv["rank"]))
+            else:
+                self.topology.set_straggler(
+                    int(kv["rank"]), float(kv.get("mult", 8.0)))
+        elif layer == "coll" and action == "quarantine":
+            self._quarantine_tier(
+                str(kv["tier"]), float(kv.get("heal_s", 2.0)))
+        elif layer == "daemon" and action in ("flood", "hog"):
+            from ..ft import inject
+
+            self._armed_specs.append(ev.data["spec"])
+            inject.arm(";".join(self._armed_specs),
+                       seed=self.scenario.seed)
+        else:
+            raise ValueError(
+                f"unknown sim fault {ev.data['spec']!r}")
+
+    def _kill_ranks(self, ranks: list[int]) -> None:
+        from ..ft import events as ftev
+
+        for r in sorted(ranks):
+            ftev.raise_event(ftev.EventClass.PROC_FAILED,
+                             world_rank=r, via="sim")
+        self._need_tenant_recovery = True
+
+    def _quarantine_tier(self, tier: str, heal_s: float) -> None:
+        from ..health import ledger, prober
+
+        if tier not in _SIM_PROBE_TIERS:
+            raise ValueError(f"quarantine fault: unknown tier {tier!r}")
+        heal_at = self.clock.monotonic() + heal_s
+        self._sim_probe_faults[tier] = heal_at
+
+        def _probe(t=tier) -> None:
+            if self.clock.monotonic() < self._sim_probe_faults.get(
+                    t, 0.0):
+                raise RuntimeError(f"sim fault active on {t}")
+
+        prober.register_probe(tier, _probe,
+                              description=f"sim modeled canary[{tier}]")
+        if tier not in self._registered_probes:
+            self._registered_probes.append(tier)
+        ledger.LEDGER.quarantine(tier, cause="sim_fault")
+
+    # -- report ---------------------------------------------------------
+
+    def digests(self) -> dict[str, str]:
+        from ..coll.sched import cache as scache
+        from ..ft import inject, lifeboat
+        from ..health import ledger
+
+        out = {
+            "ledger": ledger.digest(),
+            "watchtower": self.watchtower.digest(),
+            "lifeboat": lifeboat.digest(),
+            "daemon": self.daemon.digest(),
+            "sched_cache": scache.CACHE.digest(),
+        }
+        p = inject.plan()
+        if p is not None:
+            out["faultline"] = p.digest()
+        return out
+
+    def merged_digest(self) -> str:
+        blob = json.dumps(self.digests(), sort_keys=True,
+                          separators=(",", ":")).encode()
+        return hashlib.sha256(blob).hexdigest()
+
+    def _per_class_meter(self) -> dict[str, dict[str, int]]:
+        out: dict[str, dict[str, int]] = {}
+        for name, meter in self.daemon.metering().items():
+            cls = meter.get("qos", "") or "unknown"
+            agg = out.setdefault(cls, {"requests": 0, "admitted": 0,
+                                       "rejected": 0})
+            for k in agg:
+                agg[k] += int(meter.get(k, 0))
+        return out
+
+    def _report(self) -> dict:
+        from ..core.counters import SPC
+
+        sc = self.scenario
+        counters = SPC.snapshot()
+        rec = sorted(self.recovery_ms)
+        p50 = rec[len(rec) // 2] if rec else 0.0
+        convergence = 0
+        if self._last_retune_tick is not None:
+            first = self._first_fault_tick or 0
+            convergence = max(1, self._last_retune_tick - first)
+        return {
+            "scenario": sc.name,
+            "seed": sc.seed,
+            "nranks": sc.nranks,
+            "tenants": sc.tenants,
+            "virtual_s": round(self.clock.monotonic(), 3),
+            "events": self.queue.popped,
+            **self.m,
+            "dead_ranks": sorted(self.topology.dead_ranks()),
+            "world_size": self.world.size,
+            "recovery_p50_ms": round(p50, 3),
+            "admission_handle_per_s": round(
+                self.m["submits"] / self._handle_wall_s, 1)
+            if self._handle_wall_s > 0 else 0.0,
+            "retune_convergence_ticks": convergence,
+            "quarantines": int(counters.get("health_quarantines", 0)),
+            "restores": int(counters.get("health_restores", 0)),
+            "per_class": self._per_class_meter(),
+            "digests": self.digests(),
+            "digest": self.merged_digest(),
+        }

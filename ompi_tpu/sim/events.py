@@ -1,0 +1,84 @@
+"""Seeded heap-ordered event queue for the armada engine.
+
+Events are totally ordered by ``(at, prio, seq)``: virtual time
+first, then an explicit priority (faults before traffic at the same
+instant — a host that dies at t also rejects the submit at t), then
+the monotone insertion sequence as the deterministic tie-break. No
+wall clock, no hash order, no thread anywhere in the queue: the pop
+sequence is a pure function of the push sequence, which is itself a
+pure function of the scenario seed — the replay contract's
+foundation.
+"""
+
+from __future__ import annotations
+
+import heapq
+import itertools
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+__all__ = ["Event", "EventQueue",
+           "FAULT", "SUBMIT", "COLLECTIVE_DONE", "PUMP",
+           "SUPERVISOR_TICK", "SAMPLER_TICK", "END"]
+
+# -- event kinds (prio encodes same-instant ordering) -------------------
+
+FAULT = "fault"                  # faultline-grammar spec fires
+SUBMIT = "submit"                # tenant request enters admission
+COLLECTIVE_DONE = "coll_done"    # modeled collective completes
+PUMP = "pump"                    # daemon pump round (refill+dispatch)
+SUPERVISOR_TICK = "supervisor"   # health Supervisor.tick quantum
+SAMPLER_TICK = "sampler"         # telemetry tick (straggler+watchtower)
+END = "end"                      # scenario horizon
+
+#: same-instant ordering: faults land first so the state they change
+#: is visible to everything else scheduled at that instant; END drains
+#: last so completions at the horizon still count.
+_PRIO = {
+    FAULT: 0,
+    COLLECTIVE_DONE: 1,
+    SUPERVISOR_TICK: 2,
+    SAMPLER_TICK: 3,
+    PUMP: 4,
+    SUBMIT: 5,
+    END: 9,
+}
+
+
+@dataclass(order=True)
+class Event:
+    at: float
+    prio: int
+    seq: int
+    kind: str = field(compare=False)
+    data: dict = field(compare=False, default_factory=dict)
+
+
+class EventQueue:
+    """Min-heap of events with a monotone sequence tie-break."""
+
+    def __init__(self) -> None:
+        self._heap: list[Event] = []
+        self._seq = itertools.count()
+        self.pushed = 0
+        self.popped = 0
+
+    def push(self, at: float, kind: str, **data: Any) -> Event:
+        ev = Event(at=float(at), prio=_PRIO.get(kind, 5),
+                   seq=next(self._seq), kind=kind, data=data)
+        heapq.heappush(self._heap, ev)
+        self.pushed += 1
+        return ev
+
+    def pop(self) -> Event:
+        self.popped += 1
+        return heapq.heappop(self._heap)
+
+    def peek(self) -> Optional[Event]:
+        return self._heap[0] if self._heap else None
+
+    def __len__(self) -> int:
+        return len(self._heap)
+
+    def __bool__(self) -> bool:
+        return bool(self._heap)

@@ -1,0 +1,81 @@
+"""Scenario files and the same-seed replay contract.
+
+A scenario file is the JSON of `Scenario.to_dict()`. `run_scenario`
+executes it through a fresh `FleetSim` and returns the report;
+`replay` re-runs it and verifies the merged decision-log digest —
+ledger transitions, watchtower decision log, lifeboat epochs, daemon
+admission meters, sched-cache winners, faultline firing log, each
+digested by its own subsystem and merged with sha256 over sorted
+JSON — is byte-identical to a reference. Wall-clock meters
+(`wall_s`, `events_per_s`, recovery phase ms) are excluded from the
+digest by construction: they are measurements, never decisions.
+
+`diff` explains a digest mismatch subsystem-by-subsystem so a broken
+determinism invariant names its culprit instead of just failing.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Union
+
+from .engine import FleetSim, Scenario
+
+__all__ = ["load_scenario", "dump_scenario", "run_scenario",
+           "replay", "diff"]
+
+
+def load_scenario(path: str) -> Scenario:
+    with open(path, encoding="utf-8") as f:
+        return Scenario.from_dict(json.load(f))
+
+
+def dump_scenario(sc: Scenario, path: str) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(sc.to_dict(), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def run_scenario(sc: Union[Scenario, str, dict]) -> dict:
+    """Run a scenario (object, file path, or dict) through a fresh
+    FleetSim; returns the full report including per-subsystem and
+    merged digests."""
+    if isinstance(sc, str):
+        sc = load_scenario(sc)
+    elif isinstance(sc, dict):
+        sc = Scenario.from_dict(sc)
+    return FleetSim(sc).run()
+
+
+def replay(sc: Union[Scenario, str, dict],
+           reference: Optional[dict] = None) -> dict:
+    """Run the scenario (twice when no reference report is given) and
+    verify the merged decision-log digests agree. Returns
+    ``{"ok": bool, "digest": ..., "reference_digest": ...,
+    "mismatch": {subsystem: (got, want)}, "report": ...}``."""
+    if reference is None:
+        reference = run_scenario(sc)
+    report = run_scenario(sc)
+    mismatch = diff(report, reference)
+    return {
+        "ok": not mismatch,
+        "digest": report["digest"],
+        "reference_digest": reference["digest"],
+        "mismatch": mismatch,
+        "report": report,
+    }
+
+
+def diff(report_a: dict, report_b: dict) -> dict:
+    """Per-subsystem digest comparison of two reports: `{}` when the
+    decision logs agree; otherwise subsystem -> (a, b) for each
+    divergent component (plus the merged digest)."""
+    out: dict = {}
+    da, db = report_a.get("digests", {}), report_b.get("digests", {})
+    for key in sorted(set(da) | set(db)):
+        if da.get(key) != db.get(key):
+            out[key] = (da.get(key), db.get(key))
+    if report_a.get("digest") != report_b.get("digest"):
+        out["merged"] = (report_a.get("digest"),
+                        report_b.get("digest"))
+    return out

@@ -1,0 +1,171 @@
+"""Modeled v4-pod topology: fake procs, real fingerprints, link costs.
+
+A `FleetTopology` fabricates the `runtime.proc.Proc` list a real pod
+would modex-exchange — 3-D torus coordinates, `chips_per_host` chips
+per process index, one slice — and feeds it to the *real*
+`topo.hardware_fingerprint` (so sched cache keys carry a genuine
+fingerprint) and the real `Communicator` constructor (`Proc.device`
+is opaque to the control plane; only data-plane ops touch jax, and
+the simulator never issues one).
+
+Cost model: a collective's virtual duration is the sched autotuner's
+closed-form alpha-beta cost (`autotune._steps_and_wire`) mapped to
+seconds with per-topology coefficients, scaled by the slowest
+participant's latency factor — collectives are bulk-synchronous, so
+the fleet runs at the pace of its worst rank. Per-host latency
+factors are drawn once from the topology seed (a modeled fleet is
+never perfectly uniform); straggler faults multiply a rank's factor,
+host-loss removes its ranks from the live set.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..runtime.proc import Proc
+
+__all__ = ["FleetTopology"]
+
+#: seconds per schedule round (alpha) and per wire byte (beta) for the
+#: modeled ICI fabric; derived from the ~1 us hop latency and
+#: ~100 GB/s per-link bandwidth ballpark of a v4 pod. Relative, not
+#: calibrated — the sim models control-plane dynamics, not hardware.
+ALPHA_S = 2e-6
+BETA_S_PER_BYTE = 1.0 / (100e9)
+
+
+class FleetTopology:
+    """A modeled pod: fake procs, host groups, link-latency factors."""
+
+    def __init__(self, nranks: int, *, chips_per_host: int = 4,
+                 seed: int = 0, jitter: float = 0.10) -> None:
+        if nranks < 2:
+            raise ValueError(f"nranks must be >= 2, got {nranks}")
+        self.nranks = int(nranks)
+        self.chips_per_host = max(1, int(chips_per_host))
+        self.seed = int(seed)
+        self.nhosts = (self.nranks + self.chips_per_host - 1) \
+            // self.chips_per_host
+        rng = random.Random((seed << 1) ^ 0xA44ADA)
+        #: per-host latency factor (>= 1): the modeled fleet's
+        #: baseline non-uniformity, drawn once per topology seed
+        self._host_factor = [
+            1.0 + jitter * rng.random() for _ in range(self.nhosts)
+        ]
+        #: rank -> straggler multiplier installed by fault events
+        self._straggler: dict[int, float] = {}
+        self._dead: set[int] = set()
+        self._procs: Optional[list[Proc]] = None
+
+    # -- the modeled proc table ----------------------------------------
+
+    def procs(self) -> list[Proc]:
+        """The fake modex view: one Proc per rank, v4-style 3-D
+        coords, `chips_per_host` chips per process index."""
+        if self._procs is None:
+            side = max(1, round(self.nranks ** (1.0 / 3.0)))
+            self._procs = [
+                Proc(rank=r, device=_SimDevice(r),
+                     process_index=r // self.chips_per_host,
+                     platform="tpu",
+                     coords=(r % side, (r // side) % side,
+                             r // (side * side)),
+                     core_on_chip=0, slice_index=0, modex={})
+                for r in range(self.nranks)
+            ]
+        return self._procs
+
+    def world(self, name: str = "armada_world"):
+        """A real Communicator over the modeled procs (the mesh is
+        lazy; control planes never force it)."""
+        from ..communicator import Communicator
+        from ..group import Group
+
+        return Communicator(Group(list(range(self.nranks))),
+                            self.procs(), name=name)
+
+    def fingerprint(self) -> str:
+        """The real topo.hardware_fingerprint over the modeled procs
+        — sched cache keys in the sim carry a genuine fingerprint."""
+        from ..topo import hardware_fingerprint
+
+        return hardware_fingerprint(self.procs())
+
+    # -- host groups ----------------------------------------------------
+
+    def host_of(self, rank: int) -> int:
+        return rank // self.chips_per_host
+
+    def ranks_of_host(self, host: int) -> list[int]:
+        lo = host * self.chips_per_host
+        return [r for r in range(lo, min(lo + self.chips_per_host,
+                                         self.nranks))
+                if r not in self._dead]
+
+    def live_ranks(self) -> list[int]:
+        return [r for r in range(self.nranks) if r not in self._dead]
+
+    def dead_ranks(self) -> set[int]:
+        return set(self._dead)
+
+    # -- faults ---------------------------------------------------------
+
+    def fail_host(self, host: int) -> list[int]:
+        """Mark a host lost; returns the ranks that just died."""
+        ranks = self.ranks_of_host(host)
+        self._dead.update(ranks)
+        return ranks
+
+    def set_straggler(self, rank: int, mult: float) -> None:
+        self._straggler[int(rank)] = max(1.0, float(mult))
+
+    def clear_straggler(self, rank: int) -> None:
+        self._straggler.pop(int(rank), None)
+
+    def stragglers(self) -> dict[int, float]:
+        return dict(self._straggler)
+
+    # -- cost model ------------------------------------------------------
+
+    def rank_factor(self, rank: int) -> float:
+        """The rank's latency multiplier: its host's baseline factor
+        times any installed straggler multiplier."""
+        f = self._host_factor[self.host_of(rank) % self.nhosts]
+        return f * self._straggler.get(rank, 1.0)
+
+    def collective_time_s(self, algo: str, nbytes: int,
+                          participants: Optional[list[int]] = None
+                          ) -> float:
+        """Virtual duration of one collective: the autotuner's
+        closed-form (rounds, wire-bytes) mapped to seconds, gated by
+        the slowest live participant."""
+        from ..coll.sched.autotune import _steps_and_wire
+
+        live = participants if participants is not None \
+            else self.live_ranks()
+        n = max(2, len(live))
+        steps, wire = _steps_and_wire(algo, nbytes, n)
+        base = steps * ALPHA_S + wire * BETA_S_PER_BYTE
+        worst = max((self.rank_factor(r) for r in live), default=1.0)
+        return base * worst
+
+
+class _SimDevice:
+    """Opaque stand-in for a jax device: carries just enough identity
+    for reprs and equality; anything data-plane raises immediately so
+    a modeling bug can never silently fall through to jax."""
+
+    __slots__ = ("id",)
+
+    def __init__(self, rank: int) -> None:
+        self.id = rank
+
+    def __repr__(self) -> str:
+        return f"SimDevice({self.id})"
+
+    def __getattr__(self, name: str):
+        raise AttributeError(
+            f"SimDevice has no {name!r}: the armada simulator models "
+            f"control planes only — data-plane ops are out of scope"
+        )

@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Optional
 
+from ..core import clock
 from ..core import config
 from ..core.backoff import Backoff
 from ..core.counters import SPC
@@ -151,7 +152,7 @@ def collect_sample(ring: SampleRing, rank: int,
     """Capture one sample into ``ring``, each section gated on the
     deadline (monotonic seconds; None = unbounded)."""
     def due() -> bool:
-        if deadline is not None and time.monotonic() >= deadline:
+        if deadline is not None and clock.monotonic() >= deadline:
             SPC.record("telemetry_deadline_skips")
             return False
         return True
@@ -250,7 +251,7 @@ class Sampler:
         section costs this tick its data, never the thread."""
         self.ticks += 1
         SPC.record("telemetry_ticks")
-        deadline = time.monotonic() + max(1, _deadline.value) / 1000.0
+        deadline = clock.monotonic() + max(1, _deadline.value) / 1000.0
         rank = self.rank()
         rec = collect_sample(self.ring, rank, deadline)
         if _fleet.value:
@@ -292,7 +293,7 @@ class Sampler:
         while not self._stop.is_set():
             # the seeded schedule decides the wait; the stop event
             # breaks it early so stop() never waits a full interval
-            if self._stop.wait(self._bo.next_delay()):
+            if clock.wait_event(self._stop, self._bo.next_delay()):
                 break
             try:
                 self.tick()

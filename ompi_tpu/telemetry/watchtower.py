@@ -50,9 +50,9 @@ from __future__ import annotations
 import hashlib
 import json
 import threading
-import time
 from typing import Optional
 
+from ..core import clock
 from ..core import config
 from ..core.counters import SPC
 from ..core.logging import get_logger
@@ -166,7 +166,7 @@ class Watchtower:
         from ..coll.sched import cache as scache, retune, slo
 
         self.ticks += 1
-        deadline = time.monotonic() + max(1, _deadline_ms.value) / 1e3
+        deadline = clock.monotonic() + max(1, _deadline_ms.value) / 1e3
         if sample is None:
             hists = SPC.histogram_snapshots()
         else:
@@ -176,7 +176,7 @@ class Watchtower:
         drifting = 0
         entries = scache.CACHE.entries()
         for key in sorted(entries):
-            if time.monotonic() >= deadline:
+            if clock.monotonic() >= deadline:
                 SPC.record("telemetry_watchtower_deadline_skips")
                 break
             got = self._eval_key(key, entries[key], hists,

@@ -46,11 +46,12 @@ from typing import Optional
 
 #: Metric-name fragments that mark a higher-is-better series.
 _HIGHER = ("gbps", "busbw", "gb_s", "hit_rate", "speedup", "ratio_x",
-           "overlap_pct", "ticks_sampled")
+           "overlap_pct", "ticks_sampled", "_per_s")
 #: Fragments that mark a lower-is-better series. ``overhead_pct``
 #: rides the _pct absolute-slack path in _is_regression.
 _LOWER = ("p50", "p99", "_us", "_ms", "rtt", "latency", "detect_ms",
-          "overhead_pct", "tune_ms", "restore_ms", "degradation_pct")
+          "overhead_pct", "tune_ms", "restore_ms", "degradation_pct",
+          "convergence_ticks")
 
 DEFAULT_ALLOWANCE = 0.25
 

@@ -1,0 +1,138 @@
+"""armada CLI — run, replay, and diff fleet-simulator scenarios.
+
+Usage:
+    python -m ompi_tpu.tools.sim run <scenario.json> [--json out.json]
+    python -m ompi_tpu.tools.sim run --ranks 1024 --duration 20 \\
+        --tenants 32 --seed 7 --fault "3.0:host_loss@fleet:host=9"
+    python -m ompi_tpu.tools.sim replay <scenario.json> \\
+        [--reference report.json]
+    python -m ompi_tpu.tools.sim diff <report_a.json> <report_b.json>
+
+``run`` executes a scenario (from a file, or assembled from flags)
+through the real control planes under virtual time and prints the
+report; ``--json`` also writes it to a file a later ``replay
+--reference`` can verify against. ``replay`` re-runs the scenario and
+checks the merged decision-log digest is byte-identical (running the
+scenario twice when no reference report is given). ``diff`` compares
+two saved reports subsystem-by-subsystem.
+
+Exit codes: 0 ok (replay matched / reports agree), 1 digest mismatch,
+2 the run itself failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ..sim.engine import Scenario
+from ..sim.replay import diff, load_scenario, replay, run_scenario
+
+
+def _fault(spec: str) -> dict:
+    """Parse ``AT:action@layer:k=v`` into a scenario fault entry."""
+    at, sep, rest = spec.partition(":")
+    if not sep:
+        raise argparse.ArgumentTypeError(
+            f"fault {spec!r}: expected AT:action@layer:k=v")
+    try:
+        return {"at": float(at), "spec": rest}
+    except ValueError as exc:
+        raise argparse.ArgumentTypeError(
+            f"fault {spec!r}: bad fire time {at!r}") from exc
+
+
+def _scenario_from_args(args) -> Scenario:
+    if args.scenario:
+        sc = load_scenario(args.scenario)
+        if args.seed is not None:
+            sc.seed = args.seed
+        return sc
+    return Scenario(
+        name=args.name,
+        seed=args.seed if args.seed is not None else 0,
+        nranks=args.ranks,
+        duration_s=args.duration,
+        tenants=args.tenants,
+        base_rps=args.rps,
+        faults=[dict(f) for f in args.fault],
+    )
+
+
+def _emit(report: dict, path: str | None) -> None:
+    blob = json.dumps(report, indent=1, sort_keys=True)
+    if path:
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(blob + "\n")
+    print(blob)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.sim",
+        description="armada fleet-simulator scenarios over the real "
+                    "control planes")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+
+    run_p = sub.add_parser("run", help="run a scenario, print report")
+    rep_p = sub.add_parser("replay",
+                           help="re-run + verify decision-log digest")
+    for p in (run_p, rep_p):
+        p.add_argument("scenario", nargs="?", default=None,
+                       help="scenario JSON file (omit to build from "
+                            "flags)")
+        p.add_argument("--seed", type=int, default=None)
+        p.add_argument("--name", default="cli")
+        p.add_argument("--ranks", type=int, default=64)
+        p.add_argument("--duration", type=float, default=10.0)
+        p.add_argument("--tenants", type=int, default=8)
+        p.add_argument("--rps", type=float, default=100.0)
+        p.add_argument("--fault", action="append", type=_fault,
+                       default=[],
+                       help="AT:action@layer:k=v (repeatable)")
+        p.add_argument("--json", dest="json_out", default=None,
+                       help="also write the report/result here")
+    rep_p.add_argument("--reference", default=None,
+                       help="saved report to verify the digest "
+                            "against (default: run twice)")
+
+    diff_p = sub.add_parser("diff",
+                            help="compare two saved reports' digests")
+    diff_p.add_argument("report_a")
+    diff_p.add_argument("report_b")
+
+    args = ap.parse_args(argv)
+
+    if args.cmd == "diff":
+        with open(args.report_a, encoding="utf-8") as fh:
+            a = json.load(fh)
+        with open(args.report_b, encoding="utf-8") as fh:
+            b = json.load(fh)
+        mismatch = diff(a, b)
+        _emit({"ok": not mismatch, "mismatch": mismatch}, None)
+        return 0 if not mismatch else 1
+
+    try:
+        sc = _scenario_from_args(args)
+    except (OSError, ValueError) as exc:
+        print(f"sim: bad scenario: {exc}", file=sys.stderr)
+        return 2
+
+    if args.cmd == "run":
+        _emit(run_scenario(sc), args.json_out)
+        return 0
+
+    reference = None
+    if args.reference:
+        with open(args.reference, encoding="utf-8") as fh:
+            reference = json.load(fh)
+    res = replay(sc, reference)
+    _emit({"ok": res["ok"], "digest": res["digest"],
+           "reference_digest": res["reference_digest"],
+           "mismatch": res["mismatch"]}, args.json_out)
+    return 0 if res["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

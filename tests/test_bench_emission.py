@@ -212,6 +212,8 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -282,6 +284,8 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -354,6 +358,8 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -423,6 +429,8 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -501,6 +509,8 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
         bench._sched_warm_start_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -564,6 +574,8 @@ def test_daemon_rows_emit_schema_complete_on_probe_fail():
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -686,6 +698,8 @@ def test_pallas_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -770,6 +784,8 @@ def test_overlap_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -854,6 +870,8 @@ def test_step_program_rows_emit_schema_complete_on_probe_fail():
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -899,4 +917,109 @@ def test_step_program_rows_emit_schema_complete_on_probe_fail():
         assert benchgate.direction(key) == "lower"
     for key in ("per_bucket_s", "program_s", "blocking_s",
                 "overlapped_s"):
+        assert benchgate.direction(key) is None
+
+
+def test_fleet_sim_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR17 satellite 5: the fleet_sim_scale and
+    fleet_sim_determinism rows run end-to-end (real armada subprocess
+    workers driving the real control planes, shrunk via env) inside
+    the probe-failed host-only path and emit schema-complete JSON —
+    the scale row carrying pod-scale engine/admission throughput plus
+    recovery and retune-convergence ratchets, the determinism row the
+    two-subprocess byte-identical digest verdict."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the simulated pod so the schema check stays fast;
+        # tenants/rps stay at the ISSUE floor (>=100 tenants, 10k rps)
+        os.environ["OMPI_TPU_BENCH_SIM_RANKS"] = "256"
+        os.environ["OMPI_TPU_BENCH_SIM_TENANTS"] = "100"
+        os.environ["OMPI_TPU_BENCH_SIM_RPS"] = "10000"
+        os.environ["OMPI_TPU_BENCH_SIM_DURATION"] = "6"
+        os.environ["OMPI_TPU_BENCH_SIM_DET_RANKS"] = "64"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
+        bench._step_program_row = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench._telemetry_overhead_row = lambda: {"stub": True}
+        bench._watchtower_overhead_row = lambda: {"stub": True}
+        bench._straggler_detect_row = lambda: {"stub": True}
+        bench._sched_autotune_row = lambda: {"stub": True}
+        bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._pallas_sched_row = lambda: {"stub": True}
+        bench._device_resurrection_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    scale = rows["fleet_sim_scale"]
+    assert "error" not in scale, scale
+    for key in ("ranks", "tenants", "virtual_s", "wall_s", "events",
+                "events_per_s", "offered_rps", "submits", "admits",
+                "rejects", "admission_handle_per_s", "recoveries",
+                "recovery_p50_ms", "retunes",
+                "retune_convergence_ticks", "world_size_after",
+                "pass"):
+        assert key in scale, key
+    assert scale["ranks"] == 256 and scale["tenants"] == 100
+    assert scale["offered_rps"] == 10000.0
+    assert scale["events"] > 0 and scale["events_per_s"] > 0
+    assert scale["admission_handle_per_s"] > 0
+    assert scale["admits"] + scale["rejects"] <= scale["submits"]
+    # the chaos drills actually landed: host loss shrank the world
+    # (4 ranks of one host) and the straggler forced retunes
+    assert scale["world_size_after"] == 252
+    assert scale["recoveries"] > 0 and scale["recovery_p50_ms"] > 0
+    assert scale["retunes"] > 0
+    assert scale["retune_convergence_ticks"] >= 1
+    assert scale["pass"] is True
+
+    det = rows["fleet_sim_determinism"]
+    assert "error" not in det, det
+    for key in ("ranks", "runs", "digest_a", "digest_b",
+                "digests_match", "replay_match_ratio_x", "events",
+                "pass"):
+        assert key in det, key
+    assert det["runs"] == 2
+    assert det["digests_match"] is True
+    assert det["digest_a"] == det["digest_b"]
+    assert len(det["digest_a"]) == 64
+    assert det["replay_match_ratio_x"] == 1.0
+    assert det["pass"] is True
+
+    # ratchet directions resolve from the key names: throughputs
+    # higher, recovery latency + convergence lower; raw wall/virtual
+    # seconds carry no direction (scale-dependent, never ratcheted)
+    from ompi_tpu.tools import benchgate
+    for key in ("events_per_s", "admission_handle_per_s",
+                "replay_match_ratio_x"):
+        assert benchgate.direction(key) == "higher"
+    for key in ("recovery_p50_ms", "retune_convergence_ticks"):
+        assert benchgate.direction(key) == "lower"
+    for key in ("wall_s", "virtual_s"):
         assert benchgate.direction(key) is None

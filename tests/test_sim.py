@@ -1,0 +1,412 @@
+"""armada fleet simulator: virtual clock, event ordering, chaos
+drills over the real control planes, the two-subprocess replay
+contract, and the simclock lint rule."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from ompi_tpu.core import clock as seam
+from ompi_tpu.sim import (EventQueue, FleetSim, FleetTopology, Scenario,
+                          SimClock, TrafficModel)
+from ompi_tpu.sim.engine import parse_fault
+from ompi_tpu.sim.replay import diff, dump_scenario, load_scenario, \
+    replay, run_scenario
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+# -- virtual clock ------------------------------------------------------
+
+
+def test_sim_clock_monotonic_advance():
+    c = SimClock()
+    assert c.monotonic() == 0.0
+    c.advance(2.5)
+    assert c.monotonic() == 2.5
+    c.sleep(0.5)          # sleep IS advance under virtual time
+    assert c.monotonic() == 3.0
+    c.advance(-10.0)      # monotonic by contract: clamped
+    assert c.monotonic() == 3.0
+    c.advance_to(2.0)     # never backwards
+    assert c.monotonic() == 3.0
+    c.advance_to(7.25)
+    assert c.monotonic() == 7.25
+
+
+def test_sim_clock_wait_event_set_and_timeout():
+    c = SimClock()
+    ev = threading.Event()
+    ev.set()
+    t0 = c.monotonic()
+    assert c.wait_event(ev, 60.0) is True
+    assert c.monotonic() == t0    # a set event costs no virtual time
+
+    ev2 = threading.Event()
+    assert c.wait_event(ev2, 4.0) is False
+    # an unset event charges the full virtual timeout (a stall)
+    assert c.monotonic() == t0 + 4.0
+
+
+def test_sim_clock_wait_event_worker_grace():
+    """A real worker thread that finishes inside the grace window is
+    seen: virtual time is not charged."""
+    c = SimClock()
+    ev = threading.Event()
+    threading.Timer(0.05, ev.set).start()
+    assert c.wait_event(ev, 30.0) is True
+    assert c.monotonic() == 0.0
+
+
+def test_seam_install_uninstall_and_double_install():
+    c = SimClock(start=41.0)
+    assert not seam.installed()
+    with c:
+        assert seam.installed()
+        assert seam.monotonic() == 41.0
+        c.advance(1.0)
+        assert seam.monotonic() == 42.0
+        with pytest.raises(RuntimeError):
+            SimClock().install()
+    assert not seam.installed()
+
+
+def test_seam_inert_without_sim_clock():
+    """No sim installed: the seam is time.monotonic / Event.wait,
+    bit-for-bit."""
+    a = seam.monotonic()
+    b = time.monotonic()
+    assert abs(b - a) < 1.0
+    ev = threading.Event()
+    t0 = time.monotonic()
+    assert seam.wait_event(ev, 0.05) is False
+    assert time.monotonic() - t0 >= 0.04
+
+
+# -- event queue --------------------------------------------------------
+
+
+def test_event_queue_orders_by_time_then_prio_then_seq():
+    q = EventQueue()
+    q.push(2.0, "submit", tenant="b")
+    q.push(1.0, "submit", tenant="a")
+    q.push(1.0, "fault", spec="x")       # same instant: fault first
+    q.push(1.0, "submit", tenant="c")    # same (at, prio): seq order
+    got = []
+    while q:
+        e = q.pop()
+        got.append((e.at, e.kind,
+                    e.data.get("tenant") or e.data.get("spec")))
+    assert got == [(1.0, "fault", "x"), (1.0, "submit", "a"),
+                   (1.0, "submit", "c"), (2.0, "submit", "b")]
+    assert q.pushed == 4 and q.popped == 4
+
+
+# -- topology + traffic -------------------------------------------------
+
+
+def test_topology_hosts_faults_and_cost_gating():
+    topo = FleetTopology(64, chips_per_host=4, seed=3)
+    assert topo.nhosts == 16
+    assert topo.host_of(13) == 3
+    assert topo.ranks_of_host(3) == [12, 13, 14, 15]
+    dead = topo.fail_host(3)
+    assert dead == [12, 13, 14, 15]
+    assert set(dead) == topo.dead_ranks()
+    assert 13 not in topo.live_ranks()
+
+    base = topo.collective_time_s("ring", 1 << 20)
+    topo.set_straggler(20, 8.0)
+    slowed = topo.collective_time_s("ring", 1 << 20)
+    # bulk-synchronous: the slowest participant gates the collective
+    assert slowed > base * 4
+    topo.clear_straggler(20)
+    assert topo.collective_time_s("ring", 1 << 20) == base
+    # a real fingerprint, stable for the same modeled pod
+    assert topo.fingerprint() == \
+        FleetTopology(64, chips_per_host=4, seed=9).fingerprint()
+
+
+def test_traffic_seeded_and_class_shaped():
+    t1 = TrafficModel(tenants=10, base_rps=100.0, duration_s=30.0,
+                      seed=5)
+    t2 = TrafficModel(tenants=10, base_rps=100.0, duration_s=30.0,
+                      seed=5)
+    specs = t1.tenant_specs()
+    assert len(specs) == 10
+    assert specs[0][1] == "guaranteed" and specs[4][1] == "scavenger"
+    for (tenant, qos) in specs:
+        a = [t1.next_arrival(tenant, 0.0) for _ in range(20)]
+        b = [t2.next_arrival(tenant, 0.0) for _ in range(20)]
+        assert a == b     # same seed -> same arrival schedule
+        for at, nbytes in a:
+            assert at > 0.0
+            assert nbytes & (nbytes - 1) == 0    # pow2 payloads
+    t3 = TrafficModel(tenants=10, base_rps=100.0, duration_s=30.0,
+                      seed=6)
+    assert [t3.next_arrival("t001", 0.0) for _ in range(20)] != \
+        [t1.next_arrival("t001", 0.0) for _ in range(20)]
+
+
+def test_fault_grammar_parses_and_rejects():
+    assert parse_fault("host_loss@fleet:host=3") == \
+        ("host_loss", "fleet", {"host": 3})
+    assert parse_fault("straggler@fleet:rank=17,mult=8.5") == \
+        ("straggler", "fleet", {"rank": 17, "mult": 8.5})
+    assert parse_fault("flood@daemon:rate=20,key=sub") == \
+        ("flood", "daemon", {"rate": 20, "key": "sub"})
+    with pytest.raises(ValueError):
+        parse_fault("host_loss:host=3")          # no @layer
+    with pytest.raises(ValueError):
+        parse_fault("straggler@fleet:rank")      # kv without =
+
+
+# -- chaos drills over the real control planes --------------------------
+
+
+def _chaos_scenario(nranks=64, seed=7, duration_s=10.0, tenants=10):
+    return Scenario(
+        name="drill", seed=seed, nranks=nranks, duration_s=duration_s,
+        tenants=tenants, base_rps=100.0,
+        faults=[
+            {"at": 3.0, "spec": "host_loss@fleet:host=3"},
+            {"at": 4.0, "spec": "straggler@fleet:rank=17,mult=8"},
+            {"at": 5.0, "spec": "flood@daemon:rate=20,key=sub"},
+            {"at": 6.0, "spec": "quarantine@coll:tier=dcn,heal_s=1.5"},
+        ])
+
+
+def test_chaos_drills_drive_real_control_planes():
+    """One run, four drills: host loss -> lifeboat shrink, straggler
+    -> watchtower penalty + retunes, scavenger flood -> bulkhead
+    isolation, quarantine -> probation -> restore."""
+    rep = FleetSim(_chaos_scenario()).run()
+
+    # host loss: the dead host's four ranks left the world via the
+    # real PROC_FAILED -> revoke -> agree -> shrink pipeline
+    assert rep["dead_ranks"] == [12, 13, 14, 15]
+    assert rep["world_size"] == 60
+    assert rep["recoveries"] > 0 and rep["recovery_p50_ms"] > 0
+    assert rep["errors"] == 0
+
+    # persistent straggler: z-score findings promote to topology
+    # penalties and the pinned sched keys are retuned
+    assert rep["penalties"] >= 1
+    assert rep["retunes"] >= 1
+    assert rep["retune_convergence_ticks"] >= 1
+
+    # scavenger flood: bulkhead admission isolates the blast — the
+    # guaranteed class rides through untouched
+    per = rep["per_class"]
+    assert per["scavenger"]["rejected"] > 0
+    assert per["guaranteed"]["rejected"] == 0
+    assert per["guaranteed"]["admitted"] == \
+        per["guaranteed"]["requests"]
+
+    # operator quarantine heals through the real PROBATION ladder
+    # under virtual-time backoff
+    assert rep["quarantines"] >= 1
+    assert rep["restores"] >= 1
+
+    # the virtual horizon was reached; wall time is decoupled from it
+    assert rep["virtual_s"] == 10.0
+    assert rep["wall_s"] < 60.0
+
+
+def test_smoke_1024_ranks():
+    """Tier-1 pod-scale smoke: 1024 simulated ranks end-to-end with a
+    host loss, under virtual time, in seconds of wall."""
+    sc = Scenario(
+        name="pod1024", seed=42, nranks=1024, duration_s=6.0,
+        tenants=12, base_rps=150.0, pump_interval_s=0.1,
+        faults=[{"at": 2.0, "spec": "host_loss@fleet:host=100"}])
+    rep = FleetSim(sc).run()
+    assert rep["nranks"] == 1024
+    assert rep["world_size"] == 1020
+    assert rep["dead_ranks"] == [400, 401, 402, 403]
+    assert rep["recoveries"] > 0
+    assert rep["collectives"] > 0 and rep["errors"] == 0
+    assert rep["digest"]
+
+
+@pytest.mark.slow
+def test_smoke_4096_ranks():
+    sc = Scenario(
+        name="pod4096", seed=42, nranks=4096, duration_s=6.0,
+        tenants=16, base_rps=150.0, pump_interval_s=0.1,
+        faults=[{"at": 2.0, "spec": "host_loss@fleet:host=512"},
+                {"at": 3.0, "spec": "straggler@fleet:rank=17,mult=8"}])
+    rep = FleetSim(sc).run()
+    assert rep["nranks"] == 4096
+    assert rep["world_size"] == 4092
+    assert rep["recoveries"] > 0 and rep["errors"] == 0
+
+
+def test_unknown_fault_spec_raises():
+    sc = Scenario(name="bad", seed=0, nranks=8, duration_s=2.0,
+                  tenants=2, base_rps=10.0,
+                  faults=[{"at": 1.0, "spec": "meteor@fleet:size=9"}])
+    with pytest.raises(ValueError, match="unknown sim fault"):
+        FleetSim(sc).run()
+
+
+def test_seam_uninstalled_after_run_even_on_error():
+    sc = Scenario(name="bad", seed=0, nranks=8, duration_s=2.0,
+                  tenants=2, base_rps=10.0,
+                  faults=[{"at": 1.0, "spec": "meteor@fleet:size=9"}])
+    with pytest.raises(ValueError):
+        FleetSim(sc).run()
+    assert not seam.installed()
+
+
+# -- replay contract ----------------------------------------------------
+
+
+def test_replay_in_process_byte_identical():
+    res = replay(_chaos_scenario(duration_s=6.0))
+    assert res["ok"], res["mismatch"]
+    assert res["digest"] == res["reference_digest"]
+
+
+def test_replay_diff_names_divergent_subsystem():
+    a = run_scenario(_chaos_scenario(duration_s=4.0))
+    b = run_scenario(_chaos_scenario(duration_s=4.0, seed=8))
+    mismatch = diff(a, b)
+    assert mismatch, "different seeds must diverge"
+    assert "merged" in mismatch
+
+
+def test_scenario_files_round_trip(tmp_path):
+    sc = _chaos_scenario(duration_s=4.0)
+    path = str(tmp_path / "drill.json")
+    dump_scenario(sc, path)
+    back = load_scenario(path)
+    assert back == sc
+    with pytest.raises(ValueError, match="unknown scenario fields"):
+        Scenario.from_dict({"name": "x", "warp_drive": 9})
+
+
+def test_replay_two_subprocesses_byte_identical(tmp_path):
+    """THE determinism contract: the same seeded chaos scenario run in
+    two separate interpreter processes produces byte-identical merged
+    decision-log digests."""
+    sc = _chaos_scenario(nranks=32, duration_s=5.0, tenants=6)
+    spath = str(tmp_path / "scenario.json")
+    dump_scenario(sc, spath)
+    worker = (
+        "import json, sys, logging; logging.disable(logging.WARNING); "
+        "from ompi_tpu.sim.replay import run_scenario; "
+        "r = run_scenario(sys.argv[1]); "
+        "print('DIGEST ' + r['digest']); "
+        "print('SUBS ' + json.dumps(r['digests'], sort_keys=True))"
+    )
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    outs = []
+    for _ in range(2):
+        p = subprocess.run(
+            [sys.executable, "-c", worker, spath],
+            capture_output=True, text=True, env=env, cwd=REPO,
+            timeout=240)
+        assert p.returncode == 0, p.stderr[-2000:]
+        outs.append({
+            line.split(" ", 1)[0]: line.split(" ", 1)[1]
+            for line in p.stdout.splitlines()
+            if line.startswith(("DIGEST ", "SUBS "))
+        })
+    assert outs[0]["DIGEST"] == outs[1]["DIGEST"]
+    assert json.loads(outs[0]["SUBS"]) == json.loads(outs[1]["SUBS"])
+
+
+def test_cli_run_replay_diff(tmp_path):
+    """tools/sim CLI: run writes a report, replay verifies it in a
+    fresh process, diff agrees two saved reports match."""
+    from ompi_tpu.tools import sim as simcli
+
+    sc = _chaos_scenario(nranks=16, duration_s=3.0, tenants=4)
+    spath = str(tmp_path / "sc.json")
+    dump_scenario(sc, spath)
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    ra = str(tmp_path / "a.json")
+    p = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.sim", "run", spath,
+         "--json", ra],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240)
+    assert p.returncode == 0, p.stderr[-2000:]
+    p = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.sim", "replay", spath,
+         "--reference", ra],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=240)
+    assert p.returncode == 0, (p.stdout[-1000:], p.stderr[-2000:])
+    assert json.loads(p.stdout)["ok"] is True
+    # diff of a report against itself is clean, in-process
+    with open(ra, encoding="utf-8") as fh:
+        rep = json.load(fh)
+    assert simcli.main(["diff", ra, ra]) == 0
+    assert diff(rep, rep) == {}
+
+
+# -- simclock lint rule -------------------------------------------------
+
+
+def _lint_src(src, relpath):
+    from ompi_tpu.analysis.lint import Linter
+
+    lin = Linter(base=REPO)
+    return [f.rule for f in lin.lint_source(src, path=relpath,
+                                            relpath=relpath)]
+
+
+def test_simclock_rule_fires_in_decision_paths():
+    src = ("import time\n"
+           "def cooldown_over(t0):\n"
+           "    return time.monotonic() - t0 > 5\n")
+    assert "simclock" in _lint_src(src, "ompi_tpu/health/ledger.py")
+    assert "simclock" in _lint_src(src, "ompi_tpu/sim/engine.py")
+    assert "simclock" in _lint_src(src, "ompi_tpu/daemon/qos.py")
+    assert "simclock" in _lint_src(src,
+                                   "ompi_tpu/telemetry/sampler.py")
+    # out of scope: the data plane keeps its clocks
+    assert "simclock" not in _lint_src(src, "ompi_tpu/pml/fabric.py")
+    # the seam itself is the sanctioned direct caller
+    assert "simclock" not in _lint_src(src, "ompi_tpu/core/clock.py")
+
+
+def test_simclock_rule_meters_and_suppressions_pass():
+    meters = ("import time\n"
+              "def span():\n"
+              "    return time.perf_counter(), time.time_ns()\n")
+    assert "simclock" not in _lint_src(meters,
+                                       "ompi_tpu/health/prober.py")
+    allowed = ("import time\n"
+               "def wall():\n"
+               "    return time.time()"
+               "  # commlint: allow(simclock)\n")
+    assert "simclock" not in _lint_src(allowed,
+                                       "ompi_tpu/health/prober.py")
+
+
+def test_simclock_repo_decision_paths_clean():
+    """The shipped tree carries zero simclock findings: every decision
+    path in scope reads the core/clock seam."""
+    from ompi_tpu.analysis.lint import Linter
+
+    lin = Linter(base=REPO)
+    pkg = os.path.join(REPO, "ompi_tpu")
+    findings = []
+    for sub in ("sim", "health"):
+        root = os.path.join(pkg, sub)
+        for dirpath, _dirs, files in os.walk(root):
+            for fn in sorted(files):
+                if fn.endswith(".py"):
+                    findings += lin.lint_file(os.path.join(dirpath, fn))
+    for rel in ("daemon/qos.py", "telemetry/sampler.py"):
+        findings += lin.lint_file(os.path.join(pkg, rel))
+    assert [f for f in findings if f.rule == "simclock"] == []
