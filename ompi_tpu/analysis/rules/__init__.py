@@ -272,6 +272,7 @@ def ensure_rules() -> None:
         from . import revokecheck  # noqa: F401
         from . import schedcutoff  # noqa: F401
         from . import simclock  # noqa: F401
+        from . import stepbarrier  # noqa: F401
         from . import stepprogram  # noqa: F401
         from . import tags  # noqa: F401
         from . import tenantscope  # noqa: F401

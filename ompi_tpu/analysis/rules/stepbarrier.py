@@ -1,0 +1,144 @@
+"""Step-boundary barrier rule.
+
+``stepbarrier``: since slipstream (coll/sched/slipstream) pipelines
+compiled step programs across the step boundary, training-loop code
+under ``parallel/`` should keep the window open across consecutive
+steps — ``step()`` per step, ``flush()`` at window close — instead of
+fully draining between them. A ``finish()``/``wait_all()`` (or a raw
+``wait``/``bcast`` tail drain) sitting between two consecutive
+``begin_step()`` dispatches recreates the PR 16 barrier: step N's
+merged broadcast tail is paid exposed, where the window would hide it
+under step N+1's backward (and elide resident shards' allgathers
+outright).
+
+The rule flags full-drain calls between consecutive step dispatches in
+one scope — a ``begin_step ... drain ... begin_step`` straight line, or
+a loop body that both dispatches a step and drains it — when the scope
+shows no window evidence: an identifier mentioning ``flush``,
+``window`` or ``slipstream``.
+
+Suppression: ``# commlint: allow(stepbarrier)`` on the drain call (or
+the loop's / enclosing function's first line), for loops that are
+deliberately barriered (comparison arms, single-step tools).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule, call_name
+from .overlapready import _scope_walk
+
+#: Calls that fully drain a step at its boundary.
+_DRAINS = frozenset({"finish", "wait_all", "wait", "bcast"})
+
+#: Identifier substrings that count as window evidence.
+_EVIDENCE_WORDS = ("flush", "window", "slipstream")
+
+
+def _idents(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+    elif isinstance(node, (ast.Import, ast.ImportFrom)):
+        for alias in node.names:
+            yield alias.name
+
+
+def _has_window_evidence(scope: ast.AST) -> bool:
+    for node in _scope_walk(scope):
+        for ident in _idents(node):
+            low = ident.lower()
+            if any(w in low for w in _EVIDENCE_WORDS):
+                return True
+    return False
+
+
+def _ordered_calls(scope: ast.AST) -> list:
+    calls = [n for n in _scope_walk(scope) if isinstance(n, ast.Call)]
+    return sorted(calls, key=lambda c: (c.lineno, c.col_offset))
+
+
+@COMMLINT.register
+class StepBarrierRule(LintRule):
+    NAME = "stepbarrier"
+    PRIORITY = 47
+    DESCRIPTION = ("full drains between consecutive step dispatches "
+                   "under parallel/ recreate the step-boundary barrier "
+                   "— window sessions step()/flush() instead")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        rel = ctx.relpath.replace("\\", "/")
+        if "parallel/" not in rel:
+            return
+        scopes = [ctx.tree] + [
+            n for n in ast.walk(ctx.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        for scope in scopes:
+            if _has_window_evidence(scope):
+                continue
+            flagged: dict = {}   # id(call) -> call, insertion-ordered
+            for call in self._straight_line(scope):
+                flagged.setdefault(id(call), call)
+            for call in self._loops(ctx, scope):
+                flagged.setdefault(id(call), call)
+            yield from self._flag(ctx, scope, flagged.values())
+
+    def _straight_line(self, scope) -> Iterable:
+        """begin_step ... drain ... begin_step in program order."""
+        seen_begin = False
+        pending: list = []
+        for call in _ordered_calls(scope):
+            name = call_name(call)
+            if name == "begin_step":
+                if seen_begin and pending:
+                    yield from pending
+                seen_begin = True
+                pending = []
+            elif seen_begin and name in _DRAINS:
+                pending.append(call)
+
+    def _loops(self, ctx, scope) -> Iterable:
+        """A loop body that both dispatches a step and drains it runs
+        consecutive steps with a barrier between every pair."""
+        for node in _scope_walk(scope):
+            if not isinstance(node, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            names = {}
+            for n in ast.walk(node):
+                if isinstance(n, ast.Call):
+                    names.setdefault(call_name(n), []).append(n)
+            if "begin_step" not in names:
+                continue
+            drains = [c for d in sorted(_DRAINS)
+                      for c in names.get(d, ())]
+            if not drains:
+                continue
+            if ctx.suppressed(node.lineno, self.NAME):
+                continue
+            yield from drains
+
+    def _flag(self, ctx, scope, drains) -> Iterable:
+        lines = []
+        if isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lines.append(scope.lineno)
+        if any(ctx.suppressed(ln, self.NAME) for ln in lines):
+            return
+        for call in drains:
+            if ctx.suppressed(call.lineno, self.NAME):
+                continue
+            yield self.finding(
+                ctx, call,
+                f"{call_name(call)}() fully drains the step between "
+                "consecutive begin_step() dispatches with no "
+                "window/flush evidence in scope — the slipstream "
+                "window (parallel/overlap window >= 2, or "
+                "dp.window_session) hides the broadcast tail under "
+                "the next backward; pipeline with step()/flush() (or "
+                "annotate commlint: allow(stepbarrier))",
+            )

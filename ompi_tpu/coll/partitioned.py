@@ -521,6 +521,19 @@ class PartitionedAllreduce:
         return self._local
 
     @property
+    def tail_armed(self) -> bool:
+        """The deferred broadcast tail is armed: reduction complete and
+        the root-local buffer held for the merged broadcast. This is
+        the slipstream readiness hook — a step program's tail becomes a
+        schedulable node exactly when every bucket reports tail_armed,
+        at which point the executor may defer the broadcast past
+        finish() into the next step's dispatch window. Stays True until
+        the next start() re-arms the flow (the buffer survives wait()),
+        False always in eager-broadcast mode."""
+        return bool(self._defer_bcast and self._reduce_done
+                    and self._local is not None)
+
+    @property
     def reduced(self) -> bool:
         """True once every tile has been combined and the reduced
         buffer broadcast — the consumer-side hook: per-bucket apply

@@ -114,11 +114,17 @@ class ScheduleCache:
             score: Optional[float] = None,
             frontier: Optional[list] = None,
             baseline_p50_us: Optional[float] = None,
-            tile_bytes: Optional[int] = None) -> None:
+            tile_bytes: Optional[int] = None,
+            ag_deadline: Optional[int] = None,
+            resident: Optional[bool] = None) -> None:
         ent = {"algorithm": algorithm, "schedule": schedule,
                "source": source, "version": 1}
         if tile_bytes is not None:
             ent["tile_bytes"] = int(tile_bytes)
+        if ag_deadline is not None:
+            ent["ag_deadline"] = int(ag_deadline)
+        if resident is not None:
+            ent["resident"] = bool(resident)
         if tune_ms is not None:
             ent["tune_ms"] = round(float(tune_ms), 3)
         if score is not None:
@@ -136,7 +142,9 @@ class ScheduleCache:
              score: Optional[float] = None,
              frontier: Optional[list] = None,
              baseline_p50_us: Optional[float] = None,
-             tile_bytes: Optional[int] = None) -> int:
+             tile_bytes: Optional[int] = None,
+             ag_deadline: Optional[int] = None,
+             resident: Optional[bool] = None) -> int:
         """Install a new winner as a **version-bumped** entry: the
         prior winner survives one level deep under ``"previous"`` so a
         bad retune can be rolled back. Never mutates the old entry in
@@ -147,6 +155,10 @@ class ScheduleCache:
                "source": source}
         if tile_bytes is not None:
             new["tile_bytes"] = int(tile_bytes)
+        if ag_deadline is not None:
+            new["ag_deadline"] = int(ag_deadline)
+        if resident is not None:
+            new["resident"] = bool(resident)
         if tune_ms is not None:
             new["tune_ms"] = round(float(tune_ms), 3)
         if score is not None:
@@ -161,10 +173,11 @@ class ScheduleCache:
                 new["version"] = 1
             else:
                 # a retune must not silently drop the step-program tile
-                # geometry tuned onto this key: carry it forward unless
-                # the bump supplies a fresh one
-                if "tile_bytes" in old and "tile_bytes" not in new:
-                    new["tile_bytes"] = old["tile_bytes"]
+                # geometry or shard-residency plan tuned onto this key:
+                # carry them forward unless the bump supplies fresh ones
+                for carry in ("tile_bytes", "ag_deadline", "resident"):
+                    if carry in old and carry not in new:
+                        new[carry] = old[carry]
                 new["version"] = int(old.get("version", 1)) + 1
                 new["previous"] = {
                     "algorithm": old.get("algorithm", ""),
@@ -188,6 +201,16 @@ class ScheduleCache:
                         "schedule": prev.get("schedule", ""),
                         "source": prev.get("source", "") or "rollback",
                         "version": int(ent.get("version", 1)) + 1}
+            # rolling an algorithm winner back must not drop the
+            # key-scoped tuning facts riding the entry (tile geometry,
+            # shard-residency plan) — they are orthogonal to which
+            # winner is installed, and a watchtower
+            # bump-then-rollback cycle would otherwise silently erase
+            # the residency decisions every same-seed controller
+            # recompiles from
+            for carry in ("tile_bytes", "ag_deadline", "resident"):
+                if carry in ent:
+                    restored[carry] = ent[carry]
             self._entries[key] = restored
             self._generation += 1
             return True
@@ -250,11 +273,16 @@ class ScheduleCache:
                         "schedule": e.get("schedule", ""),
                         "version": int(e.get("version", 1)),
                         # semantic only when tuned: program tile
-                        # geometry changes what executes, so it joins
-                        # the digest — but only when present, keeping
-                        # pre-program caches' digests byte-stable
+                        # geometry and shard-residency plans change
+                        # what executes, so they join the digest — but
+                        # only when present, keeping pre-program and
+                        # pre-slipstream caches' digests byte-stable
                         **({"tile_bytes": int(e["tile_bytes"])}
-                           if "tile_bytes" in e else {})}
+                           if "tile_bytes" in e else {}),
+                        **({"ag_deadline": int(e["ag_deadline"])}
+                           if "ag_deadline" in e else {}),
+                        **({"resident": bool(e["resident"])}
+                           if "resident" in e else {})}
                     for k, e in sorted(self._entries.items())
                 },
             }

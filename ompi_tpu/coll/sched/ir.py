@@ -418,10 +418,17 @@ class ProgramNode:
     name: str
     schedule: Schedule
     deps: tuple = ()
+    #: forward-consume deadline (slipstream): the step-N+1 layer index
+    #: that first reads this node's output, or -1 when unknown.  Enters
+    #: the render (and hence the program digest) only when set, so
+    #: pre-slipstream programs keep their digests.
+    deadline: int = -1
 
     def render(self) -> str:
         dep = ",".join(self.deps) if self.deps else "-"
         head = f"node {self.name} deps={dep}"
+        if self.deadline >= 0:
+            head = f"{head} deadline={self.deadline}"
         body = "\n".join("  " + ln
                          for ln in self.schedule.render().splitlines())
         return f"{head}\n{body}"
@@ -499,18 +506,25 @@ def check_program(prog: Program) -> None:
 
 
 def zero_pair(name: str, nranks: int,
-              order: Optional[Sequence[int]] = None
+              order: Optional[Sequence[int]] = None,
+              ag_deadline: Optional[int] = None
               ) -> tuple[ProgramNode, ProgramNode]:
     """A ZeRO-style reduce-scatter + allgather node pair: ``<name>.rs``
     reduces shard order[p] onto rank order[p], ``<name>.ag`` (gated on
     the rs) circulates the reduced shards back out. Together they move
     the same bytes as a ring allreduce but expose the shard-owner
-    boundary as a schedulable dependency edge."""
+    boundary as a schedulable dependency edge.
+
+    ``ag_deadline`` stamps the allgather node with the step-N+1 forward
+    layer that first consumes this bucket's parameters (slipstream's
+    residency cost input); it enters the node render and therefore the
+    program digest."""
     rs = ProgramNode(name=f"{name}.rs",
                      schedule=reduce_scatter(nranks, order=order))
     ag = ProgramNode(name=f"{name}.ag",
                      schedule=allgather(nranks, order=order),
-                     deps=(f"{name}.rs",))
+                     deps=(f"{name}.rs",),
+                     deadline=-1 if ag_deadline is None else int(ag_deadline))
     return rs, ag
 
 

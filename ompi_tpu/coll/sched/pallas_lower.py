@@ -61,9 +61,12 @@ from .ir import ANNOTATIONS, Schedule, Step, check as _check
 
 #: collective_id namespace: 0-11 belong to the hand-written coll
 #: kernels (pallas_ring, pallas_shift, quant, ...); the sched compiler
-#: owns 12 (allreduce programs), 13 (reduce-scatter programs) and
-#: 14 (allgather programs — the AG half of a ZeRO-style step node).
-_COLLECTIVE_ID = {"allreduce": 12, "reduce_scatter": 13, "allgather": 14}
+#: owns 12 (allreduce programs), 13 (reduce-scatter programs),
+#: 14 (allgather programs — the AG half of a ZeRO-style step node) and
+#: 15 (step-boundary window programs: a step's allgather tail fused
+#: with the next step's first reduce-scatter group — slipstream).
+_COLLECTIVE_ID = {"allreduce": 12, "reduce_scatter": 13, "allgather": 14,
+                  "window": 15}
 
 #: compiled-wrapper memo keyed by schedule digest (kernel analysis is
 #: pure python; jit caching happens downstream in compile_plan).
@@ -182,6 +185,37 @@ def analyze(sched: Schedule) -> _Program:
                     f"schedule {sched.name!r}: rank {k} neither receives"
                     f" nor stages chunks {sorted(missing)} — the output "
                     f"would be partial")
+    if sched.op == "window":
+        # Boundary-spanning programs (slipstream): completeness holds
+        # member-wise. Segments are the brk-delimited round runs; each
+        # must be mode-uniform — an allgather tail member is all-copy,
+        # a reduce-scatter member all-reduce — and copy segments must
+        # cover their chunk universe like a standalone allgather.
+        seg_start = [r for r in range(rounds) if brk[r]]
+        for si, s0 in enumerate(seg_start):
+            s1 = (seg_start[si + 1] if si + 1 < len(seg_start)
+                  else rounds)
+            modes = {mode[r] for r in range(s0, s1)}
+            if len(modes) != 1:
+                raise ArgumentError(
+                    f"schedule {sched.name!r}: window segment rounds "
+                    f"{s0}..{s1 - 1} mix reduce and copy receive kinds")
+            if modes == {2}:
+                universe = {int(t_schunk[r, k])
+                            for r in range(s0, s1) for k in range(n)}
+                universe |= {int(t_rchunk[r, k])
+                             for r in range(s0, s1) for k in range(n)}
+                for k in range(n):
+                    got = {int(t_rchunk[r, k]) for r in range(s0, s1)}
+                    got |= {int(t_schunk[r, k]) for r in range(s0, s1)
+                            if brk[r]}
+                    missing = universe - got
+                    if missing:
+                        raise ArgumentError(
+                            f"schedule {sched.name!r}: window copy "
+                            f"segment at round {s0}: rank {k} neither "
+                            f"receives nor stages chunks "
+                            f"{sorted(missing)}")
     return _Program(op=sched.op, nranks=n, nchunks=sched.nchunks,
                     rounds=rounds, mode=tuple(mode), last=tuple(last),
                     brk=tuple(brk), t_dst=t_dst, t_src=t_src,
@@ -229,6 +263,64 @@ def fuse_schedules(name: str, scheds) -> Schedule:
         steps=tuple(steps),
         meta={"tier": "device_pallas", "lowering": "pallas",
               "segments": len(scheds)},
+    )
+    _check(fused)
+    analyze(fused)  # enforce the dense/chained/round-uniform contract
+    return fused
+
+
+def fuse_window(name: str, tail_scheds, next_scheds) -> Schedule:
+    """Fuse a step-boundary window into ONE table program (slipstream):
+    step N's merged broadcast tail — its dense round-uniform allgather
+    members — chained with step N+1's first reduce-scatter group. Same
+    chunk-base/round-base chaining as ``fuse_schedules``; each member
+    start is a chain-break re-stage, which ``analyze`` already accepts.
+    The fused op is ``"window"`` (collective_id 15): copy segments
+    write like an allgather, reduce segments emit each rank's own
+    reduced chunk at their segment-final round.
+
+    The contract is strict — every tail member must be op="allgather",
+    every next-step member op="reduce_scatter", all on one rank count —
+    because a window that silently dropped a member would break the
+    two-step bit-identity oracle. Callers treat ArgumentError as "keep
+    per-node kernels for this boundary"."""
+    tail = list(tail_scheds)
+    nxt = list(next_scheds)
+    if not tail or not nxt:
+        raise ArgumentError(
+            "fuse_window needs at least one tail member and one "
+            "next-step member")
+    n = tail[0].nranks
+    for s in tail:
+        if s.op != "allgather":
+            raise ArgumentError(
+                f"fuse_window: tail member {s.name!r} is op={s.op!r}, "
+                f"the broadcast tail fuses allgather members only")
+    for s in nxt:
+        if s.op != "reduce_scatter":
+            raise ArgumentError(
+                f"fuse_window: next-step member {s.name!r} is "
+                f"op={s.op!r}, the boundary fuses into the next step's "
+                f"reduce-scatter group only")
+    for s in tail + nxt:
+        if s.nranks != n:
+            raise ArgumentError(
+                f"fuse_window: member {s.name!r} has nranks="
+                f"{s.nranks}, window is nranks={n}")
+    steps: list[Step] = []
+    chunk_base = round_base = 0
+    for s in tail + nxt:
+        for st in s.steps:
+            steps.append(Step(st.round + round_base, st.kind, st.rank,
+                              st.peer, st.chunk + chunk_base))
+        chunk_base += s.nchunks
+        round_base += s.rounds()
+    fused = Schedule(
+        name=name, op="window", nranks=n, nchunks=chunk_base,
+        steps=tuple(steps),
+        meta={"tier": "device_pallas", "lowering": "pallas",
+              "segments": len(tail) + len(nxt),
+              "boundary": len(tail)},
     )
     _check(fused)
     analyze(fused)  # enforce the dense/chained/round-uniform contract
@@ -294,9 +386,14 @@ def simulate(sched, data, op):
         if r >= 1 and prog.brk[r]:
             for k in range(n):
                 comm[k][slot] = data[k, int(prog.t_schunk[r, k])]
-        if prog.op == "allgather" and prog.brk[r]:
+        if prog.brk[r] and (prog.op == "allgather"
+                            or (prog.op == "window"
+                                and prog.mode[r] == 2)):
             # Own chunk never travels: it reaches the output at the
-            # stage round, mirroring the kernel's out-write.
+            # stage round, mirroring the kernel's out-write. In a
+            # window program this fires only for copy (allgather tail)
+            # segments — a reduce-scatter member's stage round feeds
+            # the wire, never the output.
             for k in range(n):
                 c = int(prog.t_schunk[r, k])
                 out[k][c] = data[k, c]
@@ -316,10 +413,26 @@ def simulate(sched, data, op):
             if prog.op == "reduce_scatter":
                 if r == rounds - 1:
                     out[k] = val
+            elif prog.op == "window" and prog.mode[r] == 1:
+                # Reduce segment of a boundary window: only the
+                # segment-final receive is fully reduced (the rank's
+                # own shard) — intermediate receives are partial sums
+                # forwarded down the chain, unlike an allreduce where
+                # a chunk's last receive is final by construction.
+                if r == rounds - 1 or prog.brk[r + 1]:
+                    out[k][int(prog.t_rchunk[r, k])] = val
             elif prog.last[r]:
                 out[k][int(prog.t_rchunk[r, k])] = val
     if prog.op == "reduce_scatter":
         return jnp.stack(out)
+    if prog.op == "window":
+        # Reduce-segment chunks a rank does not own never reach its
+        # output — backfill with the rank's input so the stacked
+        # result is dense (callers read only owned shards there).
+        for k in range(n):
+            for c in range(prog.nchunks):
+                if out[k][c] is None:
+                    out[k][c] = data[k, c]
     return jnp.stack([jnp.stack(row) for row in out])
 
 
@@ -356,9 +469,13 @@ def _kernel(axis_name: str, op, prog: _Program,
                 # at round r-1 and the next remote write into it (round
                 # r+1) is still credit-gated, so a plain store is safe.
                 comm_buf[slot] = x_ref[t_schunk[r, me]]
-        if prog.op == "allgather" and prog.brk[r]:
+        if prog.brk[r] and (prog.op == "allgather"
+                            or (prog.op == "window"
+                                and prog.mode[r] == 2)):
             # A rank's own chunk never travels the ring: the staged
-            # value IS its final value, written straight to the output.
+            # value IS its final value, written straight to the output
+            # (copy segments only — a window's reduce-scatter member
+            # stages for the wire, not the output).
             out_ref[t_schunk[r, me]] = x_ref[t_schunk[r, me]]
         rdma = pltpu.make_async_remote_copy(
             src_ref=comm_buf.at[slot],
@@ -381,6 +498,11 @@ def _kernel(axis_name: str, op, prog: _Program,
         if prog.op == "reduce_scatter":
             if r == rounds - 1:
                 out_ref[:] = val
+        elif prog.op == "window" and prog.mode[r] == 1:
+            # Reduce segment: only the segment-final receive is the
+            # rank's fully-reduced own shard (see simulate).
+            if r == rounds - 1 or prog.brk[r + 1]:
+                out_ref[t_rchunk[r, me]] = val
         elif prog.last[r]:
             out_ref[t_rchunk[r, me]] = val
         # Drained comm_buf[nslot]; credit the rank that refills it at
@@ -488,4 +610,4 @@ def _make_wrapper(prog: _Program, name: str) -> Callable:
 
 
 __all__ = ["analyze", "clear_compiled", "compile_schedule",
-           "fuse_schedules", "simulate"]
+           "fuse_schedules", "fuse_window", "simulate"]

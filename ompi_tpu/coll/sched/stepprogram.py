@@ -56,13 +56,16 @@ class NodePlan:
 
     bucket: int
     name: str
-    choice: str        # "allreduce" | "rs_ag"
+    choice: str        # "allreduce" | "rs_ag" | "rs_resident"
     elems: int
     dtype: Any         # np.dtype
     tile_bytes: int
     tile_elems: int
     tiles: int
     tile_source: str   # "caller" | "cache" | "model"
+    #: forward-consume deadline behind an "rs_ag"/"rs_resident" choice
+    #: (slipstream); None when the caller supplied no deadlines.
+    ag_deadline: Optional[int] = None
 
 
 @dataclass(frozen=True)
@@ -88,6 +91,7 @@ def compile_step(nranks: int, buckets: Sequence, *,
                  tile_bytes=None, seed: Optional[int] = None,
                  topo_fp: Optional[str] = None,
                  node_choices: Optional[Sequence] = None,
+                 ag_deadlines: Optional[Sequence] = None,
                  order: Optional[Sequence] = None,
                  name: str = "step") -> CompiledStep:
     """Compile a step's bucket list into one multi-collective program.
@@ -97,7 +101,13 @@ def compile_step(nranks: int, buckets: Sequence, *,
     tile geometry (caller > winner-cache ``tile_bytes`` > model — no
     silent fallback to a static default) and the
     RS/AG-vs-allreduce schedule decision; ``node_choices`` pins the
-    latter per bucket ("allreduce" / "rs_ag" / None). Deterministic:
+    latter per bucket ("allreduce" / "rs_ag" / "rs_resident" / None).
+    ``ag_deadlines`` (per bucket, None entries allowed) feeds the
+    shard-residency model: a bucket whose owner shard can stay
+    resident past its forward-consume deadline compiles to a LONE
+    reduce-scatter node — the allgather is elided entirely
+    ("rs_resident"), visible in the program digest via the choices
+    meta and counted on ``sched_ag_elided_total``. Deterministic:
     same (buckets, nranks, seed, cache state) on any controller yields
     a byte-identical ``Program`` render and digest.
     """
@@ -111,7 +121,8 @@ def compile_step(nranks: int, buckets: Sequence, *,
     choices = _autotune.program_choices(
         [e * d.itemsize for e, d in specs], nranks,
         dtypes=[str(d) for _, d in specs], seed=seed, topo_fp=topo_fp,
-        tile_bytes=tile_bytes, node_choices=node_choices)
+        tile_bytes=tile_bytes, node_choices=node_choices,
+        ag_deadlines=ag_deadlines)
     nodes: list[NodePlan] = []
     prog_nodes: list[ir.ProgramNode] = []
     for i, ((elems, dtype), ch) in enumerate(zip(specs, choices)):
@@ -123,13 +134,24 @@ def compile_step(nranks: int, buckets: Sequence, *,
         choice = ch["choice"]
         if nranks < 2:
             choice = "allreduce"  # degenerate comm: nothing to scatter
+        dl = ch.get("ag_deadline")
         nodes.append(NodePlan(
             bucket=i, name=f"b{i}", choice=choice, elems=elems,
             dtype=dtype, tile_bytes=tb, tile_elems=tile_elems,
-            tiles=tiles, tile_source=ch["tile_source"]))
+            tiles=tiles, tile_source=ch["tile_source"],
+            ag_deadline=dl))
         if nranks >= 2:
             if choice == "rs_ag":
-                prog_nodes.extend(ir.zero_pair(f"b{i}", nranks, order))
+                prog_nodes.extend(ir.zero_pair(f"b{i}", nranks, order,
+                                               ag_deadline=dl))
+            elif choice == "rs_resident":
+                # Shard residency: the owner shard stays resident on
+                # the optimizer path and the next forward reads it in
+                # place — the allgather node is elided entirely.
+                rs, _ag = ir.zero_pair(f"b{i}", nranks, order,
+                                       ag_deadline=dl)
+                prog_nodes.append(rs)
+                SPC.record("sched_ag_elided_total")
             else:
                 prog_nodes.append(ir.ProgramNode(
                     f"b{i}", ir.ring(nranks, order), ()))
@@ -146,6 +168,13 @@ def compile_step(nranks: int, buckets: Sequence, *,
                             for i, n in enumerate(nodes)),
         "interleave": ",".join(str(i) for i in interleave),
     }
+    if any(n.ag_deadline is not None for n in nodes):
+        # Deadlines are compile inputs that changed what executes —
+        # they join the digest; absent entirely (the pre-slipstream
+        # shape) the meta and digest stay byte-stable.
+        meta["deadlines"] = ",".join(
+            f"b{i}:{'-' if n.ag_deadline is None else n.ag_deadline}"
+            for i, n in enumerate(nodes))
     program = ir.Program(name=name, nranks=nranks,
                          nodes=tuple(prog_nodes), meta=meta)
     ir.check_program(program)
@@ -286,6 +315,13 @@ class ShardedAllreduce:
         for *_, pa in self._shards:
             pa.abort()
 
+    @property
+    def tail_armed(self) -> bool:
+        """Every shard's deferred broadcast tail is armed (slipstream's
+        schedulable-tail-node readiness: see PartitionedAllreduce
+        .tail_armed)."""
+        return all(pa.tail_armed for *_, pa in self._shards)
+
     def local_segments(self) -> list:
         """(root, col_lo, col_hi, local_1d) per shard — the merged
         broadcast's input slices (defer_bcast mode)."""
@@ -324,7 +360,8 @@ class StepExecutor:
         self.bindings: list = []
         tag = tag_base
         for nd in compiled.nodes:
-            if nd.choice == "rs_ag" and comm.size >= 2:
+            if (nd.choice in ("rs_ag", "rs_resident")
+                    and comm.size >= 2):
                 b = ShardedAllreduce(
                     comm, nd.elems, nd.dtype, op=op, tiles=nd.tiles,
                     tile_elems=nd.tile_elems, tag_base=tag,
@@ -362,13 +399,39 @@ class StepExecutor:
     def wait_all(self, timeout: float = 60.0) -> list:
         """Wait every node's reduction, then resolve results: legacy
         mode returns each bucket's own broadcast result; step-program
-        mode fires the merged per-root broadcast and reassembles."""
+        mode fires the merged per-root broadcast and reassembles.
+        Equivalent to ``wait_reduced()`` + ``finish_tail()`` — the
+        slipstream window session calls the halves separately so the
+        tail can dispatch under the next step's backward."""
+        got = self.wait_reduced(timeout)
+        if self._legacy:
+            return got
+        return self.finish_tail()
+
+    def wait_reduced(self, timeout: float = 60.0):
+        """Drive every node's reduction to completion WITHOUT firing
+        the merged broadcast tail. Legacy mode (no deferred tail)
+        returns the per-bucket results; step-program mode returns None
+        with every binding's tail armed and the merged drain dropped
+        (nothing left to pump — the tail is a plain collective)."""
         deadline = time.monotonic() + timeout
         raw = []
         for b in self.bindings:
             raw.append(b.wait(max(0.1, deadline - time.monotonic())))
         if self._legacy:
             return [np.asarray(r) for r in raw]
+        self._drop_pump()
+        return None
+
+    def finish_tail(self) -> list:
+        """Fire the merged per-root broadcast tail and reassemble the
+        step's outputs. Requires every binding's tail armed (i.e. a
+        completed ``wait_reduced``)."""
+        for i, b in enumerate(self.bindings):
+            if not b.tail_armed:
+                raise RequestError(
+                    f"finish_tail: node {self.compiled.nodes[i].name} "
+                    f"tail not armed — wait_reduced() first")
         try:
             return self._merged_bcast()
         finally:
@@ -379,12 +442,23 @@ class StepExecutor:
         deferred root-local segment concatenates (as raw bytes, so
         mixed-dtype buckets share the collective) into a single
         rank-major buffer, and the replicated result splits back into
-        per-bucket (size, elems) views."""
+        per-bucket (size, elems) views.
+
+        rs_resident buckets never enter the broadcast: their owner
+        shards stay resident and every rank's "next-forward read" is
+        assembled directly from the resident owner segment — the
+        elided allgather is exactly this skipped wire traffic."""
         import jax.numpy as jnp
 
         size = self._comm.size
         segs: list = []  # (root, bucket, col_lo, col_hi, bytes_1d)
+        out = [np.zeros((size, nd.elems), nd.dtype)
+               for nd in self.compiled.nodes]
         for i, b in enumerate(self.bindings):
+            if self.compiled.nodes[i].choice == "rs_resident":
+                for root, lo, hi, local in b.local_segments():
+                    out[i][:, lo:hi] = np.asarray(local)[None, :]
+                continue
             if isinstance(b, ShardedAllreduce):
                 for root, lo, hi, local in b.local_segments():
                     segs.append((root, i, lo, hi,
@@ -394,8 +468,6 @@ class StepExecutor:
                 segs.append((b._root, i, 0, b._elems,
                              np.ascontiguousarray(b.local_reduced())
                              .view(np.uint8)))
-        out = [np.zeros((size, nd.elems), nd.dtype)
-               for nd in self.compiled.nodes]
         by_root: dict[int, list] = {}
         for seg in segs:
             by_root.setdefault(seg[0], []).append(seg)

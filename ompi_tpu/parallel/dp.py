@@ -41,6 +41,21 @@ def mean_gradients(grads: Any, axis_name: str = "dp") -> Any:
     return jax.tree.map(lambda g: g / n, summed)
 
 
+def window_session(comm, template: Any, *, window: int = 2,
+                   **kwargs) -> "_overlap.DpOverlapSession":
+    """A slipstream window session over ``template``'s gradient
+    structure: a :class:`~ompi_tpu.parallel.overlap.DpOverlapSession`
+    whose compiled step program pipelines across the step boundary
+    (``window >= 2`` — step N's merged broadcast tail dispatches under
+    step N+1's backward, shard-resident buckets skip their allgather
+    entirely). Drive it with ``begin_step()/mark_ready()/step()`` per
+    training step and ``flush()`` at window close; ``finish()`` still
+    works as close-plus-flush. Keyword arguments pass through to the
+    session constructor (tile_bytes, node_choices, seed, ...)."""
+    return _overlap.DpOverlapSession(
+        comm, template, window=window, **kwargs)
+
+
 def shard_batch(batch: Any, axis_name: str = "dp"):
     """Slice a replicated batch to this dp rank's shard (inside shard_map
     the incoming block is already sharded; this helper is for manual

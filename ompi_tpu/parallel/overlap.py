@@ -134,12 +134,19 @@ def plan_overlap(per_rank_leaves: list, treedef,
 
 @dataclasses.dataclass
 class OverlapReport:
-    """Per-step overlap accounting (the dp_step_overlap_pct source)."""
+    """Per-step overlap accounting (the dp_step_overlap_pct source).
+
+    Window sessions (``window >= 2``) additionally account the step's
+    merged broadcast tail: ``tail_ms`` is its dispatch wall-time and
+    ``tail_overlap_ms`` the share of it hidden under the NEXT step's
+    backward pass (the slipstream headline)."""
     backward_ms: float = 0.0
     comm_ms: float = 0.0
     exposed_comm_ms: float = 0.0
     tiles: int = 0
     buckets: int = 0
+    tail_ms: float = 0.0
+    tail_overlap_ms: float = 0.0
 
     @property
     def overlap_pct(self) -> float:
@@ -149,6 +156,28 @@ class OverlapReport:
             return 100.0
         pct = 100.0 * (1.0 - self.exposed_comm_ms / self.comm_ms)
         return max(0.0, min(100.0, pct))
+
+
+class _TailNode:
+    """One closed step's armed broadcast tail, queued for dispatch.
+
+    The claim protocol (claim under the fire lock, run unlocked) lets
+    the pump thread dispatch the tail concurrently with the next step's
+    backward while flush()/begin_step() can still force-complete it —
+    whoever claims first runs ``finish_tail()``; everyone else waits on
+    the event."""
+
+    __slots__ = ("exec_", "phase", "report", "event", "claimed",
+                 "result", "error")
+
+    def __init__(self, exec_, phase: int, report: OverlapReport) -> None:
+        self.exec_ = exec_
+        self.phase = phase
+        self.report = report
+        self.event = threading.Event()
+        self.claimed = False
+        self.result = None
+        self.error: Optional[BaseException] = None
 
 
 class DpOverlapSession:
@@ -174,6 +203,25 @@ class DpOverlapSession:
     it to live transport. ``step_program=False`` drops back to the
     PR 15 per-bucket behaviour (one broadcast and one progress
     callback per bucket) — kept as the bench's comparison arm.
+
+    ``window >= 2`` turns the session into a **slipstream window**
+    (coll/sched/slipstream): the bucket list compiles through
+    :func:`~ompi_tpu.coll.sched.slipstream.compile_window` (shard
+    residency included — elided allgathers never build wire flows),
+    and the step loop becomes::
+
+        sess.begin_step(); ...mark_ready...; sess.step()   # step N
+        sess.begin_step(); ...mark_ready...; sess.step()   # step N+1
+        results = sess.flush()       # [(grads, report), ...] in order
+
+    ``step()`` closes the step at ``wait_reduced()`` — reductions done,
+    merged broadcast tail ARMED but not drained — and queues the tail
+    for the pump thread, which dispatches it concurrently with step
+    N+1's backward tile bursts. Each phase of the window owns its own
+    executor (disjoint tag ranges), so step N's tail and step N+1's
+    reductions coexist on the fabric. ``finish()`` still works (close +
+    flush, last step's result) and :meth:`abort_window` collapses the
+    window deterministically (the lifeboat path).
     """
 
     def __init__(self, comm, template: Any, op: Any = SUM,
@@ -184,7 +232,9 @@ class DpOverlapSession:
                  progress_thread: bool = True,
                  step_program: bool = True,
                  node_choices: Optional[list] = None,
-                 seed: Optional[int] = None) -> None:
+                 seed: Optional[int] = None,
+                 window: int = 1,
+                 ag_deadlines: Optional[list] = None) -> None:
         from ..coll.sched.stepprogram import StepExecutor, compile_step
 
         leaves, treedef = jax.tree.flatten(template)
@@ -220,18 +270,46 @@ class DpOverlapSession:
         }
         self._comm = comm
         self._op = op
+        self._window = int(window)
+        if self._window < 1:
+            raise ArgumentError(f"window must be >= 1, got {window}")
+        if self._window >= 2 and not step_program:
+            raise ArgumentError(
+                "window sessions pipeline compiled step programs — "
+                "window >= 2 needs step_program=True")
         # Compile the step: the bucket list becomes one multi-
         # collective Program, and its executor owns every per-bucket
         # flow. Explicit tile_bytes wins; otherwise the autotuner
         # consults the winner cache, then the model — never a static
-        # default.
-        self.compiled = compile_step(
-            size, [(b.elems, b.dtype) for b in self.plan.buckets],
-            tile_bytes=tile_bytes, seed=seed,
-            node_choices=node_choices)
-        self._exec = StepExecutor(
-            comm, self.compiled, op=op, allow_quant=allow_quant,
-            tag_base=tag_base, legacy=not step_program)
+        # default. Window sessions compile the two-step slipstream
+        # window instead (tail node + shard residency + boundary
+        # fusion), and execute its repeated step per phase.
+        bucket_list = [(b.elems, b.dtype) for b in self.plan.buckets]
+        if self._window >= 2:
+            from ..coll.sched import slipstream
+            self.compiled_window = slipstream.compile_window(
+                size, bucket_list, tile_bytes=tile_bytes, seed=seed,
+                node_choices=node_choices, ag_deadlines=ag_deadlines)
+            self.compiled = self.compiled_window.step
+        else:
+            self.compiled_window = None
+            self.compiled = compile_step(
+                size, bucket_list, tile_bytes=tile_bytes, seed=seed,
+                node_choices=node_choices, ag_deadlines=ag_deadlines)
+        # One executor per window phase, disjoint tag ranges (a
+        # ShardedAllreduce consumes nshards tags, everything else one)
+        # plus slack, so step N's armed tail and step N+1's reductions
+        # coexist on the fabric without tag collisions.
+        self._execs = []
+        tag = tag_base
+        for _ in range(self._window):
+            ex = StepExecutor(
+                comm, self.compiled, op=op, allow_quant=allow_quant,
+                tag_base=tag, legacy=not step_program)
+            self._execs.append(ex)
+            tag += sum(getattr(b, "nshards", 1)
+                       for b in ex.bindings) + 8
+        self._phase = 0
         self._pas = self._exec.bindings
         # Stamp the compiled geometry back into the plan so the plan
         # names what executes (the winner-cache override regression
@@ -258,6 +336,17 @@ class DpOverlapSession:
         # the pump thread pays for wire encode + Pready bursts.
         self._fire_q: deque = deque()
         self._fire_lock = threading.Lock()
+        # Window state: closed steps whose broadcast tails are armed
+        # but not yet drained. _tails keeps step order (flush returns
+        # results in it); _tail_q feeds the pump thread's drain pass.
+        self._tails: list = []
+        self._tail_q: deque = deque()
+
+    @property
+    def _exec(self):
+        """The executor owning the CURRENT phase of the window (the
+        only executor, for window == 1)."""
+        return self._execs[self._phase]
 
     # -- step lifecycle ---------------------------------------------------
 
@@ -267,6 +356,20 @@ class DpOverlapSession:
         coverage."""
         if self._active:
             raise RequestError("begin_step() inside an open step")
+        if self._window >= 2:
+            # A phase's executor cannot re-arm (start() resets the
+            # deferred root-local buffers) until its previous tail
+            # consumed them — force-complete same-phase pending tails,
+            # and surface any tail error the pump thread stashed.
+            for rec in self._tails:
+                if rec.phase == self._phase and not rec.event.is_set():
+                    self._complete_tail(rec)
+            for rec in self._tails:
+                if rec.error is not None:
+                    err = rec.error
+                    self.abort_window()
+                    raise err
+        self._pas = self._exec.bindings
         self._exec.begin_step()
         self._covered = [
             np.zeros(pa.tiles, np.int64) for pa in self._pas
@@ -282,7 +385,10 @@ class DpOverlapSession:
         self._t0 = time.perf_counter()
         self._t_bwd_end = None
         self._report = None
-        if self._use_pump_thread:
+        # Window mode keeps ONE pump thread alive across the whole
+        # window (it drains step N's tail under step N+1's backward);
+        # single-step mode still cycles it per step.
+        if self._use_pump_thread and self._pump_thread is None:
             self._pump_stop = threading.Event()
             self._pump_thread = threading.Thread(
                 target=self._pump_loop, args=(self._pump_stop,),
@@ -298,10 +404,17 @@ class DpOverlapSession:
         or finish() signals stop."""
         def _quiet() -> bool:
             return (stop.is_set() or bool(self._fire_q)
+                    or bool(self._tail_q)
                     or all(pa.reduced for pa in self._pas))
 
         while not stop.is_set():
             self._drain_fire_q()
+            # Queued window tails dispatch HERE, after (outside) the
+            # fire queue's batch-dispatch window: the merged broadcast
+            # is a blocking collective, and a live shm fabric buffers
+            # posts until window exit — running it inside the coalescing
+            # window would deadlock it against its own dispatch.
+            self._drain_tails()
             if all(pa.reduced for pa in self._pas):
                 stop.wait(0.002)
                 continue
@@ -327,6 +440,127 @@ class DpOverlapSession:
                     pa.ready_range(run_lo, run_hi,
                                    self._stage[b][:, llo:lhi])
         return True
+
+    # -- window tails -----------------------------------------------------
+
+    def _drain_tails(self) -> bool:
+        """Pump-thread drain pass: dispatch every queued window tail
+        (deque.popleft is atomic; _run_tail's claim makes a concurrent
+        force-complete a no-op here)."""
+        ran = False
+        while self._tail_q:
+            try:
+                rec = self._tail_q.popleft()
+            except IndexError:
+                break
+            self._run_tail(rec)
+            ran = True
+        return ran
+
+    def _run_tail(self, rec: _TailNode) -> None:
+        """Claim-then-run one armed tail: the merged per-root broadcast
+        (plus resident-shard assembly) of a closed step. Runs UNLOCKED —
+        the broadcast is a blocking collective and must not serialize
+        mark_ready's fire queue behind it. Errors are stashed on the
+        record (re-raised at the next begin_step/flush), never thrown
+        off the pump thread."""
+        with self._fire_lock:
+            if rec.claimed:
+                return
+            rec.claimed = True
+        t0 = time.perf_counter()
+        try:
+            rec.result = rec.exec_.finish_tail()
+        except BaseException as e:  # commlint: allow(broadexcept)
+            # stash-and-signal: the pump thread has no caller to unwind
+            # into; begin_step()/flush() re-raise this
+            rec.error = e
+        tail_ms = (time.perf_counter() - t0) * 1e3
+        # The tail overlapped iff the NEXT step's backward was still
+        # producing while it ran (step open, bwd-end unmarked).
+        overlap_ms = (tail_ms if self._active and self._t_bwd_end is None
+                      else 0.0)
+        rec.report.tail_ms = tail_ms
+        rec.report.tail_overlap_ms = overlap_ms
+        SPC.record("sched_tail_overlap_ms", overlap_ms)
+        rec.event.set()
+
+    def _complete_tail(self, rec: _TailNode) -> None:
+        """Force one tail to completion: run it inline if unclaimed,
+        else wait out whoever claimed it (the pump thread, mid-bcast)."""
+        self._run_tail(rec)
+        rec.event.wait()
+
+    def step(self) -> None:
+        """Close the open step WITHOUT draining its broadcast tail —
+        the slipstream boundary. Reductions are waited to completion
+        (``wait_reduced``), the merged tail stays armed and is queued
+        for the pump thread to dispatch under the NEXT step's backward.
+        Results come back from :meth:`flush` in step order. Unready
+        tiles raise with the step still open (mark the rest and step()
+        again); a reduction failure collapses the whole window."""
+        if self._window < 2:
+            raise RequestError(
+                "step() needs a window session (window >= 2) — "
+                "single-step sessions use finish()")
+        if not self._active:
+            raise RequestError("step() before begin_step()")
+        self._check_all_fired("step")
+        self._t_bwd_end = time.perf_counter()
+        try:
+            self._drain_fire_q()
+            self._exec.wait_reduced()
+        except BaseException:  # commlint: allow(broadexcept)
+            # cleanup-then-reraise: a mid-window reduction failure
+            # (timeout, revoke, lifeboat kill) must not leak armed
+            # tails or the pump thread — collapse deterministically
+            self.abort_window()
+            raise
+        t_done = max(pa.t_reduce_done for pa in self._pas)
+        t_first = min(pa.t_first_ready for pa in self._pas)
+        report = OverlapReport(
+            backward_ms=(self._t_bwd_end - self._t0) * 1e3,
+            comm_ms=max(0.0, (t_done - t_first) * 1e3),
+            exposed_comm_ms=max(0.0, (t_done - self._t_bwd_end) * 1e3),
+            tiles=sum(pa.tiles for pa in self._pas),
+            buckets=len(self._pas),
+        )
+        rec = _TailNode(self._exec, self._phase, report)
+        self._tails.append(rec)
+        self._tail_q.append(rec)
+        SPC.record("sched_window_spans_total")
+        self._report = report
+        self._active = False
+        self._phase = (self._phase + 1) % self._window
+
+    def flush(self) -> list:
+        """Close the window: auto-close an open step, complete every
+        queued tail in step order, stop the pump thread, and return
+        ``[(grads, report), ...]`` — one entry per step() since the
+        last flush. The session resets to phase 0, ready for the next
+        window."""
+        if self._window < 2:
+            raise RequestError(
+                "flush() needs a window session (window >= 2)")
+        if self._active:
+            self.step()
+        try:
+            for rec in self._tails:
+                self._complete_tail(rec)
+                if rec.error is not None:
+                    raise rec.error
+        except BaseException:  # commlint: allow(broadexcept)
+            self.abort_window()
+            raise
+        self._stop_pump()
+        out = []
+        for rec in self._tails:
+            reduced = [np.asarray(r) for r in rec.result]
+            out.append((self._reassemble(reduced), rec.report))
+        self._tails = []
+        self._tail_q.clear()
+        self._phase = 0
+        return out
 
     def mark_ready(self, param, value, slice: Optional[tuple] = None
                    ) -> list:
@@ -455,20 +689,19 @@ class DpOverlapSession:
         Unready tiles raise WITHOUT tearing anything down — the step
         stays open, so the caller can mark the missing leaves and call
         finish() again (or :meth:`abort_step` to give up). A reduction
-        failure (e.g. a bucket's wait timeout) tears the step down."""
+        failure (e.g. a bucket's wait timeout) tears the step down.
+
+        On a window session this is close-plus-flush: the open step
+        closes, every pending tail drains, and the LAST step's
+        ``(grads, report)`` is returned (earlier steps' results are
+        discarded — call :meth:`step`/:meth:`flush` to keep them)."""
+        if self._window >= 2:
+            if not self._active and not self._tails:
+                raise RequestError("finish() before begin_step()")
+            return self.flush()[-1]
         if not self._active:
             raise RequestError("finish() before begin_step()")
-        unfired = [
-            (b, t) for b, fired in enumerate(self._fired)
-            for t in range(len(fired)) if not fired[t]
-        ]
-        if unfired:
-            raise RequestError(
-                f"finish() with unready tiles {unfired[:8]} — every "
-                "gradient leaf must be mark_ready()'d (the step stays "
-                "open: mark the rest and finish() again, or "
-                "abort_step())"
-            )
+        self._check_all_fired("finish")
         self._t_bwd_end = time.perf_counter()
         try:
             self._drain_fire_q()
@@ -492,18 +725,57 @@ class DpOverlapSession:
         )
         return self._reassemble(reduced), self._report
 
+    def _check_all_fired(self, verb: str) -> None:
+        unfired = [
+            (b, t) for b, fired in enumerate(self._fired)
+            for t in range(len(fired)) if not fired[t]
+        ]
+        if unfired:
+            raise RequestError(
+                f"{verb}() with unready tiles {unfired[:8]} — every "
+                "gradient leaf must be mark_ready()'d (the step stays "
+                f"open: mark the rest and {verb}() again, or "
+                "abort_step())"
+            )
+
     def abort_step(self) -> None:
         """Tear down an open step without completing it: stop the pump
         thread, abort every bucket's partitioned pair (dropping their
         progress callbacks), and close the step so the session is not
         left with a leaked callback or a live thread. In-flight wire
         state is abandoned (DESIGN.md §20); re-arming this session is
-        only safe once the fabric has drained. No-op between steps."""
+        only safe once the fabric has drained. No-op between steps.
+
+        On a window session the window is ONE unit of teardown —
+        delegates to :meth:`abort_window`."""
+        if self._window >= 2:
+            self.abort_window()
+            return
         if not self._active:
             return
         self._stop_pump()
         self._exec.abort()
         self._active = False
+
+    def abort_window(self) -> None:
+        """Deterministically collapse the whole window: stop the pump
+        thread FIRST (so no tail is mid-dispatch), abort every phase's
+        executor (armed tails included — their deferred locals are
+        abandoned with the rest of the in-flight wire state, DESIGN.md
+        §20/§22), drop all queued tails and reset to phase 0. Same-seed
+        controllers collapsing at the same step recompile the identical
+        window afterwards — this is the lifeboat path. No-op when the
+        window is idle."""
+        if (not self._active and not self._tails
+                and self._pump_thread is None):
+            return
+        self._stop_pump()
+        for ex in self._execs:
+            ex.abort()
+        self._tails = []
+        self._tail_q.clear()
+        self._active = False
+        self._phase = 0
 
     def _stop_pump(self) -> None:
         if self._pump_thread is not None:

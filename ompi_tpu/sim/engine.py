@@ -76,6 +76,13 @@ class Scenario:
     #: straggler reshaping path has schedules to retune
     pin_sched_keys: int = 2
     max_events: int = 2_000_000
+    #: slipstream co-simulation: A/B the two-step window against the
+    #: single-step barrier at fleet scale through the SAME alpha-beta
+    #: topology model the admission path prices collectives with.
+    #: ``{"buckets": 32, "bucket_kb": 1024, "backward_ms": 5.0}`` —
+    #: None (the default) keeps pre-slipstream scenario digests
+    #: byte-identical.
+    window_ab: Optional[dict] = None
 
     def to_dict(self) -> dict:
         return asdict(self)
@@ -532,6 +539,29 @@ class FleetSim:
             self._registered_probes.append(tier)
         ledger.LEDGER.quarantine(tier, cause="sim_fault")
 
+    # -- slipstream co-simulation ---------------------------------------
+
+    def _window_ab(self) -> Optional[dict]:
+        """Price the scenario's ``window_ab`` config through
+        :func:`ompi_tpu.coll.sched.slipstream.window_cost_model`: the
+        two-step slipstream window (tail overlapped under the next
+        backward, resident shards' allgathers elided) against the PR 16
+        barrier, using the SAME ``topology.collective_time_s`` the
+        admission path prices with. Pure function of the scenario —
+        the result (and its digest entry) is replay-stable."""
+        cfg = self.scenario.window_ab
+        if not cfg:
+            return None
+        from ..coll.sched import slipstream
+
+        buckets = int(cfg.get("buckets", 32))
+        nbytes = int(cfg.get("bucket_kb", 1024)) << 10
+        return slipstream.window_cost_model(
+            self.scenario.nranks, [nbytes] * buckets,
+            backward_s=float(cfg.get("backward_ms", 5.0)) / 1e3,
+            coll_time_s=self.topology.collective_time_s,
+            seed=self.scenario.seed)
+
     # -- report ---------------------------------------------------------
 
     def digests(self) -> dict[str, str]:
@@ -549,6 +579,11 @@ class FleetSim:
         p = inject.plan()
         if p is not None:
             out["faultline"] = p.digest()
+        ab = self._window_ab()
+        if ab is not None:
+            blob = json.dumps(ab, sort_keys=True,
+                              separators=(",", ":")).encode()
+            out["slipstream"] = hashlib.sha256(blob).hexdigest()[:16]
         return out
 
     def merged_digest(self) -> str:
@@ -595,6 +630,8 @@ class FleetSim:
             "quarantines": int(counters.get("health_quarantines", 0)),
             "restores": int(counters.get("health_restores", 0)),
             "per_class": self._per_class_meter(),
+            **({"slipstream": self._window_ab()}
+               if self.scenario.window_ab else {}),
             "digests": self.digests(),
             "digest": self.merged_digest(),
         }

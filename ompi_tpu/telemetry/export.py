@@ -149,6 +149,15 @@ GUARANTEED_COUNTERS = (
      "the static default when compiling a step program"),
     ("sched_program_compiles_total",
      "whole-step comm programs compiled"),
+    ("sched_window_spans_total",
+     "slipstream steps closed with their broadcast tail left armed "
+     "across the step boundary"),
+    ("sched_ag_elided_total",
+     "allgather nodes elided from compiled step programs by the "
+     "shard-residency model"),
+    ("sched_tail_overlap_ms",
+     "broadcast-tail milliseconds hidden under the next step's "
+     "backward by the slipstream window"),
 )
 
 

@@ -46,7 +46,7 @@ from typing import Optional
 
 #: Metric-name fragments that mark a higher-is-better series.
 _HIGHER = ("gbps", "busbw", "gb_s", "hit_rate", "speedup", "ratio_x",
-           "overlap_pct", "ticks_sampled", "_per_s")
+           "overlap_pct", "ticks_sampled", "_per_s", "ag_elided")
 #: Fragments that mark a lower-is-better series. ``overhead_pct``
 #: rides the _pct absolute-slack path in _is_regression.
 _LOWER = ("p50", "p99", "_us", "_ms", "rtt", "latency", "detect_ms",

@@ -209,6 +209,7 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
@@ -270,6 +271,7 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -348,6 +350,7 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -415,6 +418,7 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -493,6 +497,7 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -559,6 +564,7 @@ def test_daemon_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -681,6 +687,7 @@ def test_pallas_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -765,6 +772,7 @@ def test_overlap_rows_emit_schema_complete_on_probe_fail():
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -851,6 +859,7 @@ def test_step_program_rows_emit_schema_complete_on_probe_fail():
         bench._d2d_2proc = lambda: {"stub": True}
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -951,6 +960,7 @@ def test_fleet_sim_rows_emit_schema_complete_on_probe_fail():
         bench._cpu_mesh_dispatch = lambda: {"stub": True}
         bench._part_overlap_row = lambda: {"stub": True}
         bench._step_program_row = lambda: {"stub": True}
+        bench._step_pipeline_row = lambda: {"stub": True}
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
@@ -1022,4 +1032,98 @@ def test_fleet_sim_rows_emit_schema_complete_on_probe_fail():
     for key in ("recovery_p50_ms", "retune_convergence_ticks"):
         assert benchgate.direction(key) == "lower"
     for key in ("wall_s", "virtual_s"):
+        assert benchgate.direction(key) is None
+
+
+def test_step_pipeline_rows_emit_schema_complete_on_probe_fail():
+    """ISSUE PR18 satellite 6: the step-boundary pipeline rows — the
+    two-step slipstream window vs PR 16 barrier ratchet row
+    (step_pipeline_2step, with the residency elision count and the
+    tail-overlap fraction) and the window compile-cost row
+    (step_window_compile_ms) — run inside the probe-failed host-only
+    path and emit schema-complete JSON."""
+    prog = textwrap.dedent("""
+        import json, os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = ""
+        # shrink the drill: 16 buckets keeps runtime down while still
+        # crossing the 256KB/8-rank residency threshold (deadline ~11)
+        os.environ["OMPI_TPU_BENCH_STEPPIPE_BUCKETS"] = "16"
+        os.environ["OMPI_TPU_BENCH_STEPPIPE_TRIALS"] = "1"
+        import bench
+
+        bench._probe_device = lambda timeout_s=180.0: False
+        # stub every OTHER host row: this drill is about the new rows
+        bench._fabric_loopback = lambda: {"stub": True}
+        bench._shm_2proc = lambda: {"stub": True}
+        bench._fabric_2proc = lambda: {"stub": True}
+        bench._osc_epoch_2proc = lambda: {"stub": True}
+        bench._d2d_2proc = lambda: {"stub": True}
+        bench._cpu_mesh_dispatch = lambda: {"stub": True}
+        bench._part_overlap_row = lambda: {"stub": True}
+        bench._step_program_row = lambda: {"stub": True}
+        bench._quant_sweep_row = lambda: {"stub": True}
+        bench._bucket_fusion_row = lambda: {"stub": True}
+        bench._commlint_row = lambda: {"stub": True}
+        bench._degraded_allreduce_row = lambda: {"stub": True}
+        bench._fault_drill_row = lambda: {"stub": True}
+        bench._trace_overhead_row = lambda: {"stub": True}
+        bench._latency_hist_row = lambda: {"stub": True}
+        bench._tier_restore_row = lambda: {"stub": True}
+        bench._health_overhead_row = lambda: {"stub": True}
+        bench._telemetry_overhead_row = lambda: {"stub": True}
+        bench._watchtower_overhead_row = lambda: {"stub": True}
+        bench._straggler_detect_row = lambda: {"stub": True}
+        bench._sched_autotune_row = lambda: {"stub": True}
+        bench._sched_warm_start_row = lambda: {"stub": True}
+        bench._pallas_sched_row = lambda: {"stub": True}
+        bench._device_resurrection_row = lambda: {"stub": True}
+        bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._tenant_isolation_row = lambda: {"stub": True}
+        bench._admission_eviction_row = lambda: {"stub": True}
+        bench._fleet_sim_scale_row = lambda: {"stub": True}
+        bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench.main()
+    """)
+    r = _run(prog, timeout=420)
+    assert r.returncode == 2, (r.stdout[-2000:], r.stderr[-2000:])
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    rows = out["detail"]["partial"]
+
+    sp = rows["step_pipeline_2step"]
+    assert "error" not in sp, sp
+    assert sp["buckets"] == 16
+    assert sp["bytes"] == 2 * 16 * 256 * 1024
+    # the residency model elided at least one allgather, and the
+    # elision is visible in the window program's digest identity
+    assert sp["ag_elided_count"] >= 1
+    assert sp["elided_in_digest"] is True
+    assert sp["spc_ag_elided"] >= sp["ag_elided_count"]
+    assert len(sp["window_digest"]) == 16
+    int(sp["window_digest"], 16)
+    assert sp["nodes"] > 2 * sp["buckets"]   # two steps + tail
+    assert sp["barrier_s"] > 0 and sp["window_s"] > 0
+    # the shrunken drill still pipelines: the window strictly beats
+    # the barrier (the 1.15x ratchet itself rides the full-size run
+    # via the "pass" field + benchgate's ratio_x series)
+    assert sp["ratio_x"] > 1.0, sp
+    assert sp["ratchet_min"] == 1.15
+    assert 0.0 <= sp["tail_overlap_pct"] <= 100.0
+    assert sp["tail_total_s"] >= 0.0
+
+    cm = rows["step_window_compile_ms"]
+    assert "error" not in cm, cm
+    assert cm["buckets"] == sp["buckets"]
+    assert cm["nodes"] == sp["nodes"]
+    assert cm["compile_ms"] > 0 and cm["session_compile_ms"] > 0
+
+    # ratchet directions resolve from the key names: the window ratio
+    # and the elision count ratchet higher, compile cost lower;
+    # calibration-dependent *_s fields carry no direction
+    from ompi_tpu.tools import benchgate
+    for key in ("ratio_x", "ag_elided_count", "tail_overlap_pct"):
+        assert benchgate.direction(key) == "higher"
+    for key in ("compile_ms", "session_compile_ms"):
+        assert benchgate.direction(key) == "lower"
+    for key in ("barrier_s", "window_s", "tail_total_s"):
         assert benchgate.direction(key) is None

@@ -235,6 +235,48 @@ def test_smoke_1024_ranks():
     assert rep["digest"]
 
 
+def test_slipstream_window_ab_1024_ranks():
+    """Slipstream co-simulation (ISSUE PR18): a scenario carrying a
+    ``window_ab`` config prices the two-step window against the
+    single-step barrier at pod scale through the SAME alpha-beta
+    topology model admission uses — the report grows a 'slipstream'
+    section, the digest map a replay-stable 'slipstream' entry, and a
+    config-free scenario keeps its pre-slipstream digest byte-for-byte
+    (the hook is opt-in)."""
+    ab_cfg = {"buckets": 32, "bucket_kb": 1024, "backward_ms": 5.0}
+    sc = Scenario(
+        name="slip1024", seed=42, nranks=1024, duration_s=4.0,
+        tenants=8, base_rps=100.0, pump_interval_s=0.1,
+        window_ab=dict(ab_cfg))
+    rep = FleetSim(sc).run()
+    ab = rep["slipstream"]
+    assert ab["nranks"] == 1024 and ab["buckets"] == 32
+    # at 1MB buckets / 1024 ranks the residency model elides most
+    # allgathers, and the interleave beats the barrier
+    assert ab["ag_elided"] >= 1
+    assert ab["tail_window_s"] <= ab["tail_s"]
+    assert ab["window_s"] < ab["barrier_s"]
+    assert ab["speedup_x"] > 1.0
+    assert "slipstream" in rep["digests"]
+
+    # replay-stable: same scenario -> same slipstream digest; and the
+    # A/B section prices exactly what a second run prices
+    rep2 = FleetSim(Scenario(
+        name="slip1024", seed=42, nranks=1024, duration_s=4.0,
+        tenants=8, base_rps=100.0, pump_interval_s=0.1,
+        window_ab=dict(ab_cfg))).run()
+    assert rep2["slipstream"] == ab
+    assert rep2["digests"]["slipstream"] == rep["digests"]["slipstream"]
+
+    # opt-out: no window_ab -> no section, no digest entry (digest map
+    # byte-identical to pre-slipstream runs)
+    rep3 = FleetSim(Scenario(
+        name="slip1024", seed=42, nranks=1024, duration_s=4.0,
+        tenants=8, base_rps=100.0, pump_interval_s=0.1)).run()
+    assert "slipstream" not in rep3
+    assert "slipstream" not in rep3["digests"]
+
+
 @pytest.mark.slow
 def test_smoke_4096_ranks():
     sc = Scenario(

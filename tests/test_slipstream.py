@@ -1,0 +1,579 @@
+"""Slipstream (ISSUE PR18): pipelining compiled step programs across
+the step boundary — the two-step window IR (tail node, shard
+residency, boundary fusion), the window session's two-step
+bit-identity oracle, the residency winner-cache round-trip, the
+mid-window lifeboat drill, the stepbarrier lint rule, and the
+guaranteed telemetry series.
+"""
+
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import ompi_tpu
+from ompi_tpu.coll.sched import autotune, ir, pallas_lower, slipstream
+from ompi_tpu.coll.sched import cache as scache
+from ompi_tpu.core.counters import SPC
+from ompi_tpu.core.errors import ArgumentError, RequestError
+
+
+@pytest.fixture(scope="module")
+def base():
+    return ompi_tpu.init()
+
+
+def _pow2_grads(base, sizes, dtype="float32", seed=7):
+    """Rank-major leaves with values in {1, 2}: every arrival-order
+    combine is exact in f32 and bf16, so cross-arm comparisons can be
+    bitwise."""
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    return {
+        f"p{i}": jnp.asarray(
+            rng.integers(1, 3, (base.size, n)).astype(np.float32),
+            jnp.dtype(dtype))
+        for i, n in enumerate(sizes)
+    }
+
+
+# -- the IR: deadlines, residency, the window program -----------------------
+
+def test_zero_pair_deadline_enters_render_and_digest():
+    rs, ag = ir.zero_pair("b0", 8, ag_deadline=7)
+    assert ag.deadline == 7
+    prog = ir.Program("p", 8, (rs, ag))
+    assert "node b0.ag deps=b0.rs deadline=7" in prog.render()
+    # unset keeps the pre-slipstream render (and hence old digests)
+    rs2, ag2 = ir.zero_pair("b0", 8)
+    assert ag2.deadline == -1
+    legacy = ir.Program("p", 8, (rs2, ag2))
+    assert "deadline" not in legacy.render()
+    assert legacy.digest() != prog.digest()
+
+
+def test_residency_model_deadline_axis():
+    """The elide-the-allgather model: urgency decays with the deadline,
+    so a bucket consumed immediately keeps its AG while one consumed
+    layers later sheds it; at pod scale nearly everything sheds."""
+    nbytes = 256 << 10
+    assert not autotune.ag_elision_wins(nbytes, 8, 0, 0)
+    assert autotune.ag_elision_wins(nbytes, 8, 0, 31)
+    assert autotune.ag_elision_wins(1 << 20, 1024, 0, 2)
+    # the choice surface: pinned rs_ag deepens to rs_resident only on
+    # a model win; explicit pins are honored both ways
+    assert autotune.program_node_choice(
+        nbytes, 8, 0, ag_deadline=31, resident=True) == "rs_resident"
+    assert autotune.program_node_choice(
+        nbytes, 8, 0, ag_deadline=31, resident=False) != "rs_resident"
+    # nranks < 2: nothing to scatter, never resident
+    assert autotune.program_node_choice(
+        nbytes, 1, 0, ag_deadline=31, resident=True) != "rs_resident"
+
+
+def test_compile_window_digest_deterministic_and_elision_in_digest():
+    """Tentpole acceptance: 32-bucket window at 8 ranks with the ZeRO
+    pair pinned — the residency model elides far-deadline allgathers,
+    the elision is visible in the program digest, and same-seed
+    compiles are byte-identical."""
+    buckets = [(65536, np.float32)] * 32       # 256 KB each
+    pins = ["rs_ag"] * 32
+    a = slipstream.compile_window(8, buckets, seed=5, topo_fp="t",
+                                  node_choices=pins)
+    b = slipstream.compile_window(8, buckets, seed=5, topo_fp="t",
+                                  node_choices=pins)
+    assert a.digest() == b.digest()
+    assert a.program.render() == b.program.render()
+    assert len(a.elided) >= 1
+    # elided buckets compile to a lone rs node — the allgather is gone
+    names = {nd.name for nd in a.program.nodes}
+    for i in a.elided:
+        assert f"s0.b{i}.rs" in names and f"s0.b{i}.ag" not in names
+    # near-deadline buckets keep their pair
+    kept = [i for i in range(32) if i not in a.elided]
+    assert kept, "some bucket must keep its allgather at this scale"
+    for i in kept:
+        assert f"s0.b{i}.ag" in names
+    # the elision record and deadlines feed the digest
+    assert a.program.meta["elided"] != "-"
+    assert "deadlines" in a.program.meta
+    c = slipstream.compile_window(8, buckets, seed=6, topo_fp="t",
+                                  node_choices=pins)
+    assert c.digest() != a.digest()
+    with pytest.raises(ArgumentError):
+        slipstream.compile_window(8, [])
+    with pytest.raises(ArgumentError):
+        slipstream.compile_window(8, buckets, ag_deadlines=[0, 1])
+
+
+def test_compile_window_tail_node_and_overlap_edge():
+    """The window program's shape IS the overlap contract: s0's tail
+    depends on every non-resident terminal, and s1's nodes carry NO
+    dep on the tail — that missing edge is what the executor
+    exploits."""
+    buckets = [(256, np.float32)] * 3
+    w = slipstream.compile_window(
+        8, buckets, seed=0,
+        node_choices=["allreduce", "rs_ag", "rs_resident"])
+    assert w.elided == (2,)
+    tail = w.program.node("s0.tail")
+    assert set(tail.deps) == {"s0.b0", "s0.b1.ag"}
+    assert tail.schedule.op == "allgather"
+    for nd in w.program.nodes:
+        if nd.name.startswith("s1."):
+            assert "s0.tail" not in nd.deps
+    assert w.program.meta["window"] == 2
+    assert w.program.meta["elided"] == "b2"
+    # all-resident window has no tail traffic at all
+    nt = slipstream.compile_window(8, buckets, seed=0,
+                                   node_choices=["rs_resident"] * 3)
+    assert all(nd.name != "s0.tail" for nd in nt.program.nodes)
+
+
+def test_fuse_window_boundary_matches_memberwise_oracle():
+    """Boundary fusion oracle: one op="window" table program covering
+    the tail's allgathers plus the next step's reduce-scatter must be
+    bit-exact against simulating each member on its own."""
+    import jax.numpy as jnp
+
+    n = 4
+    ags = [ir.allgather(n), ir.allgather(n)]
+    rs = ir.zero_pair("x", n)[0].schedule
+    win = pallas_lower.fuse_window("bnd", ags, [rs])
+    assert win.op == "window" and win.meta["boundary"] == 2
+    assert win.nchunks == sum(s.nchunks for s in ags + [rs])
+    rng = np.random.default_rng(3)
+    data = jnp.asarray(rng.integers(1, 3, (n, win.nchunks, 2)),
+                       jnp.float32)
+    got = np.asarray(pallas_lower.simulate(win, data, "sum"))
+    off = 0
+    for s in ags + [rs]:
+        seg = jnp.asarray(np.asarray(data)[:, off:off + s.nchunks])
+        ref = np.asarray(pallas_lower.simulate(s, seg, "sum"))
+        if s.op == "reduce_scatter":
+            # only each rank's OWNED chunk is defined by RS contract;
+            # simulate() returns it as (nranks, chunk), and its place
+            # inside the fused table is the segment-final rchunk
+            sp = pallas_lower.analyze(s)
+            for k in range(n):
+                own = int(sp.t_rchunk[sp.rounds - 1, k])
+                np.testing.assert_array_equal(got[k][off + own], ref[k])
+        else:
+            np.testing.assert_array_equal(got[:, off:off + s.nchunks],
+                                          ref)
+        off += s.nchunks
+    # contract violations are ArgumentError (keep per-node kernels)
+    with pytest.raises(ArgumentError):
+        pallas_lower.fuse_window("bad", [], [rs])
+    with pytest.raises(ArgumentError):
+        pallas_lower.fuse_window("bad", [rs], [rs])  # tail must be AG
+    with pytest.raises(ArgumentError):
+        pallas_lower.fuse_window("bad", ags, [ir.allgather(n)])
+
+
+def test_window_cost_model_pod_scale_ab():
+    """The armada-shared A/B: at 1024 ranks the window elides most
+    allgathers and beats the barrier; with a zero-cost tail both arms
+    converge."""
+    ab = slipstream.window_cost_model(
+        1024, [1 << 20] * 32, backward_s=5e-3,
+        coll_time_s=lambda algo, nbytes: 1e-5 + nbytes * 1e-9, seed=0)
+    assert ab["ag_elided"] >= 16
+    assert ab["tail_window_s"] < ab["tail_s"]
+    assert ab["window_s"] < ab["barrier_s"]
+    assert ab["speedup_x"] > 1.0
+    # determinism (the sim digest rides on this)
+    ab2 = slipstream.window_cost_model(
+        1024, [1 << 20] * 32, backward_s=5e-3,
+        coll_time_s=lambda algo, nbytes: 1e-5 + nbytes * 1e-9, seed=0)
+    assert ab == ab2
+
+
+# -- the window session: two-step bit identity ------------------------------
+
+@pytest.mark.parametrize("dtype", ["float32", "bfloat16"])
+def test_window_two_steps_bit_identical_vs_sequential(base, dtype):
+    """Tentpole acceptance: a two-step window (tail overlapped, one
+    bucket's allgather elided — its result read from the resident
+    owner shards) is bit-identical to two sequential barriered steps,
+    f32 and bf16."""
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads_a = _pow2_grads(base, [300, 200, 128], dtype=dtype)
+    grads_b = {k: v * 2 for k, v in grads_a.items()}   # {2,4}: exact
+    kw = dict(bucket_bytes=1024, tile_bytes=256)
+    ref_sess = DpOverlapSession(base, grads_a, step_program=False,
+                                tag_base=5700, **kw)
+    nb = len(ref_sess.plan.buckets)
+    assert nb >= 2
+    refs = []
+    for g in (grads_a, grads_b):
+        ref_sess.begin_step()
+        for nm in g:
+            ref_sess.mark_ready(nm, g[nm])
+        out, _ = ref_sess.finish()
+        refs.append(out)
+
+    choices = ["rs_resident" if i == 0 else
+               ("rs_ag" if i % 2 else "allreduce") for i in range(nb)]
+    sess = DpOverlapSession(base, grads_a, window=2, tag_base=5800,
+                            node_choices=choices, **kw)
+    assert sess.compiled_window.elided == (0,)
+    for g in (grads_a, grads_b):
+        sess.begin_step()
+        for nm in g:
+            sess.mark_ready(nm, g[nm])
+        sess.step()
+    results = sess.flush()
+    assert len(results) == 2
+    for (out, report), ref in zip(results, refs):
+        assert report.buckets == nb
+        assert report.tail_ms >= 0.0
+        for nm in ref:
+            a, b = np.asarray(ref[nm]), np.asarray(out[nm])
+            assert a.dtype == b.dtype
+            assert (a == b).all(), f"{dtype} leaf {nm} diverged"
+
+
+def test_window_finish_and_phase_reuse(base):
+    """finish() on a window session is close-plus-flush (last step's
+    result); an odd step count wraps phases, forcing the same-phase
+    tail force-complete in begin_step."""
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _pow2_grads(base, [256, 192], seed=3)
+    expect = {nm: np.broadcast_to(np.asarray(g).sum(axis=0),
+                                  np.asarray(g).shape)
+              for nm, g in grads.items()}
+    sess = DpOverlapSession(base, grads, bucket_bytes=1024,
+                            tag_base=5900, window=2)
+    spans0 = SPC.snapshot().get("sched_window_spans_total", 0)
+    for _ in range(3):                   # 3 steps through 2 phases
+        sess.begin_step()
+        for nm in grads:
+            sess.mark_ready(nm, grads[nm])
+        sess.step()
+    out = sess.flush()
+    assert len(out) == 3
+    for got, _rep in out:
+        for nm in expect:
+            assert (np.asarray(got[nm]) == expect[nm]).all(), nm
+    assert SPC.snapshot()["sched_window_spans_total"] == spans0 + 3
+    # finish() = close + flush, returning the LAST step's pair
+    sess.begin_step()
+    for nm in grads:
+        sess.mark_ready(nm, grads[nm])
+    got, report = sess.finish()
+    for nm in expect:
+        assert (np.asarray(got[nm]) == expect[nm]).all(), nm
+    assert report.tail_ms >= 0.0
+    assert not sess._active and sess._pump_thread is None
+
+
+def test_window_session_validations(base):
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    grads = _pow2_grads(base, [128], seed=5)
+    with pytest.raises(ArgumentError):
+        DpOverlapSession(base, grads, window=0)
+    with pytest.raises(ArgumentError):
+        DpOverlapSession(base, grads, window=2, step_program=False)
+    plain = DpOverlapSession(base, grads, bucket_bytes=1024,
+                             tag_base=6000)
+    with pytest.raises(RequestError):
+        plain.step()                     # window=1 has no step()
+    with pytest.raises(RequestError):
+        plain.flush()
+    win = DpOverlapSession(base, grads, bucket_bytes=1024,
+                           tag_base=6050, window=2)
+    with pytest.raises(RequestError):
+        win.step()                       # before begin_step
+    # unready tiles leave the step open: mark the rest, step() again
+    win.begin_step()
+    with pytest.raises(RequestError, match="unready tiles"):
+        win.step()
+    win.mark_ready("p0", grads["p0"])
+    win.step()
+    (got, _), = win.flush()
+    ref = np.broadcast_to(np.asarray(grads["p0"]).sum(axis=0),
+                          np.asarray(grads["p0"]).shape)
+    assert (np.asarray(got["p0"]) == ref).all()
+
+
+# -- satellite: residency round-trips the winner cache ----------------------
+
+def test_cache_roundtrip_residency_and_deadline():
+    """Bugfix regression: bump() carries ag_deadline/resident forward
+    like tile_bytes, rollback() preserves all three, and both fields
+    feed the canonical digest."""
+    c = scache.ScheduleCache()
+    c.put("k", "ring", tile_bytes=4096, ag_deadline=9, resident=True)
+    d_full = c.digest()
+    # a retune bump without residency kwargs must not drop them
+    c.bump("k", "sched_hier")
+    ent = c.entries()["k"]
+    assert ent["version"] == 2 and ent["algorithm"] == "sched_hier"
+    assert ent["tile_bytes"] == 4096
+    assert ent["ag_deadline"] == 9 and ent["resident"] is True
+    # rollback restores the old winner WITHOUT erasing the plan
+    assert c.rollback("k")
+    ent = c.entries()["k"]
+    assert ent["algorithm"] == "ring" and ent["version"] == 3
+    assert ent["tile_bytes"] == 4096
+    assert ent["ag_deadline"] == 9 and ent["resident"] is True
+    # residency is semantic: with vs without differs in the digest
+    bare = scache.ScheduleCache()
+    bare.put("k", "ring", tile_bytes=4096)
+    assert bare.digest() != d_full
+    # rollback with no previous is a no-op
+    assert not c.rollback("nosuch")
+
+
+def test_tune_residency_persists_plan_and_compile_consumes_it():
+    """tune_residency writes per-key deadlines + verdicts; a later
+    compile with NO caller deadlines recovers the same residency plan
+    from the cache (the same-seed controller contract)."""
+    from ompi_tpu.coll.sched.stepprogram import compile_step
+
+    scache.CACHE.clear()
+    try:
+        # 32 MB buckets at 8 ranks: rs_ag model-wins AND the shard
+        # stays resident past deadline 31 — a genuinely positive
+        # verdict for the cache to carry
+        sizes = [32 << 20, 32 << 20]
+        out = autotune.tune_residency(
+            8, sizes, [0, 31], seed=5, topo_fp="tr")
+        assert len(out["keys"]) >= 1 and out["digest"]
+        ent = scache.CACHE.get(out["keys"][0])
+        assert ent["ag_deadline"] == 31 and ent["resident"] is True
+        # both sizes share one cache key; the later (resident) verdict
+        # stands — and compile_step picks it up with no deadlines
+        comp = compile_step(8, [(8 << 20, np.float32)] * 2, seed=5,
+                            topo_fp="tr", node_choices=["rs_ag"] * 2)
+        assert [n.choice for n in comp.nodes] == ["rs_resident"] * 2
+    finally:
+        scache.CACHE.clear()
+
+
+# -- satellite: the mid-window lifeboat drill -------------------------------
+
+@pytest.fixture
+def _drill_clean():
+    from ompi_tpu.ft import elastic, events, inject, lifeboat
+    from ompi_tpu.health import ledger
+    from ompi_tpu.telemetry import fleet
+
+    yield
+    inject.disarm()
+    lifeboat.reset()
+    elastic.reset()
+    events.clear()
+    fleet.reset_for_testing()
+    ledger.reset()
+    w = ompi_tpu.world()
+    w._revoked = False
+    w.epoch = 0
+
+
+def test_rank_kill_mid_window_collapses_and_recovers(base, _drill_clean):
+    """rank_kill on the armed tail's broadcast: the window collapses
+    deterministically (no leaked tails, executors, or pump thread),
+    lifeboat shrinks the comm, and a window session rebuilt on the
+    survivors runs a full two-step window bit-exactly."""
+    from ompi_tpu.core.errors import RevokedError
+    from ompi_tpu.ft import elastic, inject, lifeboat
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    lifeboat.enable()
+    inject.arm("rank_kill@coll:op=bcast,peer=3")
+    c = base.dup()  # armed before dup: the coll vtable carries probes
+    grads = _pow2_grads(base, [256, 192], seed=3)
+    sess = DpOverlapSession(c, grads, bucket_bytes=1024, tag_base=6100,
+                            window=2, progress_thread=False)
+    old_digest = sess.compiled_window.digest()
+    sess.begin_step()
+    for nm in grads:
+        sess.mark_ready(nm, grads[nm])
+    sess.step()            # reductions complete; tail armed, queued
+    with pytest.raises((RevokedError, inject.FaultInjected)):
+        sess.flush()       # the tail's merged bcast hits the kill
+    assert not sess._active and sess._pump_thread is None
+    assert sess._tails == [] and not sess._tail_q
+    assert sess._phase == 0
+    inject.disarm()
+    assert elastic.failed_ranks() == {3}
+
+    new = lifeboat.recover(c, seed=11)
+    ompi_tpu.world()._revoked = False
+    assert new.size == c.size - 1 and new.epoch == c.epoch + 1
+    survivors = [r for r in range(c.size) if r != 3]
+    g2 = {nm: np.asarray(grads[nm])[survivors] for nm in grads}
+    sess2 = DpOverlapSession(new, g2, bucket_bytes=1024, tag_base=6100,
+                             window=2)
+    assert sess2.compiled_window.program.nranks == new.size
+    assert sess2.compiled_window.digest() != old_digest
+    for _ in range(2):
+        sess2.begin_step()
+        for nm in g2:
+            sess2.mark_ready(nm, g2[nm])
+        sess2.step()
+    for out, _rep in sess2.flush():
+        for nm in g2:
+            ref = np.broadcast_to(g2[nm].sum(axis=0), g2[nm].shape)
+            assert (np.asarray(out[nm]) == ref).all(), nm
+
+
+_WINDOW_DRILL = textwrap.dedent("""
+    import os
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+    import ompi_tpu as mt
+    from ompi_tpu.core.errors import RevokedError
+    from ompi_tpu.ft import inject, lifeboat
+    from ompi_tpu.parallel.overlap import DpOverlapSession
+
+    world = mt.init()
+    lifeboat.enable()
+    inject.arm("rank_kill@coll:op=bcast,peer=3")
+    comm = world.dup()
+    rng = np.random.default_rng(3)
+    grads = {f"p{i}": rng.integers(1, 3, (8, n)).astype(np.float32)
+             for i, n in enumerate((256, 192))}
+    sess = DpOverlapSession(comm, grads, bucket_bytes=1024,
+                            tag_base=6100, seed=5, window=2,
+                            progress_thread=False)
+    d0 = sess.compiled_window.digest()
+    sess.begin_step()
+    for nm in grads:
+        sess.mark_ready(nm, grads[nm])
+    sess.step()
+    try:
+        sess.flush()
+    except (RevokedError, inject.FaultInjected):
+        pass
+    assert sess._tails == [] and sess._phase == 0
+    inject.disarm()
+    new = lifeboat.recover(comm, seed=5)
+    g2 = {nm: g[[r for r in range(8) if r != 3]]
+          for nm, g in grads.items()}
+    sess2 = DpOverlapSession(new, g2, bucket_bytes=1024,
+                             tag_base=6100, seed=5, window=2)
+    for _ in range(2):
+        sess2.begin_step()
+        for nm in g2:
+            sess2.mark_ready(nm, g2[nm])
+        sess2.step()
+    for out, _rep in sess2.flush():
+        for nm in g2:
+            ref = np.broadcast_to(g2[nm].sum(axis=0), g2[nm].shape)
+            assert (np.asarray(out[nm]) == ref).all(), nm
+    print("DIGESTS " + d0 + ":" + sess2.compiled_window.digest() + ":"
+          + lifeboat.digest())
+""")
+
+
+@pytest.mark.slow
+def test_window_digests_byte_identical_across_controllers():
+    """Two same-seed controllers running the mid-window kill drill
+    agree byte-for-byte: the pre-kill window digest, the recompiled
+    window digest, and the recovery decision log."""
+    outs = []
+    for _ in range(2):
+        p = subprocess.run(
+            [sys.executable, "-c", _WINDOW_DRILL],
+            capture_output=True, text=True, timeout=300,
+        )
+        assert p.returncode == 0, p.stderr[-1500:]
+        line = [l for l in p.stdout.splitlines()
+                if l.startswith("DIGESTS ")][0]
+        outs.append(line.split(" ", 1)[1])
+    assert outs[0] == outs[1]
+    pre, post, _boat = outs[0].split(":")
+    assert pre != post and len(pre) == len(post) == 16
+
+
+# -- satellite: the stepbarrier lint rule -----------------------------------
+
+def test_stepbarrier_rule_fires_evidence_and_allow(tmp_path):
+    from ompi_tpu.analysis import lint
+
+    par = tmp_path / "parallel"
+    par.mkdir()
+    (par / "bad.py").write_text(textwrap.dedent("""
+        def train(sess, steps):
+            for g in steps:
+                sess.begin_step()
+                sess.mark_ready("p0", g)
+                sess.finish()
+    """))
+    (par / "bad_straight.py").write_text(textwrap.dedent("""
+        def two(sess, a, b):
+            sess.begin_step()
+            sess.mark_ready("p0", a)
+            sess.wait_all()
+            sess.begin_step()
+            sess.mark_ready("p0", b)
+    """))
+    (par / "good.py").write_text(textwrap.dedent("""
+        def train(sess, steps):
+            for g in steps:
+                sess.begin_step()
+                sess.mark_ready("p0", g)
+                sess.step()
+            return sess.flush()
+    """))
+    (par / "allowed.py").write_text(textwrap.dedent("""
+        def bench_barrier_arm(sess, steps):
+            for g in steps:  # commlint: allow(stepbarrier)
+                sess.begin_step()
+                sess.mark_ready("p0", g)
+                sess.finish()
+    """))
+    other = tmp_path / "tools"
+    other.mkdir()
+    (other / "outside.py").write_text(textwrap.dedent("""
+        def train(sess, steps):
+            for g in steps:
+                sess.begin_step()
+                sess.finish()
+    """))
+    rep = lint.lint_tree(str(tmp_path), select="stepbarrier")
+    paths = [f.path for f in rep.findings]
+    assert any("bad.py" in p for p in paths)
+    assert any("bad_straight.py" in p for p in paths)
+    assert not any("good.py" in p for p in paths)
+    assert not any("allowed.py" in p for p in paths)
+    assert not any("outside.py" in p for p in paths)
+
+
+def test_stepbarrier_repo_parallel_clean():
+    """The shipped parallel/ tree carries zero stepbarrier findings —
+    the window surface itself is the evidence."""
+    import os
+
+    from ompi_tpu.analysis import lint
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    rep = lint.lint_tree(os.path.join(repo, "ompi_tpu"),
+                         select="stepbarrier")
+    assert [f for f in rep.findings if f.rule == "stepbarrier"] == []
+
+
+# -- satellite: guaranteed telemetry series ---------------------------------
+
+def test_slipstream_counters_guaranteed_in_exposition():
+    from ompi_tpu.telemetry import export
+
+    txt = export.prometheus_text()
+    for name in ("sched_window_spans_total", "sched_ag_elided_total",
+                 "sched_tail_overlap_ms"):
+        assert any(
+            line.split(" ")[0].endswith(name)
+            for line in txt.splitlines() if not line.startswith("#")
+        ), name
