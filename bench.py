@@ -3331,6 +3331,8 @@ def _host_rows() -> dict:
     rows["dp_bucket_fusion"] = _bucket_fusion_row()
     _set_phase("commlint self-analysis")
     rows["commlint"] = _commlint_row()
+    _set_phase("locksmith whole-program lock analysis")
+    rows["locksmith"] = _locksmith_row()
     _set_phase("degraded allreduce (one dcn link down)")
     rows["degraded_allreduce"] = _degraded_allreduce_row()
     _set_phase("fault drill (inject -> detect -> respawn -> resume)")
@@ -3386,6 +3388,33 @@ def _commlint_row() -> dict:
             "findings": len(rep),
             "errors": len(linter.errors),
             "runtime_ms": round(linter.elapsed_ms, 1),
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
+def _locksmith_row() -> dict:
+    """Whole-program concurrency model over the package: lock/thread
+    inventory sizes, order-graph shape, and the two analysis phases'
+    wall time. Pure host work — no mesh, no subprocess."""
+    try:
+        from ompi_tpu.analysis.index import ProjectIndex
+
+        here = os.path.dirname(os.path.abspath(__file__))
+        pkg = os.path.join(here, "ompi_tpu")
+        t0 = time.perf_counter()
+        index = ProjectIndex.build(pkg)
+        t1 = time.perf_counter()
+        an = index.locksmith()
+        t2 = time.perf_counter()
+        return {
+            "locks": len(index.locks),
+            "thread_spawns": len(index.threads),
+            "order_edges": len(an.edges),
+            "cycles": len(an.cycles),
+            "findings": len(an.findings),
+            "index_build_ms": round((t1 - t0) * 1e3, 1),
+            "analyze_ms": round((t2 - t1) * 1e3, 1),
         }
     except Exception as exc:
         return {"error": f"{type(exc).__name__}: {exc}"}

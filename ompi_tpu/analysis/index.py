@@ -1,0 +1,685 @@
+"""ProjectIndex — the whole-program symbol layer under commlint.
+
+Per-file rules only ever needed a parsed AST; the concurrency rules
+(analysis/locksmith.py) need *resolution*: which function does
+``self._pump`` name, which class owns the ``self._mu`` being held,
+which ``threading.Thread(target=...)`` ends up running a given method.
+This module parses every source exactly once into a ``FileContext``
+(shared with the linter — rules see the same cached tree) and builds:
+
+- a **module table** (dotted module name -> file) honoring the package
+  layout and relative imports;
+- a **symbol table**: every class (with bases, methods, and best-effort
+  ``self.x = ClassName(...)`` attribute types) and every function,
+  keyed ``module.Class.method`` / ``module.func``;
+- a **call graph** resolver: ``self.m()``, ``mod.f()``, bare ``f()``,
+  ``self.attr.m()`` (through the inferred attribute type), and
+  imported names;
+- a **lock inventory**: every ``threading.Lock/RLock/Condition`` bound
+  to a module global or a ``self.`` attribute, with its creation site.
+  A ``Condition(self._mu)`` wrapping an inventoried lock aliases to the
+  underlying lock's key — acquiring the condition IS acquiring the
+  lock;
+- a **thread inventory**: every ``threading.Thread(target=...)`` spawn
+  site with the resolved target function.
+
+Everything is best-effort static resolution: an unresolvable name
+simply contributes nothing (the analyses built on top are linters, not
+verifiers). The index is deliberately cheap — one AST walk per file —
+so ``Linter.lint_paths`` can build it on every run and hand the cached
+``FileContext``s to all rules (the parse-once engine).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+_ALLOW_RE = re.compile(r"#\s*commlint:\s*allow\(\s*([\w\-, ]+?)\s*\)")
+
+#: threading factory callables that mint a lock-like object.
+_LOCK_FACTORIES = frozenset({"Lock", "RLock", "Condition", "Semaphore",
+                             "BoundedSemaphore"})
+
+
+class FileContext:
+    """One parsed source file, shared by every rule.
+
+    Attributes
+    ----------
+    path:     the path as given to the linter (for error messages)
+    relpath:  path relative to the lint root, '/'-normalised — this is
+              what appears in findings and baseline keys, so baselines
+              are stable across checkouts.
+    tree:     the parsed ``ast`` module
+    lines:    source split into lines (1-indexed via ``lines[i-1]``)
+    index:    the owning ProjectIndex (None for bare snippets)
+
+    The context also memoizes the traversals every rule used to redo
+    from scratch — ``walk()``, ``parents()`` — so a 20-rule run pays
+    for each exactly once per file.
+    """
+
+    def __init__(self, path: str, source: str, relpath: str | None = None):
+        self.path = path
+        self.relpath = (relpath or path).replace(os.sep, "/")
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.index: Optional["ProjectIndex"] = None
+        self._walk: Optional[list[ast.AST]] = None
+        self._parents: Optional[dict[ast.AST, ast.AST]] = None
+        self._allow: dict[int, frozenset[str]] = {}
+        for i, line in enumerate(self.lines, start=1):
+            m = _ALLOW_RE.search(line)
+            if m:
+                names = frozenset(
+                    p.strip() for p in m.group(1).split(",") if p.strip()
+                )
+                self._allow[i] = names
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """True if ``# commlint: allow(rule)`` covers ``line``
+        (same line or the line immediately above)."""
+        for ln in (line, line - 1):
+            names = self._allow.get(ln)
+            if names and (rule in names or "all" in names):
+                return True
+        return False
+
+    # -- cached traversals (the parse-once engine) ---------------------
+
+    def walk(self) -> list[ast.AST]:
+        """``ast.walk(self.tree)`` computed once and reused by every
+        rule (the single hottest redundancy in the old per-rule walks)."""
+        if self._walk is None:
+            self._walk = list(ast.walk(self.tree))
+        return self._walk
+
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        """child -> parent over the whole tree, computed once."""
+        if self._parents is None:
+            p: dict[ast.AST, ast.AST] = {}
+            for node in self.walk():
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+            self._parents = p
+        return self._parents
+
+
+# -- symbol table records ---------------------------------------------------
+
+
+@dataclass
+class FuncInfo:
+    """One function or method."""
+
+    key: str                      # "module.Class.method" / "module.func"
+    module: str
+    relpath: str
+    node: ast.AST                 # FunctionDef / AsyncFunctionDef
+    cls: Optional["ClassInfo"] = None
+    summary: object = None        # locksmith attaches its Summary here
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def line(self) -> int:
+        return self.node.lineno
+
+    @property
+    def params(self) -> list[str]:
+        a = self.node.args
+        names = [p.arg for p in a.posonlyargs + a.args + a.kwonlyargs]
+        if a.vararg:
+            names.append(a.vararg.arg)
+        if a.kwarg:
+            names.append(a.kwarg.arg)
+        return names
+
+
+@dataclass
+class ClassInfo:
+    """One class: methods, base names, inferred attribute types, locks."""
+
+    key: str                      # "module.Class"
+    module: str
+    relpath: str
+    node: ast.ClassDef
+    bases: list[str] = field(default_factory=list)   # unresolved names
+    methods: dict[str, FuncInfo] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)  # x -> class key
+    lock_attrs: dict[str, "LockInfo"] = field(default_factory=dict)
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+
+@dataclass
+class LockInfo:
+    """One inventoried lock (or lock-aliasing Condition)."""
+
+    key: str                      # "module.Class._mu" / "module._mu"
+    kind: str                     # Lock / RLock / Condition / ...
+    relpath: str
+    line: int
+    owner: Optional[str] = None   # owning class key, None for module-level
+    alias_of: Optional[str] = None  # Condition(self._mu) -> underlying key
+
+    def resolved_key(self) -> str:
+        return self.alias_of or self.key
+
+
+@dataclass
+class ThreadSpawn:
+    """One ``threading.Thread(target=...)`` site."""
+
+    relpath: str
+    line: int
+    target: Optional[str]         # resolved FuncInfo key, or None
+    target_text: str              # source text of the target expression
+    in_func: Optional[str]        # key of the spawning function
+
+
+class ProjectIndex:
+    """Symbol table + call graph + lock/thread inventory for a file set."""
+
+    def __init__(self, base: Optional[str] = None) -> None:
+        self.base = base
+        self.files: dict[str, FileContext] = {}       # relpath -> ctx
+        self.modules: dict[str, str] = {}             # module -> relpath
+        self.classes: dict[str, ClassInfo] = {}       # key -> info
+        self.functions: dict[str, FuncInfo] = {}      # key -> info
+        self.locks: dict[str, LockInfo] = {}          # key -> info
+        self.threads: list[ThreadSpawn] = []
+        self.errors: list[str] = []
+        # per-module import map: alias -> dotted target ("threading",
+        # "ompi_tpu.core.config", "ompi_tpu.analysis.report.Finding")
+        self.imports: dict[str, dict[str, str]] = {}
+        self._package = False
+        self._locksmith = None    # cached locksmith.Analysis
+
+    # -- construction --------------------------------------------------
+
+    @classmethod
+    def build(cls, root: str,
+              paths: Optional[Iterable[str]] = None) -> "ProjectIndex":
+        """Index every .py under ``root`` (or just ``paths``)."""
+        idx = cls(base=os.path.abspath(root))
+        if paths is None:
+            paths = sorted(
+                os.path.join(dp, f)
+                for dp, dns, fns in os.walk(root)
+                for f in fns if f.endswith(".py")
+                if "__pycache__" not in dp
+            )
+        for path in paths:
+            idx.add_file(path)
+        idx.link()
+        return idx
+
+    @classmethod
+    def from_contexts(cls, contexts: Iterable[FileContext],
+                      base: Optional[str] = None) -> "ProjectIndex":
+        idx = cls(base=base)
+        for ctx in contexts:
+            idx.add_context(ctx)
+        idx.link()
+        return idx
+
+    @classmethod
+    def from_sources(cls, sources: dict[str, str],
+                     base: Optional[str] = None) -> "ProjectIndex":
+        """Test/tool entry: {relpath: source} parsed in-memory."""
+        idx = cls(base=base)
+        for relpath, src in sorted(sources.items()):
+            try:
+                idx.add_context(FileContext(relpath, src, relpath=relpath))
+            except SyntaxError as exc:
+                idx.errors.append(f"{relpath}: syntax error: {exc}")
+        idx.link()
+        return idx
+
+    def add_file(self, path: str) -> Optional[FileContext]:
+        try:
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as exc:
+            self.errors.append(f"{path}: {exc}")
+            return None
+        relpath = self._relpath(path)
+        try:
+            ctx = FileContext(path, source, relpath=relpath)
+        except SyntaxError as exc:
+            self.errors.append(f"{path}: syntax error: {exc}")
+            return None
+        return self.add_context(ctx)
+
+    def _relpath(self, path: str) -> str:
+        ap = os.path.abspath(path)
+        base = self.base
+        if base and (ap == base or ap.startswith(base + os.sep)):
+            return os.path.relpath(ap, base).replace(os.sep, "/")
+        return path.replace(os.sep, "/")
+
+    def add_context(self, ctx: FileContext) -> FileContext:
+        ctx.index = self
+        self.files[ctx.relpath] = ctx
+        module = self.module_name(ctx.relpath)
+        self.modules[module] = ctx.relpath
+        self._index_module(module, ctx)
+        return ctx
+
+    def module_name(self, relpath: str) -> str:
+        """Dotted module for a relpath. When the index base is itself a
+        package directory (has __init__.py), names are rooted at the
+        package so absolute imports resolve."""
+        parts = relpath[:-3].split("/") if relpath.endswith(".py") \
+            else relpath.split("/")
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        if self.base and os.path.exists(
+                os.path.join(self.base, "__init__.py")):
+            self._package = True
+            parts = [os.path.basename(self.base)] + parts
+        return ".".join(p for p in parts if p) or "__main__"
+
+    # -- per-module indexing -------------------------------------------
+
+    def _index_module(self, module: str, ctx: FileContext) -> None:
+        imp = self.imports.setdefault(module, {})
+        for node in ctx.tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    imp[a.asname or a.name.split(".")[0]] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                target = self._resolve_from(module, node)
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    imp[a.asname or a.name] = (
+                        f"{target}.{a.name}" if target else a.name
+                    )
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                key = f"{module}.{node.name}"
+                fi = FuncInfo(
+                    key=key, module=module, relpath=ctx.relpath, node=node
+                )
+                self.functions[key] = fi
+                self._index_nested(fi)
+            elif isinstance(node, ast.ClassDef):
+                self._index_class(module, ctx, node)
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                kind = self._lock_factory(module, node.value)
+                if kind:
+                    key = f"{module}.{node.targets[0].id}"
+                    self.locks[key] = LockInfo(
+                        key=key, kind=kind, relpath=ctx.relpath,
+                        line=node.lineno,
+                    )
+
+    def _resolve_from(self, module: str,
+                      node: ast.ImportFrom) -> Optional[str]:
+        if node.level == 0:
+            return node.module
+        # relative import: walk up from the module's package
+        pkg = module.split(".")[:-1]
+        up = node.level - 1
+        if up > len(pkg):
+            return node.module
+        head = pkg[: len(pkg) - up]
+        return ".".join(head + ([node.module] if node.module else [])) \
+            or None
+
+    def _index_class(self, module: str, ctx: FileContext,
+                     node: ast.ClassDef) -> None:
+        key = f"{module}.{node.name}"
+        info = ClassInfo(
+            key=key, module=module, relpath=ctx.relpath, node=node,
+            bases=[self._base_name(b) for b in node.bases],
+        )
+        self.classes[key] = info
+        for item in node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            fkey = f"{key}.{item.name}"
+            fi = FuncInfo(key=fkey, module=module, relpath=ctx.relpath,
+                          node=item, cls=info)
+            info.methods[item.name] = fi
+            self.functions[fkey] = fi
+            self._index_nested(fi)
+            self._index_self_assigns(module, ctx, info, item)
+
+    def _index_nested(self, parent: FuncInfo) -> None:
+        """Register nested defs under ``parent.<locals>.name`` — pump
+        workers and sentinel loops are closures, and their lock
+        activity (and Thread targets) must be in the table. ``cls`` is
+        inherited: a closure's ``self`` is the enclosing method's.
+        Defs anywhere in the parent's statement tree count, but not
+        defs inside deeper nested defs (the recursion owns those)."""
+
+        def scan(node: ast.AST) -> None:
+            for item in ast.iter_child_nodes(node):
+                if isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                    key = f"{parent.key}.<locals>.{item.name}"
+                    fi = FuncInfo(key=key, module=parent.module,
+                                  relpath=parent.relpath, node=item,
+                                  cls=parent.cls)
+                    self.functions.setdefault(key, fi)
+                    self._index_nested(fi)
+                elif not isinstance(item, (ast.ClassDef, ast.Lambda)):
+                    scan(item)
+
+        scan(parent.node)
+
+    @staticmethod
+    def _base_name(node: ast.AST) -> str:
+        if isinstance(node, ast.Name):
+            return node.id
+        if isinstance(node, ast.Attribute):
+            return node.attr
+        return ""
+
+    def _index_self_assigns(self, module: str, ctx: FileContext,
+                            cls: ClassInfo, fn: ast.AST) -> None:
+        """``self.x = threading.Lock()`` -> lock inventory;
+        ``self.x = ClassName(...)`` -> attribute type inference."""
+        for node in ast.walk(fn):
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1):
+                continue
+            tgt = node.targets[0]
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            kind = self._lock_factory(module, node.value)
+            if kind:
+                key = f"{cls.key}.{tgt.attr}"
+                alias = None
+                if kind in ("Condition", "Semaphore") \
+                        and isinstance(node.value, ast.Call) \
+                        and node.value.args:
+                    alias = self._self_lock_text(node.value.args[0], cls)
+                li = LockInfo(key=key, kind=kind, relpath=ctx.relpath,
+                              line=node.lineno, owner=cls.key,
+                              alias_of=alias)
+                self.locks[key] = li
+                cls.lock_attrs[tgt.attr] = li
+            elif isinstance(node.value, ast.Call):
+                ctor = self._callee_key_from_expr(module, node.value.func,
+                                                  cls=None)
+                if ctor:
+                    # may be cross-module / not yet parsed; link()
+                    # resolves against the final class table and drops
+                    # anything that isn't a known class
+                    cls.attr_types.setdefault(tgt.attr, ctor)
+
+    @staticmethod
+    def _self_lock_text(node: ast.AST, cls: ClassInfo) -> Optional[str]:
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            return f"{cls.key}.{node.attr}"
+        return None
+
+    def _lock_factory(self, module: str,
+                      value: ast.AST) -> Optional[str]:
+        """'Lock'/'RLock'/'Condition'/... when ``value`` constructs a
+        threading lock object, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        fn = value.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _LOCK_FACTORIES \
+                and isinstance(fn.value, ast.Name):
+            mod = self.imports.get(module, {}).get(fn.value.id)
+            if mod == "threading" or fn.value.id == "threading":
+                return fn.attr
+        if isinstance(fn, ast.Name) and fn.id in _LOCK_FACTORIES:
+            target = self.imports.get(module, {}).get(fn.id, "")
+            if target == f"threading.{fn.id}":
+                return fn.id
+        return None
+
+    # -- linking (cross-module fixups after all files are parsed) ------
+
+    def link(self) -> None:
+        """Resolve attr types / condition aliases to final class keys and
+        inventory thread spawns (needs the full function table)."""
+        for cls in self.classes.values():
+            for attr, ctor in list(cls.attr_types.items()):
+                resolved = self._resolve_class_key(cls.module, ctor)
+                if resolved:
+                    cls.attr_types[attr] = resolved
+                else:
+                    del cls.attr_types[attr]
+        for lock in self.locks.values():
+            if lock.alias_of and lock.alias_of not in self.locks:
+                lock.alias_of = None
+        self._inventory_threads()
+
+    def _resolve_class_key(self, module: str, name: str) -> Optional[str]:
+        if name in self.classes:
+            return name
+        tail = name.split(".")[-1]
+        local = f"{module}.{tail}"
+        if local in self.classes:
+            return local
+        imp = self.imports.get(module, {})
+        target = imp.get(name) or imp.get(tail)
+        if target and target in self.classes:
+            return target
+        return None
+
+    @staticmethod
+    def _own_nodes(root: ast.AST) -> Iterable[ast.AST]:
+        """Walk a function's own statements, not nested defs' (those
+        are separate FuncInfos and would double-count)."""
+        stack = [root]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, (ast.FunctionDef,
+                                          ast.AsyncFunctionDef,
+                                          ast.Lambda)):
+                    stack.append(child)
+
+    def _inventory_threads(self) -> None:
+        self.threads = []
+        for fi in list(self.functions.values()):
+            for node in self._own_nodes(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                fn = node.func
+                name = fn.attr if isinstance(fn, ast.Attribute) else (
+                    fn.id if isinstance(fn, ast.Name) else None)
+                if name != "Thread":
+                    continue
+                target = next(
+                    (k.value for k in node.keywords if k.arg == "target"),
+                    None,
+                )
+                if target is None:
+                    continue
+                key = self._resolve_ref(fi, target)
+                self.threads.append(ThreadSpawn(
+                    relpath=fi.relpath, line=node.lineno, target=key,
+                    target_text=ast.unparse(target), in_func=fi.key,
+                ))
+
+    # -- resolution ----------------------------------------------------
+
+    def _callee_key_from_expr(self, module: str, fn: ast.AST,
+                              cls: Optional[ClassInfo]) -> Optional[str]:
+        """Dotted best-effort name for a callee expression (may not be a
+        known symbol yet; callers re-resolve against the tables)."""
+        if isinstance(fn, ast.Name):
+            imp = self.imports.get(module, {}).get(fn.id)
+            return imp or f"{module}.{fn.id}"
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+            base = fn.value.id
+            if base == "self":
+                return None  # handled by resolve_call with cls
+            imp = self.imports.get(module, {}).get(base)
+            return f"{imp or base}.{fn.attr}"
+        return None
+
+    def method_on(self, cls_key: str, name: str,
+                  _seen: Optional[set] = None) -> Optional[FuncInfo]:
+        """Method lookup walking the (name-resolved) base chain."""
+        seen = _seen or set()
+        if cls_key in seen:
+            return None
+        seen.add(cls_key)
+        cls = self.classes.get(cls_key)
+        if cls is None:
+            return None
+        if name in cls.methods:
+            return cls.methods[name]
+        for base in cls.bases:
+            bkey = self._resolve_class_key(cls.module, base)
+            if bkey:
+                m = self.method_on(bkey, name, seen)
+                if m is not None:
+                    return m
+        return None
+
+    def _resolve_ref(self, fi: FuncInfo,
+                     ref: ast.AST) -> Optional[str]:
+        """Resolve a *reference* (not a call): Thread targets,
+        callbacks passed by name."""
+        if isinstance(ref, ast.Attribute) \
+                and isinstance(ref.value, ast.Name) \
+                and ref.value.id == "self" and fi.cls is not None:
+            m = self.method_on(fi.cls.key, ref.attr)
+            return m.key if m else None
+        if isinstance(ref, ast.Name):
+            # local (nested) function in the same source scope?
+            local = f"{fi.key}.<locals>.{ref.id}"
+            for key in (local, f"{fi.module}.{ref.id}"):
+                if key in self.functions:
+                    return key
+            imp = self.imports.get(fi.module, {}).get(ref.id)
+            if imp and imp in self.functions:
+                return imp
+            # nested defs aren't in the function table; fall back to a
+            # scan of the enclosing function body
+            for node in ast.walk(fi.node):
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)) \
+                        and node.name == ref.id and node is not fi.node:
+                    return f"{fi.key}.<locals>.{ref.id}"
+        return None
+
+    def resolve_call(self, fi: FuncInfo,
+                     call: ast.Call) -> Optional[FuncInfo]:
+        """The FuncInfo a call lands in, or None when unresolvable."""
+        fn = call.func
+        module = fi.module
+        if isinstance(fn, ast.Name):
+            for key in (f"{module}.{fn.id}",):
+                if key in self.functions:
+                    return self.functions[key]
+            imp = self.imports.get(module, {}).get(fn.id)
+            if imp:
+                if imp in self.functions:
+                    return self.functions[imp]
+                if imp in self.classes:
+                    return self.method_on(imp, "__init__")
+            if f"{module}.{fn.id}" in self.classes:
+                return self.method_on(f"{module}.{fn.id}", "__init__")
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        base = fn.value
+        if isinstance(base, ast.Name):
+            if base.id == "self" and fi.cls is not None:
+                return self.method_on(fi.cls.key, fn.attr)
+            imp = self.imports.get(module, {}).get(base.id)
+            if imp:
+                if f"{imp}.{fn.attr}" in self.functions:
+                    return self.functions[f"{imp}.{fn.attr}"]
+                if imp in self.classes:  # ClassName.method(...)
+                    return self.method_on(imp, fn.attr)
+            if f"{module}.{base.id}" in self.classes:
+                return self.method_on(f"{module}.{base.id}", fn.attr)
+            return None
+        # self.attr.m() through the inferred attribute type
+        if isinstance(base, ast.Attribute) \
+                and isinstance(base.value, ast.Name) \
+                and base.value.id == "self" and fi.cls is not None:
+            tkey = fi.cls.attr_types.get(base.attr)
+            if tkey:
+                return self.method_on(tkey, fn.attr)
+        return None
+
+    # -- lock expression resolution ------------------------------------
+
+    def resolve_lock(self, fi: FuncInfo,
+                     expr: ast.AST) -> Optional[LockInfo]:
+        """The inventoried lock an expression names, or None."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and fi.cls is not None:
+            li = self._class_lock(fi.cls.key, expr.attr)
+            if li is not None:
+                return li
+        if isinstance(expr, ast.Name):
+            key = f"{fi.module}.{expr.id}"
+            if key in self.locks:
+                return self.locks[key]
+            imp = self.imports.get(fi.module, {}).get(expr.id)
+            if imp and imp in self.locks:
+                return self.locks[imp]
+        # obj.attr where obj's type is inferred
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Attribute) \
+                and isinstance(expr.value.value, ast.Name) \
+                and expr.value.value.id == "self" and fi.cls is not None:
+            tkey = fi.cls.attr_types.get(expr.value.attr)
+            if tkey:
+                return self._class_lock(tkey, expr.attr)
+        return None
+
+    def _class_lock(self, cls_key: str, attr: str,
+                    _seen: Optional[set] = None) -> Optional[LockInfo]:
+        seen = _seen or set()
+        if cls_key in seen:
+            return None
+        seen.add(cls_key)
+        cls = self.classes.get(cls_key)
+        if cls is None:
+            return None
+        if attr in cls.lock_attrs:
+            return cls.lock_attrs[attr]
+        for base in cls.bases:
+            bkey = self._resolve_class_key(cls.module, base)
+            if bkey:
+                li = self._class_lock(bkey, attr, seen)
+                if li is not None:
+                    return li
+        return None
+
+    # -- consumers ------------------------------------------------------
+
+    def contexts(self) -> list[FileContext]:
+        return [self.files[k] for k in sorted(self.files)]
+
+    def locksmith(self):
+        """The (cached) whole-program concurrency analysis."""
+        if self._locksmith is None:
+            from . import locksmith as _locksmith
+
+            self._locksmith = _locksmith.analyze(self)
+        return self._locksmith

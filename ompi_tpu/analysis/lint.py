@@ -1,9 +1,13 @@
 """commlint driver — static communication-correctness analysis.
 
-The linter walks Python sources, parses them once, and hands each file
-to every selected rule component (``analysis/rules/``, an MCA framework
-— rules are selectable/disableable via the ``commlint_select`` and
-``commlint_<rule>_priority`` cvars like any other component stack).
+The linter discovers Python sources, parses each exactly once into a
+shared ``ProjectIndex`` (analysis/index.py), and hands every file's
+cached ``FileContext`` to every selected rule component
+(``analysis/rules/``, an MCA framework — rules are selectable /
+disableable via the ``commlint_select`` and ``commlint_<rule>_priority``
+cvars like any other component stack).  Whole-program rules (the
+locksmith concurrency set) reach through ``ctx.index`` for the symbol
+table, call graph, and lock inventory built from the same parse.
 
 Suppressions are source-level: a ``# commlint: allow(<rule>)`` comment
 on the flagged line or the line above silences that rule there. The
@@ -22,61 +26,20 @@ or ``python -m ompi_tpu.tools.lint <path>``.
 
 from __future__ import annotations
 
-import ast
 import os
-import re
 import time
 from typing import Iterable, Sequence
 
 from ..core import config
-from .report import Finding, Report, Severity
+from .index import FileContext, ProjectIndex   # noqa: F401 (re-export)
+from .report import Finding, Report
 from .rules import COMMLINT, ensure_rules
-
-_ALLOW_RE = re.compile(r"#\s*commlint:\s*allow\(\s*([\w\-, ]+?)\s*\)")
 
 config.register(
     "commlint", "base", "exclude",
     type=str, default="__pycache__,.git,build,dist",
     description="comma-separated directory names the linter skips",
 )
-
-
-class FileContext:
-    """One parsed source file, shared by every rule.
-
-    Attributes
-    ----------
-    path:     the path as given to the linter (for error messages)
-    relpath:  path relative to the lint root, '/'-normalised — this is
-              what appears in findings and baseline keys, so baselines
-              are stable across checkouts.
-    tree:     the parsed ``ast`` module
-    lines:    source split into lines (1-indexed via ``lines[i-1]``)
-    """
-
-    def __init__(self, path: str, source: str, relpath: str | None = None):
-        self.path = path
-        self.relpath = (relpath or path).replace(os.sep, "/")
-        self.source = source
-        self.lines = source.splitlines()
-        self.tree = ast.parse(source, filename=path)
-        self._allow: dict[int, frozenset[str]] = {}
-        for i, line in enumerate(self.lines, start=1):
-            m = _ALLOW_RE.search(line)
-            if m:
-                names = frozenset(
-                    p.strip() for p in m.group(1).split(",") if p.strip()
-                )
-                self._allow[i] = names
-
-    def suppressed(self, line: int, rule: str) -> bool:
-        """True if ``# commlint: allow(rule)`` covers ``line``
-        (same line or the line immediately above)."""
-        for ln in (line, line - 1):
-            names = self._allow.get(ln)
-            if names and (rule in names or "all" in names):
-                return True
-        return False
 
 
 class Linter:
@@ -132,13 +95,24 @@ class Linter:
 
     # -- linting ------------------------------------------------------
 
-    def lint_source(self, source: str, path: str = "<string>",
-                    relpath: str | None = None) -> list[Finding]:
+    def _load(self, path: str,
+              index: ProjectIndex) -> FileContext | None:
+        """Parse one file into the shared index (None on error)."""
         try:
-            ctx = FileContext(path, source, relpath=relpath)
+            with open(path, "r", encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError as exc:
+            self.errors.append(f"{path}: {exc}")
+            return None
+        try:
+            ctx = FileContext(path, source, relpath=self._relpath(path))
         except SyntaxError as exc:
             self.errors.append(f"{path}: syntax error: {exc}")
-            return []
+            return None
+        self.files_checked += 1
+        return index.add_context(ctx)
+
+    def _check(self, ctx: FileContext) -> list[Finding]:
         findings: list[Finding] = []
         for rule in self.rules:
             try:
@@ -147,26 +121,46 @@ class Linter:
                 # A crashing rule must not take the whole run down;
                 # surface it as a run error instead.
                 self.errors.append(
-                    f"{path}: rule {rule.NAME!r} crashed: {exc!r}"
+                    f"{ctx.path}: rule {rule.NAME!r} crashed: {exc!r}"
                 )
         return findings
 
-    def lint_file(self, path: str) -> list[Finding]:
+    def lint_source(self, source: str, path: str = "<string>",
+                    relpath: str | None = None) -> list[Finding]:
+        """Lint a bare snippet: a one-file index (whole-program rules
+        see just this module)."""
+        index = ProjectIndex(base=self.base)
         try:
-            with open(path, "r", encoding="utf-8") as fh:
-                source = fh.read()
-        except OSError as exc:
-            self.errors.append(f"{path}: {exc}")
+            ctx = FileContext(path, source, relpath=relpath)
+        except SyntaxError as exc:
+            self.errors.append(f"{path}: syntax error: {exc}")
             return []
-        self.files_checked += 1
-        return self.lint_source(source, path=path,
-                                relpath=self._relpath(path))
+        index.add_context(ctx)
+        index.link()
+        return self._check(ctx)
+
+    def lint_file(self, path: str) -> list[Finding]:
+        index = ProjectIndex(base=self.base)
+        ctx = self._load(path, index)
+        if ctx is None:
+            return []
+        index.link()
+        return self._check(ctx)
 
     def lint_paths(self, paths: Sequence[str]) -> Report:
+        """The parse-once engine: every discovered file enters the
+        shared ProjectIndex, then every rule sees every cached tree."""
         t0 = time.perf_counter()
-        findings: list[Finding] = []
+        index = ProjectIndex(base=self.base)
+        ctxs: list[FileContext] = []
         for src in self.iter_sources(paths):
-            findings.extend(self.lint_file(src))
+            ctx = self._load(src, index)
+            if ctx is not None:
+                ctxs.append(ctx)
+        index.link()
+        findings: list[Finding] = []
+        for ctx in ctxs:
+            findings.extend(self._check(ctx))
         self.elapsed_ms = (time.perf_counter() - t0) * 1e3
         return Report(findings)
 

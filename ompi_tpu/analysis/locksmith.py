@@ -1,0 +1,995 @@
+"""locksmith — whole-program concurrency analysis over a ProjectIndex.
+
+The runtime is deeply threaded (overlap pump threads, the medic
+Supervisor, the telemetry sampler, the daemon pump, slipstream's
+cross-step tail drain) and the two worst shipped bugs were both lock
+bugs the per-file linter could not see: a ledger->breaker lock-order
+pin (PR 8) and a lost-combine race on an unguarded tally (PR 15).
+locksmith graduates the analysis layer from per-file pattern lint to a
+whole-program concurrency model:
+
+- **lockset dataflow**: every function is scanned once for the locks
+  it acquires (``with self._mu:`` regions, explicit
+  ``acquire()``/``release()``), the calls it makes *while holding
+  them*, and the ``self.x`` writes in each region.  Locksets propagate
+  through the ProjectIndex call graph, so holding ``ledger._mu`` while
+  calling into ``breaker.open_breaker`` (which takes ``breaker._mu``)
+  produces a cross-module edge with the full call-chain witness.
+
+- **lock-order graph + deadlock cycles** (commlint rule ``lockorder``,
+  ERROR): a directed edge A->B means "B was acquired while A was
+  held"; every elementary cycle is a potential deadlock, reported with
+  the complete ``file:line`` acquire/call witness chain of each edge.
+
+- **callback-under-lock** (rule ``cbunderlock``, WARNING): invoking a
+  passed-in callable or a registered-callback attribute while holding
+  a lock — the PR 8 class.  The fix idiom is the ledger's
+  ``_drain_restored``: queue under the lock, fire after release.
+
+- **guarded-by inference** (rule ``unguardedwrite``, WARNING): an
+  attribute written under its class's lock at some sites and outside
+  any lock at others is a data race candidate — the PR 15
+  ``_tiles_reduced`` class.  The thread-spawn inventory names which
+  spawned threads actually reach the attribute.
+
+- **runtime lock witness**: the dynamic half (commsan's validation
+  idiom applied to locks).  ``witness()`` interposes
+  ``threading.Lock/RLock/Condition`` creation, records every
+  actually-observed acquisition-order edge per thread, and at finalize
+  reports runtime cycles plus static edges never witnessed — the
+  static model is validated the same way commsan validates request
+  lifecycles.
+
+Everything is best-effort static analysis: unresolved receivers and
+dynamic dispatch contribute nothing.  Intentional exceptions carry
+``# commlint: allow(<rule>)`` with a justification, and the historical
+remainder rides the per-rule:file ratchet baseline like every other
+commlint rule.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+import threading
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .report import Finding, Severity
+
+#: Attribute/variable name words that mark a callable as a registered
+#: callback/handler (the defer-outside-the-lock contract).
+_CB_WORDS = frozenset({
+    "cb", "cbs", "callback", "callbacks", "hook", "hooks", "handler",
+    "handlers", "listener", "listeners", "subscriber", "subscribers",
+    "observer", "observers", "watcher", "watchers",
+})
+
+_WORD_RE = re.compile(r"[a-z0-9]+")
+
+
+def _name_words(ident: str) -> frozenset[str]:
+    """snake_case/camelCase identifier -> lowercase word set
+    (word-boundary matching: 'nranks' yields {'nranks'}, not 'rank')."""
+    s = re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", ident)
+    return frozenset(_WORD_RE.findall(s.lower()))
+
+
+def _is_cb_name(ident: str) -> bool:
+    return bool(_name_words(ident) & _CB_WORDS)
+
+
+# -- per-function scan ------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Frame:
+    """One witness step: a source location plus what happened there."""
+
+    relpath: str
+    line: int
+    what: str
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.line} ({self.what})"
+
+
+@dataclass
+class CallSite:
+    callee: str                      # FuncInfo key
+    frame: Frame
+    held: dict[str, Frame]           # lock key -> acquire frame
+
+
+@dataclass
+class CbCall:
+    desc: str                        # what was invoked
+    frame: Frame
+    held: dict[str, Frame]
+
+
+@dataclass
+class Write:
+    attr: str                        # "module.Class.attr"
+    frame: Frame
+    held: frozenset[str]
+    func: str                        # writing function key
+
+
+@dataclass
+class Summary:
+    """What one function does with locks (intra-procedural facts)."""
+
+    func: str
+    acquires: dict[str, Frame] = field(default_factory=dict)
+    edges: dict[tuple[str, str], list[Frame]] = field(default_factory=dict)
+    calls: list[CallSite] = field(default_factory=list)
+    writes: list[Write] = field(default_factory=list)
+    cb_calls: list[CbCall] = field(default_factory=list)
+
+
+class _Scan:
+    """Lockset walker over one function body.
+
+    Tracks the held-lock environment through ``with`` nesting and
+    explicit acquire()/release() statements; branches are walked with
+    the entry lockset (conservative: a branch cannot add to the
+    lockset seen after the statement)."""
+
+    def __init__(self, index, fi) -> None:
+        self.index = index
+        self.fi = fi
+        self.sum = Summary(func=fi.key)
+        self.tainted: set[str] = set()   # names bound from callback attrs
+        self.params = set(fi.params)
+
+    def run(self) -> Summary:
+        self._body(self.fi.node.body, {})
+        return self.sum
+
+    # -- statement dispatch --------------------------------------------
+
+    def _body(self, stmts, held: dict[str, Frame]) -> None:
+        held = dict(held)   # acquire()/release() mutate locally
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                self._with(stmt, held)
+            elif isinstance(stmt, ast.If):
+                self._expr(stmt.test, held)
+                self._body(stmt.body, held)
+                self._body(stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                self._taint_target(stmt.target, stmt.iter)
+                self._expr(stmt.iter, held)
+                self._body(stmt.body, held)
+                self._body(stmt.orelse, held)
+            elif isinstance(stmt, ast.While):
+                self._expr(stmt.test, held)
+                self._body(stmt.body, held)
+                self._body(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                self._body(stmt.body, held)
+                for h in stmt.handlers:
+                    self._body(h.body, held)
+                self._body(stmt.orelse, held)
+                self._body(stmt.finalbody, held)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                continue   # separate scope (indexed separately)
+            elif isinstance(stmt, ast.Expr) and self._acquire_stmt(
+                    stmt.value, held):
+                continue
+            else:
+                if isinstance(stmt, ast.Assign):
+                    self._taint_assign(stmt)
+                self._writes(stmt, held)
+                self._expr(stmt, held)
+
+    def _with(self, stmt, held: dict[str, Frame]) -> None:
+        new = dict(held)
+        for item in stmt.items:
+            self._expr(item.context_expr, held)
+            li = self.index.resolve_lock(self.fi, item.context_expr)
+            if li is None:
+                continue
+            key = li.resolved_key()
+            frame = Frame(self.fi.relpath, item.context_expr.lineno,
+                          f"acquire {key}")
+            self._acquired(key, frame, new)
+        self._body(stmt.body, new)
+
+    def _acquire_stmt(self, value, held: dict[str, Frame]) -> bool:
+        """Handle standalone ``x.acquire()`` / ``x.release()``; returns
+        True when consumed (held mutated for the rest of this body)."""
+        if not (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Attribute)
+                and value.func.attr in ("acquire", "release")):
+            return False
+        li = self.index.resolve_lock(self.fi, value.func.value)
+        if li is None:
+            return False
+        key = li.resolved_key()
+        if value.func.attr == "acquire":
+            frame = Frame(self.fi.relpath, value.lineno, f"acquire {key}")
+            self._acquired(key, frame, held)
+        else:
+            held.pop(key, None)
+        return True
+
+    def _acquired(self, key: str, frame: Frame,
+                  held: dict[str, Frame]) -> None:
+        self.sum.acquires.setdefault(key, frame)
+        for hkey, hframe in held.items():
+            if hkey != key:
+                self.sum.edges.setdefault((hkey, key), [hframe, frame])
+        held[key] = frame
+
+    # -- expression scan (calls, callbacks) ----------------------------
+
+    def _expr(self, node, held: dict[str, Frame]) -> None:
+        for sub in self._expr_walk(node):
+            if isinstance(sub, ast.Call):
+                self._call(sub, held)
+
+    @staticmethod
+    def _expr_walk(node):
+        """ast.walk without descending into nested defs/lambdas (their
+        bodies execute later, under whatever locks *they* see)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _call(self, call: ast.Call, held: dict[str, Frame]) -> None:
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in (
+                "acquire", "release", "locked"):
+            if self.index.resolve_lock(self.fi, fn.value) is not None:
+                return   # lock ops inside expressions: not a call edge
+        callee = self.index.resolve_call(self.fi, call)
+        if callee is not None:
+            self.sum.calls.append(CallSite(
+                callee=callee.key,
+                frame=Frame(self.fi.relpath, call.lineno,
+                            f"call {callee.key}"),
+                held=dict(held),
+            ))
+            return
+        if not held:
+            return
+        desc = self._callback_desc(fn)
+        if desc is not None:
+            self.sum.cb_calls.append(CbCall(
+                desc=desc,
+                frame=Frame(self.fi.relpath, call.lineno,
+                            f"invoke {desc}"),
+                held=dict(held),
+            ))
+
+    def _callback_desc(self, fn) -> Optional[str]:
+        """Non-None when the callee expression is callback-shaped:
+        a passed-in callable parameter, a name bound from a registered
+        callback collection, or a callback-named attribute."""
+        if isinstance(fn, ast.Name):
+            if fn.id in self.params and fn.id != "self":
+                return f"passed-in callable {fn.id!r}"
+            if fn.id in self.tainted or _is_cb_name(fn.id):
+                return f"registered callback {fn.id!r}"
+            return None
+        if isinstance(fn, ast.Attribute) and _is_cb_name(fn.attr) \
+                and not fn.attr[:1].isupper() \
+                and isinstance(fn.value, ast.Name) \
+                and fn.value.id == "self":
+            # self-receivers only: logging.StreamHandler(...) or
+            # logger.addHandler(...) are constructors/registrations on
+            # foreign objects, not registered-callback dispatch
+            return f"callback attribute .{fn.attr}"
+        if isinstance(fn, ast.Subscript):
+            base = fn.value
+            if isinstance(base, ast.Attribute) and _is_cb_name(base.attr):
+                return f"callback table .{base.attr}[...]"
+            if isinstance(base, ast.Name) and (
+                    base.id in self.tainted or _is_cb_name(base.id)):
+                return f"callback table {base.id!r}[...]"
+        return None
+
+    # -- callback taint -------------------------------------------------
+
+    def _taint_assign(self, stmt: ast.Assign) -> None:
+        if not self._cb_source(stmt.value):
+            return
+        for tgt in stmt.targets:
+            if isinstance(tgt, ast.Name):
+                self.tainted.add(tgt.id)
+
+    def _taint_target(self, target, source) -> None:
+        if self._cb_source(source) and isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+
+    def _cb_source(self, expr) -> bool:
+        for sub in self._expr_walk(expr):
+            if isinstance(sub, ast.Attribute) and _is_cb_name(sub.attr):
+                return True
+            if isinstance(sub, ast.Name) and sub.id in self.tainted:
+                return True
+        return False
+
+    # -- attribute writes ----------------------------------------------
+
+    def _writes(self, stmt, held: dict[str, Frame]) -> None:
+        if self.fi.cls is None:
+            return
+        targets: list = []
+        if isinstance(stmt, ast.Assign):
+            targets = list(stmt.targets)
+        elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+            targets = [stmt.target]
+        for tgt in targets:
+            for t in self._flatten_target(tgt):
+                attr = self._self_attr(t)
+                if attr is None:
+                    continue
+                self.sum.writes.append(Write(
+                    attr=f"{self.fi.cls.key}.{attr}",
+                    frame=Frame(self.fi.relpath, stmt.lineno,
+                                f"write self.{attr}"),
+                    held=frozenset(held),
+                    func=self.fi.key,
+                ))
+
+    @staticmethod
+    def _flatten_target(tgt) -> list:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            out = []
+            for e in tgt.elts:
+                out.extend(_Scan._flatten_target(e))
+            return out
+        return [tgt]
+
+    @staticmethod
+    def _self_attr(t) -> Optional[str]:
+        # self.x = / self.x[...] = : both mutate the attribute's value
+        if isinstance(t, (ast.Subscript,)):
+            t = t.value
+        if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+                and t.value.id == "self":
+            return t.attr
+        return None
+
+
+# -- whole-program analysis -------------------------------------------------
+
+
+@dataclass
+class Edge:
+    """One lock-order edge with its acquire/call witness chain."""
+
+    src: str
+    dst: str
+    witness: list[Frame]
+
+    def render(self) -> str:
+        chain = " -> ".join(f.render() for f in self.witness)
+        return f"{self.src} -> {self.dst} [{chain}]"
+
+
+class Analysis:
+    """The whole-program result: summaries, graph, cycles, findings."""
+
+    def __init__(self, index) -> None:
+        self.index = index
+        self.summaries: dict[str, Summary] = {}
+        self.entry_held: dict[str, frozenset[str]] = {}
+        self.edges: dict[tuple[str, str], Edge] = {}
+        self.cycles: list[list[Edge]] = []
+        self.findings: list[Finding] = []
+        self._by_file: Optional[dict[tuple[str, str], list[Finding]]] = None
+
+    # -- queries --------------------------------------------------------
+
+    def findings_for(self, relpath: str, rule: str) -> list[Finding]:
+        if self._by_file is None:
+            by: dict[tuple[str, str], list[Finding]] = {}
+            for f in self.findings:
+                by.setdefault((f.path, f.rule), []).append(f)
+            self._by_file = by
+        return self._by_file.get((relpath, rule), [])
+
+    def holders(self) -> dict[str, list[str]]:
+        """lock key -> sorted function keys that acquire it directly."""
+        out: dict[str, set[str]] = {}
+        for s in self.summaries.values():
+            for lock in s.acquires:
+                out.setdefault(lock, set()).add(s.func)
+        return {k: sorted(v) for k, v in sorted(out.items())}
+
+    def waiters(self) -> dict[str, list[Edge]]:
+        """lock key -> edges that acquire it while something is held
+        (who waits on this lock with another lock pinned)."""
+        out: dict[str, list[Edge]] = {}
+        for e in self.edges.values():
+            out.setdefault(e.dst, []).append(e)
+        return {k: sorted(v, key=lambda e: e.src)
+                for k, v in sorted(out.items())}
+
+    def to_dot(self) -> str:
+        """GraphViz dump of the lock-order graph; cycle edges in red."""
+        in_cycle = {(e.src, e.dst) for cyc in self.cycles for e in cyc}
+        lines = ["digraph lockorder {", '  rankdir="LR";']
+        names = sorted({k for e in self.edges for k in e}
+                       | set(self.index.locks))
+        for n in names:
+            li = self.index.locks.get(n)
+            label = f"{n}\\n{li.relpath}:{li.line}" if li else n
+            lines.append(f'  "{n}" [label="{label}"];')
+        for (src, dst), e in sorted(self.edges.items()):
+            attr = ' [color="red",penwidth=2]' \
+                if (src, dst) in in_cycle else ""
+            lines.append(f'  "{src}" -> "{dst}"{attr};')
+        lines.append("}")
+        return "\n".join(lines)
+
+
+def analyze(index) -> Analysis:
+    """Run the full concurrency analysis over an index (cached there —
+    prefer ``index.locksmith()``)."""
+    an = Analysis(index)
+    for key, fi in index.functions.items():
+        try:
+            an.summaries[key] = _Scan(index, fi).run()
+        except RecursionError:   # pathological nesting: skip the func
+            an.summaries[key] = Summary(func=key)
+    _build_edges(an)
+    _entry_locksets(an)
+    _find_cycles(an)
+    _emit_findings(an)
+    _count(an)
+    return an
+
+
+def _entry_locksets(an: Analysis) -> None:
+    """locks guaranteed held on entry to each function: the meet
+    (intersection) over every static call site of (caller's entry set
+    ∪ locks held at the call).  Functions with no in-repo callers are
+    entry points: nothing guaranteed.  This is what keeps private
+    helpers like ledger._transition — only ever called under ``_mu`` —
+    from reading as unguarded."""
+    callers: dict[str, list[tuple[str, frozenset[str]]]] = {}
+    for s in an.summaries.values():
+        for call in s.calls:
+            callers.setdefault(call.callee, []).append(
+                (s.func, frozenset(call.held)))
+    TOP = None   # "not yet constrained" (identity for intersection)
+    entry: dict[str, Optional[frozenset[str]]] = {
+        k: (TOP if k in callers else frozenset())
+        for k in an.summaries
+    }
+    for _ in range(16):   # decreasing lattice, tiny lock universe
+        changed = False
+        for fkey, sites in callers.items():
+            acc: Optional[frozenset[str]] = TOP
+            for caller, held in sites:
+                ce = entry.get(caller) or frozenset()
+                site = held | ce
+                acc = site if acc is TOP else (acc & site)
+            if acc is not TOP and entry.get(fkey) != acc:
+                entry[fkey] = acc
+                changed = True
+        if not changed:
+            break
+    an.entry_held = {k: (v or frozenset()) for k, v in entry.items()}
+
+
+def _trans_acquires(an: Analysis, key: str,
+                    memo: dict, stack: set) -> dict[str, list[Frame]]:
+    """lock -> call/acquire witness path for every lock the function
+    acquires transitively (first path found wins)."""
+    if key in memo:
+        return memo[key]
+    if key in stack:
+        return {}
+    stack.add(key)
+    out: dict[str, list[Frame]] = {}
+    s = an.summaries.get(key)
+    if s is not None:
+        for lock, frame in s.acquires.items():
+            out.setdefault(lock, [frame])
+        for call in s.calls:
+            sub = _trans_acquires(an, call.callee, memo, stack)
+            for lock, path in sub.items():
+                if lock not in out and len(path) < 12:
+                    out[lock] = [call.frame] + path
+    stack.discard(key)
+    memo[key] = out
+    return out
+
+
+def _build_edges(an: Analysis) -> None:
+    memo: dict = {}
+    for s in an.summaries.values():
+        for (src, dst), frames in s.edges.items():
+            an.edges.setdefault((src, dst),
+                                Edge(src=src, dst=dst, witness=frames))
+        for call in s.calls:
+            if not call.held:
+                continue
+            sub = _trans_acquires(an, call.callee, memo, set())
+            for lock, path in sub.items():
+                for hkey, hframe in call.held.items():
+                    if lock == hkey:
+                        continue
+                    an.edges.setdefault(
+                        (hkey, lock),
+                        Edge(src=hkey, dst=lock,
+                             witness=[hframe] + path),
+                    )
+
+
+def _find_cycles(an: Analysis, max_len: int = 4,
+                 max_cycles: int = 64) -> None:
+    """Elementary cycles up to ``max_len`` edges; each reported once
+    (rooted at its lexicographically-smallest lock)."""
+    adj: dict[str, list[str]] = {}
+    for src, dst in an.edges:
+        adj.setdefault(src, []).append(dst)
+    for v in adj.values():
+        v.sort()
+    seen: set[tuple[str, ...]] = set()
+
+    def dfs(root: str, node: str, path: list[str]) -> None:
+        if len(an.cycles) >= max_cycles:
+            return
+        for nxt in adj.get(node, ()):
+            if nxt == root and len(path) >= 2:
+                cyc = tuple(path)
+                if min(cyc) == root and cyc not in seen:
+                    seen.add(cyc)
+                    an.cycles.append([
+                        an.edges[(path[i], path[(i + 1) % len(path)])]
+                        for i in range(len(path))
+                    ])
+            elif nxt > root and nxt not in path and len(path) < max_len:
+                dfs(root, nxt, path + [nxt])
+
+    for root in sorted(adj):
+        dfs(root, root, [root])
+
+
+def _emit_findings(an: Analysis) -> None:
+    for cyc in an.cycles:
+        locks = [e.src for e in cyc] + [cyc[0].src]
+        chain = "; ".join(e.render() for e in cyc)
+        anchor = cyc[0].witness[0]
+        an.findings.append(Finding(
+            rule="lockorder", severity=Severity.ERROR,
+            path=anchor.relpath, line=anchor.line,
+            message=(
+                "potential deadlock: lock-order cycle "
+                f"{' -> '.join(locks)}; witness: {chain} — two threads "
+                "entering from opposite ends block forever; impose one "
+                "global order or drop to a single lock"
+            ),
+        ))
+    for s in an.summaries.values():
+        for cb in s.cb_calls:
+            lock, frame = next(iter(sorted(cb.held.items())))
+            an.findings.append(Finding(
+                rule="cbunderlock", severity=Severity.WARNING,
+                path=cb.frame.relpath, line=cb.frame.line,
+                message=(
+                    f"{cb.desc} invoked while holding {lock} (acquired "
+                    f"at {frame.relpath}:{frame.line}) — a callback "
+                    "that blocks or re-enters the lock deadlocks; "
+                    "queue under the lock and fire after release (the "
+                    "ledger._drain_restored idiom)"
+                ),
+            ))
+    _guarded_by(an)
+    an.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+
+
+def _guarded_by(an: Analysis) -> None:
+    """Attributes written both under a class lock and outside any lock."""
+    index = an.index
+    by_attr: dict[str, list[Write]] = {}
+    for s in an.summaries.values():
+        for w in s.writes:
+            by_attr.setdefault(w.attr, []).append(w)
+    reach_memo: dict[str, set[str]] = {}
+    for attr, writes in sorted(by_attr.items()):
+        cls_key = attr.rsplit(".", 1)[0]
+        cls = index.classes.get(cls_key)
+        if cls is None or not cls.lock_attrs:
+            continue
+        own_locks = {li.resolved_key() for li in cls.lock_attrs.values()}
+
+        def eff(w: Write) -> frozenset[str]:
+            return w.held | an.entry_held.get(w.func, frozenset())
+
+        guarded = [w for w in writes if eff(w) & own_locks]
+        unguarded = [
+            w for w in writes
+            if not eff(w) and not w.func.endswith(".__init__")
+        ]
+        if not guarded or not unguarded:
+            continue
+        g = guarded[0]
+        lockname = sorted(eff(g) & own_locks)[0]
+        writers = {w.func for w in writes}
+        racing = _racing_threads(an, writers, reach_memo)
+        race = (
+            "; racing threads: " + ", ".join(racing)
+            if racing else "; no spawn site resolved to a racing thread "
+            "(pump/supervisor callbacks may still race)"
+        )
+        w0 = unguarded[0]
+        an.findings.append(Finding(
+            rule="unguardedwrite", severity=Severity.WARNING,
+            path=w0.frame.relpath, line=w0.frame.line,
+            message=(
+                f"self.{attr.rsplit('.', 1)[1]} is written under "
+                f"{lockname} at {len(guarded)} site(s) (e.g. "
+                f"{g.frame.relpath}:{g.frame.line}) but unguarded here"
+                + (f" and at {len(unguarded) - 1} more site(s)"
+                   if len(unguarded) > 1 else "")
+                + " — concurrent writers can lose updates (the "
+                "_tiles_reduced lost-combine class); hold the lock or "
+                "document the happens-before" + race
+            ),
+        ))
+
+
+def _racing_threads(an: Analysis, writers: set[str],
+                    memo: dict[str, set[str]]) -> list[str]:
+    """Thread spawns whose target's transitive callees include one of
+    the writer functions."""
+    out = []
+    for spawn in an.index.threads:
+        if spawn.target is None:
+            continue
+        reach = memo.get(spawn.target)
+        if reach is None:
+            reach = set()
+            stack = [spawn.target]
+            while stack:
+                k = stack.pop()
+                if k in reach:
+                    continue
+                reach.add(k)
+                s = an.summaries.get(k)
+                if s is not None:
+                    stack.extend(c.callee for c in s.calls)
+            memo[spawn.target] = reach
+        if writers & reach:
+            out.append(f"{spawn.relpath}:{spawn.line} "
+                       f"(target {spawn.target_text})")
+    return sorted(set(out))
+
+
+def _count(an: Analysis) -> None:
+    try:
+        from ..core.counters import SPC
+    except Exception:   # commlint: allow(broadexcept)
+        return          # analysis layer must not require the runtime
+    SPC.record("locksmith_functions_scanned", len(an.summaries))
+    SPC.record("locksmith_locks_inventoried", len(an.index.locks))
+    SPC.record("locksmith_order_edges", len(an.edges))
+    for rule in ("lockorder", "cbunderlock", "unguardedwrite"):
+        n = sum(1 for f in an.findings if f.rule == rule)
+        if n:
+            SPC.record(f"locksmith_findings_{rule}", n)
+
+
+# -- runtime lock witness ---------------------------------------------------
+
+_THIS_FILE = os.path.abspath(__file__)
+_STDLIB_THREADING = os.path.abspath(threading.__file__)
+
+
+class _WitnessLock:
+    """Wraps a real threading lock; every acquire/release reports to
+    the witness with this lock's identity (static key when the
+    creation site matches the index inventory)."""
+
+    def __init__(self, real, key: str, witness: "LockWitness") -> None:
+        self._real = real
+        self.key = key
+        self._w = witness
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        ok = self._real.acquire(blocking, timeout)
+        if ok:
+            self._w._on_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._w._on_release(self)
+        self._real.release()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        fn = getattr(self._real, "locked", None)
+        return bool(fn()) if fn is not None else False
+
+    # Condition protocol (RLock hosts): keep the witness's held stack
+    # balanced across cv.wait()'s release/reacquire.  Resolved via
+    # __getattr__ so a plain-Lock host raises AttributeError at
+    # *access* time — Condition.__init__ probes with try/except and
+    # must fall back to acquire()/release() for locks without these.
+    def __getattr__(self, name: str):
+        if name == "_release_save":
+            fn = self._real._release_save
+            w, me = self._w, self
+
+            def _release_save():
+                w._on_release(me)
+                return fn()
+            return _release_save
+        if name == "_acquire_restore":
+            fn = self._real._acquire_restore
+            w, me = self._w, self
+
+            def _acquire_restore(state):
+                fn(state)
+                w._on_acquire(me)
+            return _acquire_restore
+        if name == "_is_owned":
+            return self._real._is_owned
+        raise AttributeError(name)
+
+
+@dataclass
+class _ObservedEdge:
+    count: int = 0
+    thread: str = ""
+    site: tuple[str, int] = ("", 0)
+
+
+class LockWitness:
+    """Opt-in runtime acquisition-order recorder.
+
+    ``install()`` interposes ``threading.Lock/RLock/Condition`` so
+    every lock created while the witness is active is wrapped; each
+    wrapped lock's creation site is matched against the static
+    inventory (when an index is given) so runtime edges and static
+    edges share a key space.  ``report()`` returns runtime cycles
+    (ERROR) plus static edges never witnessed (NOTE — untested order
+    assumptions, commsan's "modeled but never exercised" class).
+    """
+
+    def __init__(self, index=None) -> None:
+        self.index = index
+        self.edges: dict[tuple[str, str], _ObservedEdge] = {}
+        self._tls = threading.local()
+        self._mu = threading.Lock()   # guards .edges  # commlint: allow(unguardedwrite)
+        self._orig: Optional[tuple] = None
+        self._site_to_key: dict[tuple[str, int], str] = {}
+        if index is not None:
+            for li in index.locks.values():
+                base = os.path.basename(li.relpath)
+                self._site_to_key[(base, li.line)] = li.resolved_key()
+
+    # -- interposition --------------------------------------------------
+
+    def install(self) -> "LockWitness":
+        if self._orig is not None:
+            return self
+        self._orig = (threading.Lock, threading.RLock,
+                      threading.Condition)
+        orig_lock, orig_rlock, orig_cond = self._orig
+
+        def _key() -> Optional[str]:
+            f = sys._getframe(2)
+            while f is not None and os.path.abspath(
+                    f.f_code.co_filename) == _THIS_FILE:
+                f = f.f_back
+            if f is None:
+                return "<unknown>"
+            fname = os.path.abspath(f.f_code.co_filename)
+            if fname == _STDLIB_THREADING:
+                # threading's own plumbing (Thread/Event/Timer
+                # internals) — interposing it only adds noise edges
+                # among locks no user code can ever hold.
+                return None
+            base = os.path.basename(fname)
+            site = (base, f.f_lineno)
+            return self._site_to_key.get(site, f"{base}:{f.f_lineno}")
+
+        def make_lock():
+            key = _key()
+            real = orig_lock()
+            return real if key is None else _WitnessLock(real, key, self)
+
+        def make_rlock():
+            key = _key()
+            real = orig_rlock()
+            return real if key is None else _WitnessLock(real, key, self)
+
+        def make_condition(lock=None):
+            if lock is None:
+                key = _key()
+                if key is not None:
+                    lock = _WitnessLock(orig_rlock(), key, self)
+            return orig_cond(lock)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        threading.Condition = make_condition
+        return self
+
+    def uninstall(self) -> None:
+        if self._orig is not None:
+            (threading.Lock, threading.RLock,
+             threading.Condition) = self._orig
+            self._orig = None
+
+    def __enter__(self) -> "LockWitness":
+        return self.install()
+
+    def __exit__(self, *exc) -> None:
+        self.uninstall()
+
+    # -- recording ------------------------------------------------------
+
+    def _held(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def _on_acquire(self, lock: _WitnessLock) -> None:
+        st = self._held()
+        if any(h is lock for h in st):   # RLock re-entry: no new edge
+            st.append(lock)
+            return
+        new_edges = []
+        for h in st:
+            if h.key != lock.key:
+                new_edges.append((h.key, lock.key))
+        st.append(lock)
+        if new_edges:
+            f = sys._getframe(2)
+            while f is not None and os.path.abspath(
+                    f.f_code.co_filename) == _THIS_FILE:
+                f = f.f_back
+            site = (os.path.basename(f.f_code.co_filename), f.f_lineno) \
+                if f else ("", 0)
+            with self._mu:
+                for pair in new_edges:
+                    e = self.edges.get(pair)
+                    if e is None:
+                        e = self.edges[pair] = _ObservedEdge()
+                    e.count += 1
+                    if e.count == 1:
+                        e.thread = threading.current_thread().name
+                        e.site = site
+
+    def _on_release(self, lock: _WitnessLock) -> None:
+        st = self._held()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lock:
+                del st[i]
+                return
+
+    # -- finalize -------------------------------------------------------
+
+    def report(self) -> list[Finding]:
+        findings: list[Finding] = []
+        with self._mu:
+            observed = dict(self.edges)
+        adj: dict[str, set[str]] = {}
+        for src, dst in observed:
+            adj.setdefault(src, set()).add(dst)
+        seen_cycles: set[frozenset] = set()
+        for (src, dst), e in sorted(observed.items()):
+            # runtime cycle: any path dst ->* src among observed edges
+            if _reaches(adj, dst, src):
+                cyc_key = frozenset((src, dst))
+                if cyc_key in seen_cycles:
+                    continue
+                seen_cycles.add(cyc_key)
+                back = observed.get((dst, src))
+                via = (f"; reverse edge observed on thread "
+                       f"{back.thread!r} at {back.site[0]}:{back.site[1]}"
+                       if back is not None else "")
+                findings.append(Finding(
+                    rule="witness-cycle", severity=Severity.ERROR,
+                    path=e.site[0], line=e.site[1],
+                    message=(
+                        f"runtime lock-order cycle: {src} -> {dst} "
+                        f"observed {e.count}x on thread {e.thread!r}"
+                        f"{via} — an interleaving of these threads "
+                        "deadlocks"
+                    ),
+                ))
+        if self.index is not None:
+            static = self.index.locksmith()
+            for (src, dst), edge in sorted(static.edges.items()):
+                if (src, dst) not in observed:
+                    f0 = edge.witness[0]
+                    findings.append(Finding(
+                        rule="witness-unseen", severity=Severity.NOTE,
+                        path=f0.relpath, line=f0.line,
+                        message=(
+                            f"static lock-order edge {src} -> {dst} was "
+                            "never witnessed at runtime — the ordering "
+                            "assumption is untested by this run"
+                        ),
+                    ))
+        try:
+            from ..core.counters import SPC
+
+            SPC.record("locksmith_witness_edges", len(observed))
+            cycles = sum(1 for f in findings
+                         if f.rule == "witness-cycle")
+            if cycles:
+                SPC.record("locksmith_witness_cycles", cycles)
+        except Exception:   # commlint: allow(broadexcept)
+            pass
+        return findings
+
+
+def _reaches(adj: dict[str, set[str]], src: str, dst: str) -> bool:
+    stack, seen = [src], set()
+    while stack:
+        n = stack.pop()
+        if n == dst:
+            return True
+        if n in seen:
+            continue
+        seen.add(n)
+        stack.extend(adj.get(n, ()))
+    return False
+
+
+def witness(index=None) -> LockWitness:
+    """``with locksmith.witness(index) as w: ...; w.report()``"""
+    return LockWitness(index)
+
+
+# -- sanitizer seam ---------------------------------------------------------
+
+_ACTIVE_WITNESS: Optional[LockWitness] = None
+
+
+def witness_enable(index=None) -> LockWitness:
+    """Install the process-wide witness (the sanitizer's opt-in lock
+    mode).  Idempotent; returns the active witness.  Without an index
+    one is built over the package now — runtime lock keys must match
+    the static inventory from creation time, not from finalize."""
+    global _ACTIVE_WITNESS
+    if _ACTIVE_WITNESS is None:
+        if index is None:
+            from .index import ProjectIndex
+
+            index = ProjectIndex.build(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))
+        _ACTIVE_WITNESS = LockWitness(index).install()
+    return _ACTIVE_WITNESS
+
+
+def witness_active() -> Optional[LockWitness]:
+    return _ACTIVE_WITNESS
+
+
+def witness_finalize() -> list[Finding]:
+    """Uninstall and report — called from sanitizer.finalize_check."""
+    global _ACTIVE_WITNESS
+    w = _ACTIVE_WITNESS
+    if w is None:
+        return []
+    _ACTIVE_WITNESS = None
+    w.uninstall()
+    return w.report()

@@ -14,7 +14,7 @@ what a "request maker" or a "collective" is.
 from __future__ import annotations
 
 import ast
-from typing import Any, Iterable, Iterator, Optional
+from typing import Any, Iterable, Optional
 
 from ...core import component as mca
 from ..report import Finding, Severity
@@ -157,41 +157,68 @@ def itemsize_of(dtype: Optional[str]) -> int:
     return _ITEMSIZE.get(dtype or "", 4)
 
 
-def scopes(tree: ast.Module) -> Iterator[tuple[ast.AST, bool]]:
-    """Yield (scope_node, is_module): the module plus every function.
+def tree_walk(tree: ast.AST) -> list[ast.AST]:
+    """``ast.walk`` memoized on the node — for helpers handed a bare
+    tree rather than the FileContext (whose ``walk()`` caches too)."""
+    cached = getattr(tree, "_commlint_treewalk", None)
+    if cached is None:
+        cached = list(ast.walk(tree))
+        tree._commlint_treewalk = cached
+    return cached
+
+
+def scopes(tree: ast.Module) -> Iterable[tuple[ast.AST, bool]]:
+    """(scope_node, is_module) list: the module plus every function.
 
     A scope's statements are analyzed together; nested functions form
     their own scopes (their bodies are excluded from the enclosing
-    scope's walk by ``scope_walk``).
+    scope's walk by ``scope_walk``).  Memoized on the tree — with the
+    parse-once engine every rule shares one FileContext per file, so a
+    20-rule run pays for this traversal exactly once.
     """
-    yield tree, True
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            yield node, False
+    cached = getattr(tree, "_commlint_scopes", None)
+    if cached is None:
+        cached = [(tree, True)] + [
+            (node, False) for node in ast.walk(tree)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ]
+        tree._commlint_scopes = cached
+    return cached
 
 
-def scope_walk(scope: ast.AST) -> Iterator[ast.AST]:
+def scope_walk(scope: ast.AST) -> Iterable[ast.AST]:
     """ast.walk restricted to this scope: does not descend into nested
     function definitions (they are separate scopes), but does descend
-    into class bodies, loops, withs, and tries."""
-    stack: list[ast.AST] = list(ast.iter_child_nodes(scope))
-    while stack:
-        node = stack.pop()
-        yield node
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)):
-            continue
-        stack.extend(ast.iter_child_nodes(node))
+    into class bodies, loops, withs, and tries.  Memoized on the scope
+    node (rules hit the same scopes thousands of times per run)."""
+    cached = getattr(scope, "_commlint_walk", None)
+    if cached is None:
+        cached = []
+        stack: list[ast.AST] = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            cached.append(node)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            stack.extend(ast.iter_child_nodes(node))
+        scope._commlint_walk = cached
+    return cached
 
 
 def name_uses(scope: ast.AST, name: str) -> list[ast.Name]:
-    """Every Name node for `name` inside the scope, document order."""
-    out = [
-        n for n in scope_walk(scope)
-        if isinstance(n, ast.Name) and n.id == name
-    ]
-    out.sort(key=lambda n: (n.lineno, n.col_offset))
-    return out
+    """Every Name node for `name` inside the scope, document order.
+    The per-scope name table is built once and shared by every rule."""
+    cache = getattr(scope, "_commlint_names", None)
+    if cache is None:
+        cache = {}
+        for n in scope_walk(scope):
+            if isinstance(n, ast.Name):
+                cache.setdefault(n.id, []).append(n)
+        for uses in cache.values():
+            uses.sort(key=lambda n: (n.lineno, n.col_offset))
+        scope._commlint_names = cache
+    return cache.get(name, [])
 
 
 def literal_elems(node: Optional[ast.AST]) -> Optional[int]:
@@ -263,6 +290,7 @@ def ensure_rules() -> None:
         from . import fastpath  # noqa: F401
         from . import healthseam  # noqa: F401
         from . import lifecycle  # noqa: F401
+        from . import locking  # noqa: F401
         from . import metricname  # noqa: F401
         from . import overlapready  # noqa: F401
         from . import polling  # noqa: F401

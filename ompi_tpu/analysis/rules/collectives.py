@@ -12,12 +12,42 @@ ranks are legal.
 from __future__ import annotations
 
 import ast
+import re
 from typing import Iterable
 
 from ..report import Severity
 from . import COMMLINT, COLL_OPS, LintRule, call_name
 
-_RANK_WORDS = ("rank", "process_index", "pid", "proc_id")
+#: Identifier *words* that mark a rank-dependent value.  Matching is by
+#: word, not substring: ``nranks``/``world_size`` are sizes, the same
+#: on every rank, and must not trip the rule.
+_RANK_WORDS = frozenset({"rank", "pid"})
+#: Multi-word identifiers matched whole.
+_RANK_IDENTS = frozenset({"process_index", "proc_id"})
+#: Word-set spellings that are sizes, never a rank (``my_nranks`` etc.
+#: never exist, but ``local_rank_count`` would: ``count``/``size``/
+#: ``n``-prefixed words veto the rank reading of that identifier).
+_SIZE_WORDS = frozenset({"nranks", "size", "count", "num", "n"})
+
+_WORD_SPLIT_RE = re.compile(r"[a-z0-9]+")
+
+#: Receiver name words that look communicator-shaped — only calls like
+#: ``comm.allgather(...)`` count as collectives; ``ir.allgather(...)``
+#: builds schedule IR and ``fleet.gather(...)`` sweeps a KV store.
+_COMM_WORDS = frozenset({"comm", "communicator", "world", "self"})
+
+
+def _ident_words(ident: str) -> list[str]:
+    s = re.sub(r"([a-z0-9])([A-Z])", r"\1_\2", ident)
+    return _WORD_SPLIT_RE.findall(s.lower())
+
+
+def _is_rank_ident(ident: str) -> bool:
+    if ident.lower() in _RANK_IDENTS:
+        return True
+    words = _ident_words(ident)
+    return bool(_RANK_WORDS.intersection(words)) \
+        and not _SIZE_WORDS.intersection(words)
 
 
 def _mentions_rank(test: ast.AST) -> bool:
@@ -27,12 +57,32 @@ def _mentions_rank(test: ast.AST) -> bool:
             ident = node.id
         elif isinstance(node, ast.Attribute):
             ident = node.attr
-        if ident is None:
-            continue
-        low = ident.lower()
-        if any(w in low for w in _RANK_WORDS):
+        if ident is not None and _is_rank_ident(ident):
             return True
     return False
+
+
+def _comm_receiver(node: ast.Call) -> bool:
+    """True when the callee's receiver plausibly is a communicator:
+    a bare name (``allreduce(...)``), ``self``, or a dotted chain whose
+    terminal name reads communicator-ish (``comm``, ``self.comm``,
+    ``world_comm``).  IR builders (``ir.allgather``) and non-comm
+    objects (``fleet.gather``) stay out of the sequence."""
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return True
+    if not isinstance(fn, ast.Attribute):
+        return False
+    recv = fn.value
+    if isinstance(recv, ast.Name):
+        ident = recv.id
+    elif isinstance(recv, ast.Attribute):
+        ident = recv.attr
+    elif isinstance(recv, ast.Call):
+        ident = call_name(recv) or ""
+    else:
+        return False
+    return bool(_COMM_WORDS.intersection(_ident_words(ident)))
 
 
 def _coll_sequence(stmts: list[ast.stmt]) -> list[str]:
@@ -43,7 +93,7 @@ def _coll_sequence(stmts: list[ast.stmt]) -> list[str]:
     class V(ast.NodeVisitor):
         def visit_Call(self, node: ast.Call) -> None:
             fn = call_name(node)
-            if fn in COLL_OPS:
+            if fn in COLL_OPS and _comm_receiver(node):
                 out.append(fn)
             self.generic_visit(node)
 
@@ -70,7 +120,7 @@ class CollectiveDivergenceRule(LintRule):
     SEVERITY = Severity.ERROR
 
     def check(self, ctx) -> Iterable:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.If):
                 continue
             if not _mentions_rank(node.test):

@@ -105,7 +105,7 @@ class DeviceSemRule(LintRule):
     def check(self, ctx) -> Iterable:
         if not ctx.relpath.startswith("coll/"):
             return
-        makers = [n for n in ast.walk(ctx.tree)
+        makers = [n for n in ctx.walk()
                   if isinstance(n, ast.Call) and call_name(n) == _MAKER]
         if not makers:
             return
@@ -117,7 +117,7 @@ class DeviceSemRule(LintRule):
                     isinstance(a, ast.Attribute) and a.attr == "DMA"
                     for a in ast.walk(k.value))
                 for k in n.keywords)
-            for n in ast.walk(ctx.tree))
+            for n in ctx.walk())
         if not has_dma_scratch:
             first = makers[0]
             if not ctx.suppressed(first.lineno, self.NAME):

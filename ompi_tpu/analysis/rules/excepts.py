@@ -53,7 +53,7 @@ class BroadExceptRule(LintRule):
     SEVERITY = Severity.WARNING
 
     def check(self, ctx) -> Iterable:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.ExceptHandler):
                 continue
             if not _is_broad(node):

@@ -53,7 +53,7 @@ class FastPathSleepRule(LintRule):
     def check(self, ctx) -> Iterable:
         if not _on_fast_path(ctx.relpath):
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not _is_time_sleep(node):
                 continue
             val = _sleep_const(node)

@@ -32,7 +32,7 @@ import ast
 from typing import Iterable
 
 from ..report import Severity
-from . import COMMLINT, LintRule, call_name
+from . import COMMLINT, LintRule, call_name, tree_walk
 
 #: Directories whose registered components the rule audits.
 _SEAM_DIRS = ("btl/", "pml/")
@@ -59,7 +59,7 @@ def _in_scope(relpath: str) -> bool:
 
 def _registered_transport_classes(tree: ast.Module) -> list[ast.ClassDef]:
     out = []
-    for node in ast.walk(tree):
+    for node in tree_walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue
         for dec in node.decorator_list:
@@ -74,7 +74,7 @@ def _registered_transport_classes(tree: ast.Module) -> list[ast.ClassDef]:
 
 
 def _has_probe_evidence(tree: ast.Module) -> bool:
-    return any(call_name(n) in _PROBE_CALLS for n in ast.walk(tree))
+    return any(call_name(n) in _PROBE_CALLS for n in tree_walk(tree))
 
 
 @COMMLINT.register

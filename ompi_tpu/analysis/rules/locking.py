@@ -1,0 +1,68 @@
+"""Concurrency rules — the locksmith engine surfaced through commlint.
+
+These three rules are whole-program: they read the locksmith analysis
+(analysis/locksmith.py) computed once over the shared ProjectIndex and
+report each finding in the file it anchors to, so suppressions and the
+per-``rule:file`` ratchet baseline work exactly like every per-file
+rule.  A bare ``lint_source`` snippet gets a one-file index — the
+rules still fire on self-contained fixtures (a two-lock cycle inside
+one module) but cross-module findings need the tree run.
+
+- ``lockorder`` (ERROR): a cycle in the lock-order graph — two threads
+  entering from opposite ends deadlock.  The message carries the full
+  ``file:line`` acquire/call witness chain of every edge.
+- ``cbunderlock`` (WARNING): a passed-in callable or registered
+  callback invoked while a lock is held (the PR 8 ledger class); queue
+  under the lock, fire after release.
+- ``unguardedwrite`` (WARNING): an attribute written under its class
+  lock at some sites and outside any lock at others (the PR 15
+  ``_tiles_reduced`` lost-combine class), with the thread-spawn
+  inventory naming which threads race.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+from ..report import Finding, Severity
+from . import COMMLINT, LintRule
+
+
+class _LocksmithRule(LintRule):
+    """Shared plumbing: pull this file's findings out of the cached
+    whole-program analysis."""
+
+    def check(self, ctx) -> Iterable[Finding]:
+        if ctx.index is None:
+            return
+        analysis = ctx.index.locksmith()
+        for f in analysis.findings_for(ctx.relpath, self.NAME):
+            if not ctx.suppressed(f.line, self.NAME):
+                yield f
+
+
+@COMMLINT.register
+class LockOrderRule(_LocksmithRule):
+    NAME = "lockorder"
+    PRIORITY = 90
+    SEVERITY = Severity.ERROR
+    DESCRIPTION = ("lock-order cycles across the whole program — "
+                   "potential deadlocks with acquire witness chains")
+
+
+@COMMLINT.register
+class CallbackUnderLockRule(_LocksmithRule):
+    NAME = "cbunderlock"
+    PRIORITY = 60
+    SEVERITY = Severity.WARNING
+    DESCRIPTION = ("callbacks/passed-in callables invoked while "
+                   "holding a lock — defer past release")
+
+
+@COMMLINT.register
+class UnguardedWriteRule(_LocksmithRule):
+    NAME = "unguardedwrite"
+    PRIORITY = 60
+    SEVERITY = Severity.WARNING
+    DESCRIPTION = ("attributes written both under a class lock and "
+                   "outside any lock — cross-thread data races")

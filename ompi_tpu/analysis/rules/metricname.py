@@ -41,7 +41,7 @@ _SPC_METHODS = frozenset({
 KNOWN_PREFIXES = frozenset({
     "btl", "coll", "convertor", "daemon", "dcn", "fabric", "faultline",
     "fp",
-    "ft", "health", "hier", "init", "io", "memchecker", "monitoring",
+    "ft", "health", "hier", "init", "io", "locksmith", "memchecker", "monitoring",
     "mpit", "mtl", "nbc", "op", "osc", "parallel", "part", "pml",
     "pmpi", "quant", "sanitizer", "sched", "shmem", "sim", "sm",
     "telemetry", "topo", "trace", "vprotocol",
@@ -90,7 +90,7 @@ class MetricNameRule(LintRule):
     SEVERITY = Severity.WARNING
 
     def check(self, ctx) -> Iterable:
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func

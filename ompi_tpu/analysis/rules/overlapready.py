@@ -91,7 +91,7 @@ class OverlapReadyRule(LintRule):
         rel = ctx.relpath.replace("\\", "/")
         if "parallel/" not in rel and "models/" not in rel:
             return
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.walk():
             if not isinstance(fn, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
                 continue

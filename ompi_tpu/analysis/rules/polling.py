@@ -74,7 +74,7 @@ class PollDeadlineRule(LintRule):
 
     def check(self, ctx) -> Iterable:
         flagged: set[int] = set()
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.While):
                 continue
             if _has_evidence(node):
@@ -98,7 +98,7 @@ class PollDeadlineRule(LintRule):
                     "time.monotonic() deadline",
                     severity=Severity.ERROR,
                 )
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not _is_time_sleep(node):
                 continue
             val = _sleep_const(node)

@@ -73,13 +73,18 @@ def _classify_uses(scope: ast.AST, name: str, assign: ast.Assign):
 
 
 def _parent_map(scope: ast.AST) -> dict[ast.AST, ast.AST]:
-    parents: dict[ast.AST, ast.AST] = {}
-    for node in scope_walk(scope):
-        for child in ast.iter_child_nodes(node):
-            parents[child] = node
-    for child in ast.iter_child_nodes(scope):
-        parents.setdefault(child, scope)
-    return parents
+    """child -> parent within the scope, memoized on the scope node
+    (three rules ask for it; the shared FileContext makes one pay)."""
+    cached = getattr(scope, "_commlint_parents", None)
+    if cached is None:
+        cached = {}
+        for node in scope_walk(scope):
+            for child in ast.iter_child_nodes(node):
+                cached[child] = node
+        for child in ast.iter_child_nodes(scope):
+            cached.setdefault(child, scope)
+        scope._commlint_parents = cached
+    return cached
 
 
 def _request_bindings(scope: ast.AST):

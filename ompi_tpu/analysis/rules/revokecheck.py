@@ -110,7 +110,7 @@ class RevokeCheckRule(LintRule):
         rel = ctx.relpath.replace("\\", "/")
         if "coll/" not in rel and "pml/" not in rel:
             return
-        for loop in ast.walk(ctx.tree):
+        for loop in ctx.walk():
             if not isinstance(loop, ast.While):
                 continue
             if not _is_retry_loop(loop):

@@ -93,7 +93,7 @@ class SchedCutoffRule(LintRule):
     def check(self, ctx) -> Iterable:
         if not _in_coll(ctx.relpath):
             return
-        for fn in ast.walk(ctx.tree):
+        for fn in ctx.walk():
             if not isinstance(fn, (ast.FunctionDef,
                                    ast.AsyncFunctionDef)):
                 continue

@@ -74,7 +74,7 @@ class SimClockRule(LintRule):
     def check(self, ctx) -> Iterable:
         if not _in_scope(ctx.relpath):
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             attr = _banned_time_call(node)
             if attr is None:
                 continue

@@ -76,7 +76,7 @@ class StepBarrierRule(LintRule):
         if "parallel/" not in rel:
             return
         scopes = [ctx.tree] + [
-            n for n in ast.walk(ctx.tree)
+            n for n in ctx.walk()
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         for scope in scopes:

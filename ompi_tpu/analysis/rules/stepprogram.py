@@ -80,14 +80,14 @@ class StepProgramRule(LintRule):
         # evidence scope: the enclosing function (or the module for
         # top-level loops)
         scopes = [ctx.tree] + [
-            n for n in ast.walk(ctx.tree)
+            n for n in ctx.walk()
             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
         ]
         owner: dict = {}
         for scope in scopes:
             for node in _scope_walk(scope):
                 owner[id(node)] = scope
-        for loop in ast.walk(ctx.tree):
+        for loop in ctx.walk():
             if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
                 continue
             builds = [

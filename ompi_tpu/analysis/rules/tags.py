@@ -65,7 +65,7 @@ class PartTagCollisionRule(LintRule):
         stride = _tag_stride()
         part_tags: list[int] = []
         plain: list[tuple[ast.Call, str, int]] = []
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             fn = call_name(node)

@@ -79,7 +79,7 @@ class TenantScopeRule(LintRule):
         parts = ctx.relpath.replace("\\", "/").split("/")
         if "daemon" not in parts:
             return
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, ast.Call):
                 continue
             fn = node.func

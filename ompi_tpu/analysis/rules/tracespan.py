@@ -26,7 +26,7 @@ import ast
 from typing import Iterable
 
 from ..report import Severity
-from . import COLL_BASE_OPS, COMMLINT, LintRule, call_name, scope_walk
+from . import COLL_BASE_OPS, COMMLINT, LintRule, call_name, scope_walk, tree_walk
 
 #: Entry-op names whose public implementations belong on the timeline.
 _ENTRY_OPS = frozenset(
@@ -58,7 +58,7 @@ def _registered_classes(tree: ast.Module) -> set[ast.ClassDef]:
     component's vtable)."""
     by_name: dict[str, ast.ClassDef] = {}
     registered: set[ast.ClassDef] = set()
-    for node in ast.walk(tree):
+    for node in tree_walk(tree):
         if not isinstance(node, ast.ClassDef):
             continue
         by_name[node.name] = node
@@ -106,7 +106,7 @@ class TraceSpanRule(LintRule):
         covered: set[ast.AST] = set()
         for cls in registered:
             covered.update(ast.walk(cls))
-        for node in ast.walk(ctx.tree):
+        for node in ctx.walk():
             if not isinstance(node, (ast.FunctionDef,
                                      ast.AsyncFunctionDef)):
                 continue

@@ -63,6 +63,13 @@ _max_events = config.register(
     description="Collective-sequence events kept verbatim (the CRC "
                 "chain keeps matching past the cap)",
 )
+_lockwitness = config.register(
+    "sanitizer", "base", "lockwitness", type=bool, default=False,
+    description="Interpose inventoried threading locks (locksmith "
+                "witness): record runtime acquisition-order edges; "
+                "finalize reports runtime cycles and static lock-order "
+                "edges never witnessed",
+)
 
 _PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -349,9 +356,14 @@ def enable() -> Tracker:
 
 
 def maybe_enable() -> None:
-    """init()-time hook: honor the sanitizer_base_enable cvar."""
+    """init()-time hook: honor the sanitizer_base_enable and
+    sanitizer_base_lockwitness cvars."""
     if _enable.value and not active():
         enable()
+    if _lockwitness.value:
+        from . import locksmith
+
+        locksmith.witness_enable()
 
 
 def record_coll(comm, opname: str) -> None:
@@ -366,22 +378,34 @@ def finalize_check() -> Optional[BaseException]:
     clean."""
     global _TRACKER
     t = _TRACKER
-    if t is None:
-        return None
-    _TRACKER = None
-    _request.set_tracker(None)
-    from ..part import framework as part_fw
-    from ..pml import framework as pml_fw
+    from . import locksmith
 
-    pml_fw.reset_selection()
-    part_fw.reset_selection()
-    rep = t.report()
+    wit_findings = locksmith.witness_finalize()
+    if t is None and not wit_findings:
+        return None
+    if t is not None:
+        _TRACKER = None
+        _request.set_tracker(None)
+        from ..part import framework as part_fw
+        from ..pml import framework as pml_fw
+
+        pml_fw.reset_selection()
+        part_fw.reset_selection()
+        rep = t.report()
+    else:
+        rep = Report([])
+    if wit_findings:
+        rep = Report(list(rep.findings) + wit_findings)
     if not len(rep):
         logger.info("sanitizer: clean at finalize")
         return None
     SPC.record("sanitizer_findings", len(rep))
     show_help("sanitizer report", "%s", rep.render(), once=False)
     if not _fatal.value:
+        return None
+    if rep.max_severity() < Severity.WARNING:
+        # witness-unseen notes (static edges this run never exercised)
+        # are coverage information, not defects
         return None
     leaks = rep.by_rule("san-leak")
     if leaks:

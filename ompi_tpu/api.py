@@ -100,10 +100,14 @@ def init(
         except Exception:  # commlint: allow(broadexcept)
             _fleet_n = 1
         _telemetry.at_init(fleet_size=_fleet_n)
-        from .hook import run_hooks
+    # at_init_bottom fires after _lock is released: _state is already
+    # committed, and a hook calling back into init()/finalize() must
+    # not deadlock on the non-reentrant module lock (the ledger
+    # callback-under-lock class locksmith flags).
+    from .hook import run_hooks
 
-        run_hooks("at_init_bottom", comm_world)
-        return comm_world
+    run_hooks("at_init_bottom", comm_world)
+    return comm_world
 
 
 def initialized() -> bool:
@@ -125,7 +129,10 @@ def finalize() -> None:
         from .communicator import live_comms
         from .hook import run_hooks
 
-        run_hooks("at_finalize_top", _state.comm_world)
+        # at_finalize_top must observe live state strictly before any
+        # teardown and before a racing second finalize() can proceed;
+        # hooks are documented to not re-enter init/finalize.
+        run_hooks("at_finalize_top", _state.comm_world)  # commlint: allow(cbunderlock)
         from .analysis import sanitizer as _sanitizer
 
         san_err = _sanitizer.finalize_check()

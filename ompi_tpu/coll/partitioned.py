@@ -308,10 +308,14 @@ class PartitionedAllreduce:
         start_all(list(self._sreqs.values()) + list(self._rreqs.values()))
         self._active = True
         self._acc = np.zeros(self.tiles * self.tile_elems, np.float64)
-        self._have = [0] * self.tiles
+        # epoch resets are lock-free on purpose: start() happens-before
+        # every pready/_combine of this epoch (MPI partitioned
+        # semantics — no partition may be marked ready before start
+        # returns), so no combiner thread can race these writes
+        self._have = [0] * self.tiles  # commlint: allow(unguardedwrite)
         self._ready = [False] * self.tiles
         self._integrated = {r: [False] * self.tiles for r in self._peers}
-        self._tiles_reduced = 0
+        self._tiles_reduced = 0  # commlint: allow(unguardedwrite)
         self._reduce_done = False
         self._result = None
         self._local = None

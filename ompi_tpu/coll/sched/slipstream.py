@@ -127,9 +127,7 @@ def compile_window(nranks: int, buckets: Sequence, *,
                 name=f"{prefix}.{nd.name}", schedule=nd.schedule,
                 deps=tuple(f"{prefix}.{d}" for d in nd.deps),
                 deadline=nd.deadline))
-        if prefix == "s0" and nranks >= 2:  # commlint: allow(colldiv)
-            # ir.allgather only *builds* the tail Schedule here; no
-            # rank communicates inside this controller-side branch.
+        if prefix == "s0" and nranks >= 2:
             tail_deps = tuple(
                 f"s0.{_terminal_name(nd)}" for nd in step.nodes
                 if nd.choice != "rs_resident")

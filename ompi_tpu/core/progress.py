@@ -208,7 +208,9 @@ class ProgressEngine:
                 # someone else is pumping: sleep until a completion
                 # fires (bounded so a missed wakeup degrades to a tick)
                 with self._wait_cv:
-                    if not predicate():
+                    # condition-variable contract: the predicate is
+                    # evaluated under the cv lock by design
+                    if not predicate():  # commlint: allow(cbunderlock)
                         self._wait_cv.wait(timeout=0.002)
                 if predicate():
                     return True

@@ -162,7 +162,9 @@ class File(HasErrhandler):
             else range(self.comm.size)
         for r in ranks:
             self._views[r] = view
-            self._pointers[r] = 0
+            # set_view is collective with no I/O in flight (MPI-IO
+            # contract) — pointer resets cannot race reads/writes
+            self._pointers[r] = 0  # commlint: allow(unguardedwrite)
         self.sharedfp.seek(self._sfp_state, 0)
 
     def set_views(self, views: Sequence[FileView]) -> None:

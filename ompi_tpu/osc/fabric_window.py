@@ -178,7 +178,10 @@ class FabricWindow:
             mod = self._winseg.load(0)
             if self._mirror_dirty or mod != self._seen_mod:
                 self._inner._set_array(self._mirror)
-                self._mirror_dirty = False
+                # epoch ordering, not the segment lock, guards this:
+                # remote writers only flip the flag inside an exposure
+                # epoch, and .array reads outside one
+                self._mirror_dirty = False  # commlint: allow(unguardedwrite)
                 self._seen_mod = mod
         return self._inner.array
 

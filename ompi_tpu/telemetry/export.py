@@ -158,6 +158,12 @@ GUARANTEED_COUNTERS = (
     ("sched_tail_overlap_ms",
      "broadcast-tail milliseconds hidden under the next step's "
      "backward by the slipstream window"),
+    ("locksmith_witness_edges",
+     "distinct lock acquisition-order edges observed by the runtime "
+     "lock witness"),
+    ("locksmith_witness_cycles",
+     "runtime lock-order cycles (deadlock interleavings actually "
+     "observed) reported by the lock witness"),
 )
 
 

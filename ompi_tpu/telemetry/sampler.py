@@ -263,7 +263,7 @@ class Sampler:
                 SPC.record("telemetry_publish_errors")
             # fleet.gather is a modex KV sweep (non-collective, pure
             # polling), not a comm collective — rank gating is the point
-            if rank == 0 and self.fleet_size and self.fleet_size > 1:  # commlint: allow(colldiv)
+            if rank == 0 and self.fleet_size and self.fleet_size > 1:
                 try:
                     snaps = fleet.gather(self.fleet_size)
                     straggler.analyze(snaps)

@@ -5,7 +5,13 @@ Usage:
     python -m ompi_tpu.tools.lint ompi_tpu --baseline \\
         ompi_tpu/analysis/selfcheck_baseline.json
     python -m ompi_tpu.tools.lint ompi_tpu --write-baseline
+    python -m ompi_tpu.tools.lint --changed
     python -m ompi_tpu.tools.lint --rules
+
+``--changed`` scopes the run to .py files the git worktree touches
+(diff vs HEAD plus untracked) — the fast pre-commit/CI path.  Note the
+whole-program rules see only the changed files in this mode; the tree
+run remains the authoritative self-check.
 
 Exit codes: 0 clean (or within baseline), 1 findings at error severity /
 baseline regressions, 2 the run itself failed (unreadable files,
@@ -22,6 +28,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import subprocess
 import sys
 
 from ..analysis.lint import Linter
@@ -31,6 +38,35 @@ DEFAULT_BASELINE = os.path.join(
     os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
     "analysis", "selfcheck_baseline.json",
 )
+
+
+def changed_py_files(cwd: str | None = None) -> list[str]:
+    """Worktree-changed .py files: ``git diff --name-only HEAD`` plus
+    untracked, repo-root-relative and deduplicated.  Raises
+    RuntimeError outside a git checkout."""
+    def run(*args: str) -> list[str]:
+        proc = subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True,
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(args)} failed: "
+                f"{proc.stderr.strip() or proc.returncode}"
+            )
+        return [ln for ln in proc.stdout.splitlines() if ln.strip()]
+
+    root = run("rev-parse", "--show-toplevel")[0]
+    names = run("diff", "--name-only", "HEAD") \
+        + run("ls-files", "--others", "--exclude-standard")
+    out, seen = [], set()
+    for name in names:
+        if not name.endswith(".py") or name in seen:
+            continue
+        seen.add(name)
+        path = os.path.join(root, name)
+        if os.path.exists(path):   # deleted files have nothing to lint
+            out.append(path)
+    return sorted(out)
 
 
 def _list_rules() -> str:
@@ -63,6 +99,9 @@ def main(argv: list[str] | None = None) -> int:
     ap.add_argument("--write-baseline", action="store_true",
                     help="regenerate the ratchet from this run "
                          "(default target: the self-check baseline)")
+    ap.add_argument("--changed", action="store_true",
+                    help="lint only .py files changed in the git "
+                         "worktree (diff vs HEAD + untracked)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="machine-readable findings")
     ap.add_argument("--rules", action="store_true",
@@ -72,8 +111,19 @@ def main(argv: list[str] | None = None) -> int:
     if args.rules:
         print(_list_rules())
         return 0
+    if args.changed:
+        if args.paths:
+            ap.error("--changed takes no explicit paths")
+        try:
+            args.paths = changed_py_files()
+        except RuntimeError as exc:
+            print(f"commlint: --changed: {exc}", file=sys.stderr)
+            return 2
+        if not args.paths:
+            print("commlint: no changed .py files")
+            return 0
     if not args.paths:
-        ap.error("no paths given (or use --rules)")
+        ap.error("no paths given (or use --rules / --changed)")
 
     base = args.base
     if base is None:

@@ -1,0 +1,157 @@
+"""locksmith CLI — inspect the whole-program lock model.
+
+Usage:
+    python -m ompi_tpu.tools.locks [<root>]            # summary tables
+    python -m ompi_tpu.tools.locks --graph             # order edges
+    python -m ompi_tpu.tools.locks --dot > locks.dot   # GraphViz export
+    python -m ompi_tpu.tools.locks --json              # machine-readable
+
+The default root is the ompi_tpu package itself.  Output sections:
+
+- **inventory**: every ``threading.Lock/RLock/Condition`` bound to a
+  module global or ``self.`` attribute, with creation site and owner
+  (a ``Condition(self._mu)`` shows as an alias of the underlying
+  lock);
+- **threads**: every ``threading.Thread(target=...)`` spawn site with
+  the resolved target;
+- **holders/waiters**: per lock, which functions acquire it directly,
+  and which order edges *wait* on it while holding something else;
+- **graph/cycles**: the lock-order edges with their witness chains;
+  cycles (potential deadlocks) render with the full chain and exit 1.
+
+Exit codes: 0 clean, 1 lock-order cycles found, 2 run failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_ROOT = os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))
+
+
+def _analysis(root: str):
+    from ..analysis.index import ProjectIndex
+
+    index = ProjectIndex.build(root)
+    return index, index.locksmith()
+
+
+def _render_inventory(index) -> list[str]:
+    lines = [f"lock inventory ({len(index.locks)}):"]
+    for key in sorted(index.locks):
+        li = index.locks[key]
+        alias = f" (alias of {li.alias_of})" if li.alias_of else ""
+        lines.append(f"  {li.kind:<10} {key}  "
+                     f"[{li.relpath}:{li.line}]{alias}")
+    lines.append(f"thread spawns ({len(index.threads)}):")
+    for t in index.threads:
+        lines.append(f"  {t.relpath}:{t.line}  target="
+                     f"{t.target or '<unresolved>'} ({t.target_text})")
+    return lines
+
+
+def _render_holders(an) -> list[str]:
+    lines = ["holders (functions acquiring each lock directly):"]
+    for lock, fns in an.holders().items():
+        lines.append(f"  {lock}:")
+        for fn in fns:
+            lines.append(f"    {fn}")
+    waiters = an.waiters()
+    lines.append("waiters (acquired while another lock is held):")
+    if not waiters:
+        lines.append("  (none)")
+    for lock, edges in waiters.items():
+        lines.append(f"  {lock}:")
+        for e in edges:
+            lines.append(f"    while holding {e.src}  "
+                         f"[{e.witness[0].render()}]")
+    return lines
+
+
+def _render_graph(an) -> list[str]:
+    lines = [f"lock-order edges ({len(an.edges)}):"]
+    for key in sorted(an.edges):
+        lines.append(f"  {an.edges[key].render()}")
+    if an.cycles:
+        lines.append(f"CYCLES ({len(an.cycles)}) — potential deadlocks:")
+        for cyc in an.cycles:
+            locks = [e.src for e in cyc] + [cyc[0].src]
+            lines.append(f"  {' -> '.join(locks)}")
+            for e in cyc:
+                lines.append(f"    {e.render()}")
+    else:
+        lines.append("no cycles")
+    return lines
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m ompi_tpu.tools.locks",
+        description="whole-program lock inventory, order graph, and "
+                    "deadlock-cycle report",
+    )
+    ap.add_argument("root", nargs="?", default=DEFAULT_ROOT,
+                    help="package directory to analyze "
+                         "(default: the ompi_tpu package)")
+    ap.add_argument("--graph", action="store_true",
+                    help="order edges + cycles only")
+    ap.add_argument("--dot", action="store_true",
+                    help="GraphViz digraph on stdout")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable dump")
+    args = ap.parse_args(argv)
+
+    try:
+        index, an = _analysis(args.root)
+    except (OSError, ValueError) as exc:
+        print(f"locks: {exc}", file=sys.stderr)
+        return 2
+
+    if args.dot:
+        print(an.to_dot())
+        return 1 if an.cycles else 0
+
+    if args.as_json:
+        print(json.dumps({
+            "locks": {
+                k: {"kind": li.kind, "site": f"{li.relpath}:{li.line}",
+                    "owner": li.owner, "alias_of": li.alias_of}
+                for k, li in sorted(index.locks.items())
+            },
+            "threads": [
+                {"site": f"{t.relpath}:{t.line}", "target": t.target,
+                 "target_text": t.target_text}
+                for t in index.threads
+            ],
+            "edges": [
+                {"src": e.src, "dst": e.dst,
+                 "witness": [f.render() for f in e.witness]}
+                for _, e in sorted(an.edges.items())
+            ],
+            "cycles": [
+                [{"src": e.src, "dst": e.dst} for e in cyc]
+                for cyc in an.cycles
+            ],
+            "findings": [
+                {"rule": f.rule, "severity": f.severity.name,
+                 "where": f"{f.path}:{f.line}", "message": f.message}
+                for f in an.findings
+            ],
+        }, indent=2))
+        return 1 if an.cycles else 0
+
+    lines: list[str] = []
+    if not args.graph:
+        lines += _render_inventory(index)
+        lines += _render_holders(an)
+    lines += _render_graph(an)
+    print("\n".join(lines))
+    return 1 if an.cycles else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

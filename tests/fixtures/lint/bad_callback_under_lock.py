@@ -1,0 +1,16 @@
+"""Seeded defect: user-supplied callback invoked while a lock is held
+(the cbunderlock rule's target class — a callback that re-enters the
+owning object deadlocks on a non-reentrant lock)."""
+
+import threading
+
+
+class Notifier:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._last = None
+
+    def fire(self, cb, event):
+        with self._mu:
+            self._last = event
+            cb(event)

@@ -1,0 +1,19 @@
+"""Seeded defect: AB/BA lock-order inversion — a deadlock waiting for
+the right interleaving (the lockorder rule's target class)."""
+
+import threading
+
+_mu_a = threading.Lock()
+_mu_b = threading.Lock()
+
+
+def forward(x):
+    with _mu_a:
+        with _mu_b:
+            return x + 1
+
+
+def backward(x):
+    with _mu_b:
+        with _mu_a:
+            return x - 1

@@ -1,0 +1,23 @@
+"""Seeded defect: attribute written under its class's lock on one path
+and with no lock at all on another, while a spawned thread races the
+guarded path (the unguardedwrite rule's target class)."""
+
+import threading
+
+
+class TileCounter:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._tiles_done = 0
+
+    def worker_tick(self):
+        with self._mu:
+            self._tiles_done += 1
+
+    def reset(self):
+        self._tiles_done = 0
+
+    def start(self):
+        t = threading.Thread(target=self.worker_tick)
+        t.start()
+        return t

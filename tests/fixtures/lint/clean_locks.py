@@ -1,0 +1,33 @@
+"""Clean: consistent lock order everywhere, every shared-attribute
+write under the class's own lock — nothing for the locking rules."""
+
+import threading
+
+_mu_outer = threading.Lock()
+_mu_inner = threading.Lock()
+
+
+class Guarded:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self._count = 0
+
+    def bump(self):
+        with self._mu:
+            self._count += 1
+
+    def snapshot(self):
+        with self._mu:
+            return self._count
+
+
+def nested(x):
+    with _mu_outer:
+        with _mu_inner:
+            return x + 1
+
+
+def also_nested(x):
+    with _mu_outer:
+        with _mu_inner:
+            return x + 2

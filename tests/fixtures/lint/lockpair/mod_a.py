@@ -1,0 +1,19 @@
+"""Lock-A half of a cross-module AB/BA deadlock (pairs with mod_b):
+this module holds lock_a while calling into mod_b, which acquires
+lock_b; mod_b does the reverse."""
+
+import threading
+
+import mod_b
+
+lock_a = threading.Lock()
+
+
+def grab_a():
+    with lock_a:
+        return 1
+
+
+def a_then_b():
+    with lock_a:
+        return mod_b.grab_b()

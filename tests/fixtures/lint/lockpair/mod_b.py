@@ -1,0 +1,17 @@
+"""Lock-B half of a cross-module AB/BA deadlock (pairs with mod_a)."""
+
+import threading
+
+import mod_a
+
+lock_b = threading.Lock()
+
+
+def grab_b():
+    with lock_b:
+        return 2
+
+
+def b_then_a():
+    with lock_b:
+        return mod_a.grab_a()

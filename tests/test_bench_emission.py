@@ -275,6 +275,7 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -354,6 +355,7 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._telemetry_overhead_row = lambda: {"stub": True}
@@ -422,6 +424,7 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -501,6 +504,7 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -568,6 +572,7 @@ def test_daemon_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -691,6 +696,7 @@ def test_pallas_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -776,6 +782,7 @@ def test_overlap_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -863,6 +870,7 @@ def test_step_program_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -964,6 +972,7 @@ def test_fleet_sim_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}
@@ -1065,6 +1074,7 @@ def test_step_pipeline_rows_emit_schema_complete_on_probe_fail():
         bench._quant_sweep_row = lambda: {"stub": True}
         bench._bucket_fusion_row = lambda: {"stub": True}
         bench._commlint_row = lambda: {"stub": True}
+        bench._locksmith_row = lambda: {"stub": True}
         bench._degraded_allreduce_row = lambda: {"stub": True}
         bench._fault_drill_row = lambda: {"stub": True}
         bench._trace_overhead_row = lambda: {"stub": True}

@@ -3,6 +3,7 @@ self-lint ratchet, and the CLI."""
 
 import json
 import os
+import shutil
 import subprocess
 import sys
 
@@ -17,25 +18,31 @@ REPO = os.path.dirname(HERE)
 PKG = os.path.join(REPO, "ompi_tpu")
 BASELINE = os.path.join(PKG, "analysis", "selfcheck_baseline.json")
 
-#: Each seeded-defect fixture must be flagged by exactly this rule.
+#: Each seeded-defect fixture must be flagged by exactly this rule at
+#: exactly this severity (the locking rules grade advisory classes as
+#: WARNING; the rest are hard errors).
 EXPECTED = {
-    "bad_unwaited_request.py": "reqlife",
-    "bad_branch_divergent.py": "colldiv",
-    "bad_part_tag_collision.py": "parttags",
-    "bad_quant_int8.py": "quantuse",
-    "bad_use_after_free.py": "useafterfree",
-    "bad_silent_except.py": "broadexcept",
-    "bad_pready_missing.py": "partready",
+    "bad_unwaited_request.py": ("reqlife", Severity.ERROR),
+    "bad_branch_divergent.py": ("colldiv", Severity.ERROR),
+    "bad_part_tag_collision.py": ("parttags", Severity.ERROR),
+    "bad_quant_int8.py": ("quantuse", Severity.ERROR),
+    "bad_use_after_free.py": ("useafterfree", Severity.ERROR),
+    "bad_silent_except.py": ("broadexcept", Severity.ERROR),
+    "bad_pready_missing.py": ("partready", Severity.ERROR),
+    "bad_lock_cycle.py": ("lockorder", Severity.ERROR),
+    "bad_callback_under_lock.py": ("cbunderlock", Severity.WARNING),
+    "bad_unguarded_write.py": ("unguardedwrite", Severity.WARNING),
 }
 
 
-@pytest.mark.parametrize("fname,rule", sorted(EXPECTED.items()))
-def test_seeded_fixture_flagged_by_intended_rule(fname, rule):
+@pytest.mark.parametrize("fname,rule,severity", sorted(
+    (k, v[0], v[1]) for k, v in EXPECTED.items()))
+def test_seeded_fixture_flagged_by_intended_rule(fname, rule, severity):
     lin = Linter(base=FIXTURES)
     rep = lin.lint_paths([os.path.join(FIXTURES, fname)])
     assert not lin.errors, lin.errors
     assert {f.rule for f in rep} == {rule}, rep.render()
-    assert rep.max_severity() is Severity.ERROR
+    assert rep.max_severity() is severity
 
 
 def test_clean_fixtures_quiet():
@@ -164,5 +171,109 @@ def test_cli_lists_rules():
     res = _run_cli("--rules")
     assert res.returncode == 0
     for rule in ("reqlife", "partready", "parttags", "colldiv",
-                 "quantuse", "useafterfree", "broadexcept"):
+                 "quantuse", "useafterfree", "broadexcept",
+                 "lockorder", "cbunderlock", "unguardedwrite"):
         assert rule in res.stdout
+
+
+# -- colldiv word-boundary matching (the substring-trap regression) --------
+
+def test_colldiv_rank_words_match_on_word_boundaries():
+    lin = Linter(select="colldiv")
+    # "nranks" contains the substring "rank" but is a size, not an
+    # identity — branching on it is uniform across the fleet.
+    quiet = (
+        "def f(comm, x, nranks):\n"
+        "    if nranks > 2:\n"
+        "        comm.allreduce(x)\n"
+        "        comm.allreduce(x)\n"
+    )
+    assert lin.lint_source(quiet) == []
+    # a real per-rank identity still flags
+    loud = (
+        "def f(comm, x, rank):\n"
+        "    if rank == 0:\n"
+        "        comm.allreduce(x)\n"
+        "    comm.barrier()\n"
+    )
+    assert [f.rule for f in lin.lint_source(loud)] == ["colldiv"]
+
+
+def test_colldiv_counts_only_comm_like_receivers():
+    lin = Linter(select="colldiv")
+    # fleet.gather() is a helper method, not a collective on a
+    # communicator — must not count toward the divergence check.
+    src = (
+        "def f(fleet, rank, x):\n"
+        "    if rank == 0:\n"
+        "        fleet.gather(x)\n"
+    )
+    assert lin.lint_source(src) == []
+
+
+# -- --changed (git-scoped) mode -------------------------------------------
+
+@pytest.mark.skipif(shutil.which("git") is None, reason="git missing")
+def test_cli_changed_scopes_to_worktree_diff(tmp_path):
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep
+           + os.environ.get("PYTHONPATH", ""),
+           "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+
+    def git(*args):
+        subprocess.run(["git", *args], cwd=tmp_path, check=True,
+                       capture_output=True, env=env)
+
+    git("init", "-q")
+    committed = tmp_path / "committed.py"
+    committed.write_text("def f(comm, x):\n    comm.isend(x, 1)\n")
+    git("add", "committed.py")
+    git("commit", "-qm", "seed")
+
+    def run_changed():
+        return subprocess.run(
+            [sys.executable, "-m", "ompi_tpu.tools.lint",
+             "--changed", "--json"],
+            capture_output=True, text=True, cwd=tmp_path, timeout=180,
+            env=env,
+        )
+
+    # clean worktree: nothing to lint, rc 0
+    res = run_changed()
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "no changed .py files" in res.stdout
+
+    # an untracked defect file enters the scope; the committed (also
+    # defective) file stays out of it
+    bad = tmp_path / "fresh.py"
+    bad.write_text("def g(comm, x):\n    comm.isend(x, 2)\n")
+    res = run_changed()
+    assert res.returncode == 1, res.stdout + res.stderr
+    payload = json.loads(res.stdout)
+    assert {f["path"] for f in payload["findings"]} == {"fresh.py"}
+
+    # explicit paths alongside --changed is a usage error
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.lint", "--changed",
+         "fresh.py"],
+        capture_output=True, text=True, cwd=tmp_path, timeout=180,
+        env=env,
+    )
+    assert res.returncode == 2
+
+
+@pytest.mark.skipif(shutil.which("git") is None, reason="git missing")
+def test_cli_changed_outside_git_is_run_failure(tmp_path):
+    sub = tmp_path / "notrepo"
+    sub.mkdir()
+    res = subprocess.run(
+        [sys.executable, "-m", "ompi_tpu.tools.lint", "--changed"],
+        capture_output=True, text=True, cwd=sub, timeout=180,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": REPO + os.pathsep
+             + os.environ.get("PYTHONPATH", ""),
+             "GIT_CEILING_DIRECTORIES": str(tmp_path)},
+    )
+    assert res.returncode == 2, res.stdout + res.stderr
+    assert "--changed" in res.stderr
