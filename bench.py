@@ -2931,6 +2931,145 @@ def _elastic_recovery_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+_ELASTIC_GROW_WORKER = r"""
+import os, sys, time, json
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import ompi_tpu
+from ompi_tpu.core.errors import RevokedError
+from ompi_tpu.ft import elastic, inject, lazarus, lifeboat
+from ompi_tpu.telemetry import fleet
+
+world = ompi_tpu.init()
+assert world.size == 8
+trials = int(os.environ.get("OMPI_TPU_BENCH_ELASTIC_TRIALS", "5"))
+x = np.ones((8, 16), dtype=np.float32)
+# ~224 KiB snapshot -> several 64 KiB catch-up chunks, so rejoin_steps
+# measures a real bounded convergence, not a single transfer
+state = {"params": np.arange(48 << 10, dtype=np.float32),
+         "opt": np.ones((8, 1024), dtype=np.float32)}
+runs = []
+for t in range(trials):
+    comm = world.dup()
+    lifeboat.enable()
+    comm.allreduce(x)  # warm the dispatch before the kill
+    inject.arm("rank_kill@coll:op=allreduce,after_step=2,peer=3")
+    try:
+        comm.allreduce(x)
+        raise SystemExit("rank_kill did not fire")
+    except RevokedError:
+        pass
+    inject.disarm()
+    shrunk = lifeboat.recover(comm, seed=t)
+    y = np.ones((shrunk.size, 16), dtype=np.float32)
+    base = []
+    for _ in range(4):
+        s0 = time.perf_counter()
+        jax.block_until_ready(shrunk.allreduce(y))
+        base.append((time.perf_counter() - s0) * 1e3)
+    base.sort()
+    base_ms = base[len(base) // 2]
+    during = []
+    def survivor_step():
+        s0 = time.perf_counter()
+        jax.block_until_ready(shrunk.allreduce(y))
+        during.append((time.perf_counter() - s0) * 1e3)
+    lazarus.add_spare(3)
+    t0 = time.perf_counter()
+    grown = lazarus.grow(shrunk, seed=t, state=state,
+                         survivor_step=survivor_step)
+    grow_ms = (time.perf_counter() - t0) * 1e3
+    assert grown.size == 8
+    z = np.ones((8, 16), dtype=np.float32)
+    t1 = time.perf_counter()
+    jax.block_until_ready(grown.allreduce(z))
+    first_ms = (time.perf_counter() - t1) * 1e3
+    rep = lazarus.last_report()
+    during.sort()
+    during_ms = during[len(during) // 2] if during else 0.0
+    run = {"grow_ms": round(grow_ms, 3),
+           "first_allreduce_ms": round(first_ms, 3),
+           "baseline_step_ms": round(base_ms, 3),
+           "catchup_step_ms": round(during_ms, 3),
+           "blip_x": round(during_ms / base_ms, 3) if base_ms else 0.0,
+           "grown_size": grown.size,
+           "rejoin_steps": rep["rejoin_steps"],
+           "catchup_chunks": rep["catchup_chunks"],
+           "catchup_bytes": rep["catchup_bytes"],
+           "cache_reused": rep["cache_reused"]}
+    run.update(rep["phases"])
+    runs.append(run)
+    # next trial's dup must start healthy (revoke fan-out hit WORLD)
+    lifeboat.reset()
+    elastic.reset()
+    lazarus.reset()
+    fleet.reset_for_testing()
+    world._revoked = False
+    world.epoch = 0
+runs.sort(key=lambda r: r["grow_ms"])
+med = runs[len(runs) // 2]
+out = {
+    "trials": trials,
+    "ranks": 8,
+    "grown_size": med["grown_size"],
+    "grow_p50_ms": med["grow_ms"],
+    "agree_ms": med["agree_ms"],
+    "admit_ms": med["admit_ms"],
+    "expand_ms": med["expand_ms"],
+    "migrate_ms": med["migrate_ms"],
+    "catchup_ms": med["catchup_ms"],
+    "rejoin_steps": med["rejoin_steps"],
+    "catchup_chunks": med["catchup_chunks"],
+    "catchup_bytes": med["catchup_bytes"],
+    "cache_reused": med["cache_reused"],
+    "baseline_step_ms": med["baseline_step_ms"],
+    "catchup_step_ms": med["catchup_step_ms"],
+    "blip_x": med["blip_x"],
+    "first_allreduce_ms": med["first_allreduce_ms"],
+    "pass": all(r["grown_size"] == 8 and r["rejoin_steps"] > 0
+                and r["rejoin_steps"] == r["catchup_chunks"]
+                for r in runs),
+}
+print("ELASTICGROW " + json.dumps(out), flush=True)
+os._exit(0)
+"""
+
+
+def _elastic_grow_row() -> dict:
+    """Elastic scale-UP drill on the 8-rank virtual mesh: rank_kill
+    mid-allreduce -> lifeboat shrink to 7 -> the killed rank rejoins
+    as a warm spare through lazarus (medic ladder admission, epoch
+    bump, winner-cache reuse, snapshot-streaming catch-up) -> first
+    successful allreduce on the regrown 8-rank comm. p50 ms end-to-end
+    plus the per-phase breakdown from lazarus.last_report(), the
+    bounded rejoin_steps, and the survivor step-time blip during
+    catch-up (catchup_step_ms / baseline_step_ms)."""
+    import os
+    import subprocess
+    import sys
+
+    try:
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        here = os.path.dirname(os.path.abspath(__file__))
+        p = subprocess.run(
+            [sys.executable, "-c", _ELASTIC_GROW_WORKER],
+            capture_output=True, text=True, env=env, cwd=here,
+            timeout=420,
+        )
+        if p.returncode != 0:
+            return {"error": f"rc={p.returncode}: {p.stderr[-400:]}"}
+        for line in p.stdout.splitlines():
+            if line.startswith("ELASTICGROW "):
+                return json.loads(line[len("ELASTICGROW "):])
+        return {"error": "no ELASTICGROW line"}
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 _TENANT_ISOLATION_WORKER = r"""
 import os, sys, time, json
 os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
@@ -3273,6 +3412,58 @@ def _fleet_sim_determinism_row() -> dict:
         return {"error": f"{type(exc).__name__}: {exc}"}
 
 
+def _fleet_grow_sim_row() -> dict:
+    """armada grow drill at pod scale: a 1024-rank fleet loses a rank
+    (host-layer kill -> lifeboat shrink across the tenant fleet), then
+    the same rank rejoins as a warm spare (spare_join@fleet -> lazarus
+    grow + tenant regrow). Reports engine throughput, the grow p50
+    under virtual time, and the replay contract for the grow path:
+    the same seeded scenario in TWO separate subprocesses must produce
+    byte-identical merged decision-log digests — lazarus' numbered
+    grow log included."""
+    import os
+
+    try:
+        ranks = int(os.environ.get("OMPI_TPU_BENCH_SIM_RANKS", "1024"))
+        sc = {
+            "name": "bench_grow", "seed": 20, "nranks": ranks,
+            "duration_s": 6.0, "tenants": 20, "base_rps": 400.0,
+            "faults": [
+                {"at": 1.0, "spec": f"rank_kill@fleet:rank={ranks // 2}"},
+                {"at": 3.0,
+                 "spec": f"spare_join@fleet:rank={ranks // 2}"},
+            ],
+        }
+        a = _run_fleet_sim(sc)
+        b = _run_fleet_sim(sc)
+        for rep in (a, b):
+            if "error" in rep:
+                return rep
+        match = a["digest"] == b["digest"]
+        return {
+            "ranks": a["nranks"],
+            "tenants": a["tenants"],
+            "virtual_s": a["virtual_s"],
+            "wall_s": a["wall_s"],
+            "events": a["events"],
+            "events_per_s": a["events_per_s"],
+            "grows": a["grows"],
+            "grow_p50_ms": a["grow_p50_ms"],
+            "recoveries": a["recoveries"],
+            "world_size_after": a["world_size"],
+            "dead_after": len(a["dead_ranks"]),
+            "digest_a": a["digest"],
+            "digest_b": b["digest"],
+            "digests_match": match,
+            "pass": (match and a["grows"] > 0
+                     and a["world_size"] == ranks
+                     and not a["dead_ranks"]
+                     and a["errors"] == 0),
+        }
+    except Exception as exc:
+        return {"error": f"{type(exc).__name__}: {exc}"}
+
+
 def _host_rows() -> dict:
     """Every host-side (tunnel-independent) row, each with r4
     comparison values where r4 measured the same thing. Cached: on
@@ -3361,6 +3552,8 @@ def _host_rows() -> dict:
     rows["schedule_cache_warm_start"] = _sched_warm_start_row()
     _set_phase("elastic recovery (rank_kill -> revoke/agree/shrink)")
     rows["elastic_recovery"] = _elastic_recovery_row()
+    _set_phase("elastic grow (shrink -> warm-spare rejoin -> catch-up)")
+    rows["elastic_grow"] = _elastic_grow_row()
     _set_phase("tenant isolation (guaranteed p50 under scavenger flood)")
     rows["tenant_isolation"] = _tenant_isolation_row()
     _set_phase("admission/eviction (reject -> retry-after -> admit)")
@@ -3369,6 +3562,8 @@ def _host_rows() -> dict:
     rows["fleet_sim_scale"] = _fleet_sim_scale_row()
     _set_phase("fleet sim determinism (two-subprocess replay)")
     rows["fleet_sim_determinism"] = _fleet_sim_determinism_row()
+    _set_phase("fleet grow sim (1024-rank spare_join, replay digest)")
+    rows["fleet_grow_sim"] = _fleet_grow_sim_row()
     return rows
 
 

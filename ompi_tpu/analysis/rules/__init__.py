@@ -288,6 +288,7 @@ def ensure_rules() -> None:
         from . import devicesem  # noqa: F401
         from . import excepts  # noqa: F401
         from . import fastpath  # noqa: F401
+        from . import growfence  # noqa: F401
         from . import healthseam  # noqa: F401
         from . import lifecycle  # noqa: F401
         from . import locking  # noqa: F401

@@ -1,0 +1,136 @@
+"""Grow-fence rule.
+
+``growfence``: the elastic pipelines (lifeboat's shrink, lazarus'
+grow) keep the fleet safe across membership changes only if every code
+path that constructs or resizes a communicator is fenced by the epoch
+machinery — a comm built from a revoked parent, or handed out without
+the epoch bump/check, re-opens exactly the split-brain window the
+wire-tag epoch namespace exists to close (a straggling pre-change op
+could rendezvous with the new membership's traffic). The rule flags
+function scopes under ``ft/`` and ``daemon/`` that construct or resize
+communicators (``Communicator(...)``, ``.dup()``, ``.create(...)``,
+``.split(...)``) with no epoch-fence evidence in the same scope.
+
+Evidence that satisfies the rule, anywhere in the scope: a call named
+``check``/``revoked``/``_check_alive``/``_fence_check``/``epoch_tag``,
+or any identifier mentioning ``epoch`` or ``revok`` (reading
+``comm.epoch`` for the bump or the log line, handling
+``RevokedError``, consulting ``_revoked``).
+
+Suppression: ``# commlint: allow(growfence)`` on or above the
+constructing call (or the enclosing def), for construction sites whose
+fence provably lives in the caller.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from ..report import Severity
+from . import COMMLINT, LintRule, call_name, scope_walk, scopes
+
+#: Call names that construct or resize a communicator.
+_CONSTRUCTING = frozenset({"Communicator", "dup", "create", "split"})
+
+#: Call names that count as epoch-fence evidence.
+_EVIDENCE_CALLS = frozenset({
+    "check", "revoked", "_check_alive", "_fence_check", "epoch_tag",
+})
+
+#: Identifier substrings that count as evidence (``comm.epoch``,
+#: ``RevokedError``, ``_revoked``, ``epoch_tag``...).
+_EVIDENCE_WORDS = ("epoch", "revok")
+
+
+def _idents(node: ast.AST) -> Iterable[str]:
+    if isinstance(node, ast.Name):
+        yield node.id
+    elif isinstance(node, ast.Attribute):
+        yield node.attr
+    elif isinstance(node, ast.ExceptHandler) and node.type is not None:
+        for sub in ast.walk(node.type):
+            yield from _idents(sub)
+
+
+def _has_evidence(scope: ast.AST) -> bool:
+    for node in scope_walk(scope):
+        if isinstance(node, ast.Call):
+            if call_name(node) in _EVIDENCE_CALLS:
+                return True
+            # reflective probes: getattr(comm, "_revoked", False)
+            if call_name(node) in ("getattr", "hasattr", "setattr"):
+                for arg in node.args:
+                    if isinstance(arg, ast.Constant) \
+                            and isinstance(arg.value, str) \
+                            and any(w in arg.value.lower()
+                                    for w in _EVIDENCE_WORDS):
+                        return True
+        for ident in _idents(node):
+            low = ident.lower()
+            if any(w in low for w in _EVIDENCE_WORDS):
+                return True
+    return False
+
+
+def _constructing_calls(scope: ast.AST) -> list[ast.Call]:
+    out = []
+    for node in scope_walk(scope):
+        if not isinstance(node, ast.Call):
+            continue
+        name = call_name(node)
+        if name not in _CONSTRUCTING:
+            continue
+        # 'create'/'dup'/'split' must be attribute calls on something
+        # comm-like; a bare create() name is some other factory
+        if name != "Communicator" \
+                and not isinstance(node.func, ast.Attribute):
+            continue
+        # string-literal arguments mean str.split(",") or a name-keyed
+        # factory (ShmLane.create(f"...")) — not a communicator op,
+        # which takes ranks/colors
+        if name in ("create", "split") and any(
+            isinstance(a, ast.JoinedStr)
+            or (isinstance(a, ast.Constant) and isinstance(a.value, str))
+            for a in node.args
+        ):
+            continue
+        out.append(node)
+    return out
+
+
+@COMMLINT.register
+class GrowFenceRule(LintRule):
+    NAME = "growfence"
+    PRIORITY = 43
+    DESCRIPTION = ("communicator construction/resizing under "
+                   "ft//daemon/ must show epoch-fence evidence in "
+                   "the same scope")
+    SEVERITY = Severity.WARNING
+
+    def check(self, ctx) -> Iterable:
+        rel = ctx.relpath.replace("\\", "/")
+        if "ft/" not in rel and "daemon/" not in rel:
+            return
+        for scope, _is_module in scopes(ctx.tree):
+            constructing = _constructing_calls(scope)
+            if not constructing:
+                continue
+            if _has_evidence(scope):
+                continue
+            for call in constructing:
+                anchor = getattr(scope, "lineno", call.lineno)
+                if ctx.suppressed(anchor, self.NAME):
+                    continue
+                if ctx.suppressed(call.lineno, self.NAME):
+                    continue
+                yield self.finding(
+                    ctx, call,
+                    f"{call_name(call)}() constructs/resizes a "
+                    "communicator with no epoch-fence evidence in "
+                    "scope — a comm built from a revoked parent (or "
+                    "handed out without the epoch bump) re-opens the "
+                    "split-brain window; check revocation or stamp "
+                    "the epoch here (or annotate commlint: "
+                    "allow(growfence))",
+                )

@@ -437,11 +437,19 @@ class Daemon:
 
     # -- recovery --------------------------------------------------------
 
-    def recover_tenant(self, tenant_name: str) -> dict:
+    def recover_tenant(self, tenant_name: str, *,
+                       onto: Any = None) -> dict:
         """Recover a tenant whose session comms were revoked (rank
         death): lifeboat's shrink pipeline per session, then rebind —
         the session keeps its sid and meter, gets a fresh comm, cid
-        scope seeded from the tenant namespace, epoch bumped."""
+        scope seeded from the tenant namespace, epoch bumped.
+
+        With ``onto`` (a grown world from ``lazarus.grow``), every
+        session rebinds onto a dup of it instead — revoked sessions
+        skip the shrink (the grown comm already carries the bumped
+        epoch and the re-admitted ranks), and LIVE sessions move too:
+        a session left on the pre-grow comm would keep running at the
+        shrunk size forever."""
         with self._mu:
             from ..ft import lifeboat
 
@@ -451,12 +459,19 @@ class Daemon:
             recovered = 0
             for session in sorted(tenant.sessions.values(),
                                   key=lambda s: s.sid):
-                if session.state != REVOKED and \
-                        not lifeboat.revoked(session.comm):
+                revoked = session.state == REVOKED or \
+                    lifeboat.revoked(session.comm)
+                if onto is None and not revoked:
                     continue
                 old = session.comm
-                new = lifeboat.recover(old, quiesce_timeout=0.5,
-                                       seed=self.seed)
+                if onto is not None:
+                    lifeboat.check(onto)  # epoch fence: never rebind
+                    # onto a world revoked since it grew
+                    new = onto.dup()
+                    new.epoch = onto.epoch
+                else:
+                    new = lifeboat.recover(old, quiesce_timeout=0.5,
+                                           seed=self.seed)
                 session.comm = new
                 session.state = ATTACHED
                 self.bulkhead.on_attach(tenant_name, new)
@@ -465,8 +480,9 @@ class Daemon:
                                    tenant.qos.slo_p50_us)
                     slo.set_target(str(old.cid), None)
                 recovered += 1
+                verb = "regrow" if onto is not None else "recover"
                 self.log.note(
-                    f"recover tenant={tenant_name} "
+                    f"{verb} tenant={tenant_name} "
                     f"sid={session.sid} cid={old.cid}->{new.cid} "
                     f"epoch={old.epoch}->{new.epoch} "
                     f"survivors={new.size}"

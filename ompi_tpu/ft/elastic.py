@@ -157,6 +157,21 @@ def failed_ranks() -> set[int]:
         return set(_failed)
 
 
+def revive(ranks) -> list[int]:
+    """Forget recorded failures for ``ranks`` (lazarus calls this when
+    a warm spare passes admission): a revived rank re-enters agree's
+    survivor set and shrink's keep list. Returns the ranks that were
+    actually recorded dead, sorted — the deterministic evidence line
+    lazarus logs."""
+    revived = []
+    with _lock:
+        for wr in ranks:
+            if int(wr) in _failed:
+                _failed.discard(int(wr))
+                revived.append(int(wr))
+    return sorted(revived)
+
+
 def watch_dcn(peer_world_ranks: dict) -> int:
     """Bridge DCN link-death detection to elastic recovery: when every
     TCP link to a peer endpoint dies, `DcnEndpoint.check_peer` raises a
@@ -217,6 +232,48 @@ def shrink(comm, *, dead: Optional[set] = None) -> Any:
     logger.info(
         "shrink %s: %d -> %d ranks (failed: %s)",
         comm.name, comm.size, new.size, sorted(dead),
+    )
+    return new
+
+
+def grow(comm, spares) -> Any:
+    """The inverse of :func:`shrink`: a new communicator over
+    ``comm``'s ranks PLUS ``spares`` (world ranks present in the
+    retained world proc table but not in the current group). The
+    caller — ``ft/lazarus.grow`` — owns admission (PROBATION walks)
+    and the epoch bump; this is only the construction step. Like
+    shrink, the grown comm is built directly over the retained
+    ``_world_procs`` table rather than through ``world.create``'s
+    liveness fence: growth usually happens right after a recovery,
+    when WORLD is still revoked."""
+    if getattr(comm, "_revoked", False):
+        raise CommError(
+            f"{comm.name}: cannot grow a revoked communicator — "
+            f"recover (shrink) it first"
+        )
+    current = set(comm.group.world_ranks)
+    joiners = sorted(int(s) for s in set(spares) - current)
+    if not joiners:
+        return comm.dup()
+    nworld = len(comm._world_procs)
+    bad = [wr for wr in joiners if not 0 <= wr < nworld]
+    if bad:
+        raise CommError(
+            f"{comm.name}: spare ranks {bad} outside the retained "
+            f"world proc table (0..{nworld - 1})"
+        )
+    # the grow fence is the caller's: lazarus bumps new.epoch past
+    # comm.epoch and re-checks revocation before traffic flows
+    from ..communicator import Communicator
+
+    new = Communicator(
+        Group(sorted(current | set(joiners))), comm._world_procs,
+        name=f"{comm.name}.grown", parent_cid=comm.cid,
+    )
+    SPC.record("ft_grows_constructed")
+    logger.info(
+        "grow %s: %d -> %d ranks (joiners: %s)",
+        comm.name, comm.size, new.size, joiners,
     )
     return new
 

@@ -1,0 +1,477 @@
+"""lazarus — elastic scale-UP: warm spares, grow-after-shrink, and
+preemption-tolerant rejoin.
+
+Lifeboat (``ft/lifeboat.py``) is the shrink half of ULFM-grade
+elasticity: revoke → quiesce → agree → shrink → re-admit. A production
+fleet on preemptible capacity needs the other half — a
+killed-and-replaced rank must rejoin within a bounded number of steps
+instead of forcing a restart. This module is that inverse pipeline::
+
+    grow(comm, spares) = agree → admit → epoch-bump → expand →
+                         state-migration → catch-up
+
+**Warm spares.** ``add_spare(wr)`` registers a standby world rank (the
+PiP warm-standby pattern applied to daemon-owned meshes). A spare is a
+rank present in the survivor comm's retained ``_world_procs`` table but
+not in its group — shrink keeps the full table precisely so a later
+grow can re-address the vacated slots.
+
+**Admission (the medic ladder).** Before a spare touches real traffic
+it is walked through the health ledger's PROBATION machinery in its own
+``spare:<wr>`` scope: forced QUARANTINED, then canary probes
+(``health/prober.probe_tier`` by default — the same deadline-bounded
+device canaries the medic supervisor runs) must walk it QUARANTINED →
+PROBATION → HEALTHY. A canary failure re-quarantines *with cause* (the
+readmit idempotency contract) and retries ride a bounded seeded
+``Backoff`` — a flaky spare is rejected, never admitted, and never
+stalls the pipeline.
+
+**Epoch bump + expand.** ``elastic.grow`` constructs the grown comm
+over survivors + admitted spares; the new comm is born at
+``parent.epoch + 1`` so its wire-tag namespace (``lifeboat.epoch_tag``)
+is disjoint from anything a straggling pre-grow op could emit.
+
+**State migration.** The sched winner cache migrates ``r<old>`` keys to
+``r<new>`` — the PR 12 shrink migration in reverse. Keys retained from
+a previous life at ``new_n`` (shrink deliberately keeps them) are
+*reused*, not re-tuned: growing back to a prior size is warm-start by
+construction (``lifeboat._migrate_sched_cache`` promises "the old keys
+stay — a respawn back to old_n re-uses them"). The health ledger's new
+comm scope is seeded from global, the spare scopes are GC'd, the fleet
+merge un-deads the joiners (``fleet.mark_alive``), and watchtower
+baselines reset on grow exactly as on shrink.
+
+**Catch-up.** The joiner converges by continuous parameter/optimizer
+snapshot streaming over the comm plane itself: the snapshot is
+serialized once, split into fixed-size chunks, and each chunk rides the
+comm's point-to-point path (device-resident transfers on whatever tier
+the pml selected — the DCN path cross-host, with its existing link
+failover) under a ``sentinel.run_bounded`` deadline, sha256-verified
+end to end. Survivors keep training meanwhile (``survivor_step`` fires
+once per chunk), so ``rejoin_steps == ceil(len(snapshot)/chunk_bytes)``
+is a *pure function of snapshot size* — bounded, measured, and
+deterministic.
+
+Determinism: every decision lands in a numbered timestamp-free log
+(ledger idiom); ``digest()`` hashes it — byte-identical across
+same-seed controllers (two-subprocess drill). Wall-clock phase timings
+live in ``last_report()``, outside the log.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from ..core import config
+from ..core.counters import SPC
+from ..core.errors import CommError
+from ..core.logging import get_logger
+from . import elastic, lifeboat
+
+logger = get_logger("ft.lazarus")
+
+__all__ = [
+    "GrowError", "add_spare", "digest", "grow", "last_report", "log",
+    "remove_spare", "reset", "spares",
+]
+
+_spare_attempts = config.register(
+    "ft", "lazarus", "spare_attempts", type=int, default=2,
+    description="Admission walks a flaky spare retries before it is "
+    "rejected (each retry re-quarantines and re-runs the full "
+    "PROBATION ladder under seeded backoff)",
+)
+_chunk_bytes = config.register(
+    "ft", "lazarus", "chunk_bytes", type=int, default=1 << 16,
+    description="Catch-up snapshot stream chunk size; rejoin_steps = "
+    "ceil(snapshot_bytes / chunk_bytes) — one survivor step per chunk",
+)
+_chunk_deadline_s = config.register(
+    "ft", "lazarus", "chunk_deadline_s", type=float, default=1.0,
+    description="run_bounded stall deadline per streamed catch-up "
+    "chunk (a wedged link is a tier fault, never a hang)",
+)
+
+
+class GrowError(CommError):
+    """The grow pipeline could not admit any spare (every candidate
+    failed its canary ladder) or was asked to grow a revoked comm."""
+
+    errclass = "ERR_COMM"
+
+
+# -- module state --------------------------------------------------------
+
+_mu = threading.RLock()
+#: timestamp-free decision log (ledger idiom: numbered lines).
+_log: list[str] = []
+#: warm-spare pool: world ranks standing by for admission.
+_pool: set[int] = set()
+_last_report: dict = {}
+
+#: catch-up stream tag — below the epoch bits of the wire-tag
+#: namespace, constant because chunks are strictly send-then-recv
+#: sequenced (never concurrently outstanding per joiner).
+_CATCHUP_TAG = 3091
+
+
+def _note(line: str) -> None:
+    with _mu:
+        _log.append(f"{len(_log)} {line}")
+
+
+def log() -> list[str]:
+    with _mu:
+        return list(_log)
+
+
+def digest() -> str:
+    """sha256 of the grow decision log — byte-identical across
+    same-seed controllers (the lifeboat/ledger contract)."""
+    with _mu:
+        return hashlib.sha256("\n".join(_log).encode()).hexdigest()
+
+
+def last_report() -> dict:
+    """Wall-clock phase breakdown of the most recent grow() —
+    deliberately OUTSIDE the decision log so timings never perturb
+    the byte-identity contract."""
+    with _mu:
+        return dict(_last_report)
+
+
+def reset() -> None:
+    """Forget the log, the spare pool, and tracking (test teardown)."""
+    with _mu:
+        _log.clear()
+        _pool.clear()
+        _last_report.clear()
+
+
+# -- the warm-spare pool -------------------------------------------------
+
+def add_spare(world_rank: int) -> None:
+    """Register a warm standby rank. Idempotent; logged once."""
+    wr = int(world_rank)
+    with _mu:
+        if wr in _pool:
+            return
+        _pool.add(wr)
+    _note(f"spare add wr={wr}")
+    SPC.record("ft_spares_registered")
+
+
+def remove_spare(world_rank: int) -> None:
+    """Withdraw a standby rank (preempted before it was needed)."""
+    wr = int(world_rank)
+    with _mu:
+        if wr not in _pool:
+            return
+        _pool.discard(wr)
+    _note(f"spare remove wr={wr}")
+
+
+def spares() -> list[int]:
+    """The warm pool, sorted (the deterministic admission order)."""
+    with _mu:
+        return sorted(_pool)
+
+
+# -- admission: the medic PROBATION ladder -------------------------------
+
+def _walk_ladder(wr: int, *, canary: Optional[Callable[[int], bool]],
+                 attempts: int, seed: int) -> tuple[int, bool]:
+    """Walk spare ``wr`` through QUARANTINED → PROBATION → HEALTHY in
+    its own ``spare:<wr>`` ledger scope. Returns (attempts_used,
+    admitted). Canary-fail → retry is idempotent: every walk starts by
+    forcing QUARANTINED, and a failure re-quarantines with cause
+    before the seeded bounded backoff schedules the retry."""
+    from ..core.backoff import Backoff
+    from ..health import ledger as health, prober
+
+    scope = f"spare:{wr}"
+    needed = int(config.get("health_ledger_probation_successes", 2)) + 1
+    attempts = max(1, int(attempts))
+    bo = Backoff(initial=0.01, maximum=0.25, seed=seed ^ (wr << 1),
+                 timeout=2.0)
+    if canary is None:
+        prober.ensure_builtin_probes()
+    attempt = 0
+    for attempt in range(attempts):
+        health.LEDGER.quarantine("device", scope=scope, cause="admit")
+        failed = False
+        for _ in range(needed):
+            if canary is None:
+                # the medic canary: deadline-bounded, and it feeds the
+                # ledger in this scope itself
+                ok = bool(prober.probe_tier("device", scope=scope))
+            else:
+                try:
+                    ok = bool(canary(wr))
+                except Exception:  # commlint: allow(broadexcept)
+                    ok = False
+                if ok:
+                    health.LEDGER.report_success("device", scope=scope)
+                else:
+                    health.LEDGER.report_failure(
+                        "device", scope=scope, cause="canary")
+            if not ok:
+                health.LEDGER.quarantine("device", scope=scope,
+                                         cause="canary_failed")
+                failed = True
+                break
+        if not failed and health.LEDGER.state("device", scope) \
+                == health.HEALTHY:
+            return attempt, True
+        if attempt + 1 < attempts and not bo.sleep():
+            break
+    return attempt, False
+
+
+# -- state migration: the shrink migration in reverse --------------------
+
+def _migrate_sched_cache(old_n: int, new_n: int,
+                         seed: Optional[int] = None
+                         ) -> tuple[int, int]:
+    """Move the winner cache to the grown world: every key tuned for
+    ``r<old_n>`` gets a ``r<new_n>`` counterpart. A counterpart that
+    already exists — shrink retains old-size keys exactly for this —
+    is REUSED (warm-start by construction, zero tuning); a missing one
+    is installed through the retune sweep. Returns (migrated,
+    reused)."""
+    from ..coll.sched import autotune, cache as scache, retune
+
+    fp = autotune.fingerprint()
+    entries = scache.CACHE.entries()
+    migrated = reused = 0
+    for key in sorted(entries):
+        parsed = retune.parse_key(key)
+        if parsed is None or parsed["nranks"] != old_n:
+            continue
+        new_key = scache.cache_key(
+            parsed["opname"], scache.bucket_bytes(parsed["bucket"]),
+            new_n,
+            None if parsed["dtype"] == "any" else parsed["dtype"],
+            fp,
+        )
+        if new_key in entries:
+            reused += 1
+            continue
+        if retune.retune_key(new_key, reason="grow",
+                             seed=seed) is not None:
+            migrated += 1
+    return migrated, reused
+
+
+# -- catch-up: snapshot streaming over the comm plane --------------------
+
+def _serialize(state: Any) -> bytes:
+    """Deterministic byte encoding of a parameter/optimizer pytree:
+    every leaf as an npy record in tree-flatten order."""
+    import jax
+    import numpy as np
+
+    buf = io.BytesIO()
+    for leaf in jax.tree.flatten(state)[0]:
+        np.lib.format.write_array(buf, np.asarray(leaf),
+                                  allow_pickle=False)
+    return buf.getvalue()
+
+
+def _stream_catchup(new, joiners: list[int], payload: bytes, *,
+                    chunk_bytes: int, chunk_deadline_s: float,
+                    stream: Optional[Callable[[int, bytes, int], None]],
+                    survivor_step: Optional[Callable[[], None]]
+                    ) -> tuple[int, int]:
+    """Stream ``payload`` to every joiner in fixed-size chunks; one
+    survivor training step interleaves per chunk, so the returned
+    (chunks, steps) is a pure function of the snapshot size. Each real
+    chunk is a point-to-point transfer under a ``run_bounded`` stall
+    deadline (a wedged link faults, never hangs) and is sha256-verified
+    after the round trip."""
+    import numpy as np
+
+    from ..health import sentinel
+
+    nchunks = (len(payload) + chunk_bytes - 1) // chunk_bytes
+    if not joiners or nchunks == 0:
+        return 0, 0
+    # the lowest SURVIVOR streams (a joiner can hold group rank 0 when
+    # it re-occupies the smallest world slot — it must not self-stream)
+    joined = set(joiners)
+    src = next(i for i, wr in enumerate(new.group.world_ranks)
+               if wr not in joined)
+    jranks = [new.group.world_ranks.index(wr) for wr in joiners]
+    for i in range(nchunks):
+        chunk = payload[i * chunk_bytes:(i + 1) * chunk_bytes]
+        if stream is not None:
+            # modeled transport (armada: data-plane ops are impossible
+            # on sim devices) — count the chunk, skip the wire
+            for wr in joiners:
+                stream(wr, chunk, i)
+        else:
+            arr = np.frombuffer(chunk, dtype=np.uint8)
+            want = hashlib.sha256(chunk).hexdigest()
+            for jr in jranks:
+                def _round_trip(jr=jr):
+                    new.send(arr, jr, _CATCHUP_TAG, source=src)
+                    return new.recv(src, _CATCHUP_TAG, dest=jr)
+                got = sentinel.run_bounded(
+                    _round_trip, chunk_deadline_s,
+                    what=f"lazarus.catchup chunk={i} joiner={jr}")
+                got_sha = hashlib.sha256(
+                    np.asarray(got).tobytes()).hexdigest()
+                if got_sha != want:
+                    raise GrowError(
+                        f"catch-up chunk {i} corrupt in flight to "
+                        f"group rank {jr}: {got_sha[:12]} != "
+                        f"{want[:12]}")
+        SPC.record("ft_catchup_chunks_total", len(joiners))
+        if survivor_step is not None:
+            survivor_step()
+    return nchunks, nchunks
+
+
+# -- the grow pipeline ---------------------------------------------------
+
+def grow(comm, spares: Optional[list] = None, *,
+         seed: Optional[int] = None,
+         canary: Optional[Callable[[int], bool]] = None,
+         state: Any = None,
+         stream: Optional[Callable[[int, bytes, int], None]] = None,
+         survivor_step: Optional[Callable[[], None]] = None,
+         chunk_bytes: Optional[int] = None,
+         chunk_deadline_s: Optional[float] = None,
+         migrate_cache: bool = True) -> Any:
+    """The deterministic grow pipeline — the inverse of
+    ``lifeboat.recover``: agree → admit (PROBATION ladder per spare) →
+    epoch-bump → expand → state-migration → catch-up. Returns the
+    grown communicator; phase timings land in ``last_report()``, every
+    decision in the timestamp-free log.
+
+    ``spares`` defaults to the registered warm pool. ``canary`` (a
+    ``wr -> bool`` probe) overrides the medic prober ladder — armada
+    and tests inject it. ``state`` is the parameter/optimizer snapshot
+    streamed to joiners; ``stream`` replaces the real point-to-point
+    transport with a model (armada). ``survivor_step`` fires once per
+    chunk — the survivors' training step the joiner converges under."""
+    from ..health import ledger as health
+    from ..telemetry import fleet, watchtower
+
+    lifeboat.check(comm)  # a revoked comm must recover, not grow
+    pool = spares if spares is not None else globals()["spares"]()
+    current = set(comm.group.world_ranks)
+    candidates = sorted(int(s) for s in set(pool) - current)
+    if not candidates:
+        raise GrowError(f"{comm.name}: no spare ranks to admit")
+    seed_v = int(seed) if seed is not None else 0
+    attempts = max(1, int(_spare_attempts.value))
+    cbytes = int(chunk_bytes if chunk_bytes is not None
+                 else _chunk_bytes.value)
+    cdeadline = float(chunk_deadline_s if chunk_deadline_s is not None
+                      else _chunk_deadline_s.value)
+
+    phases: dict[str, float] = {}
+    t0 = time.perf_counter()
+
+    def _mark(phase: str) -> None:
+        nonlocal t0
+        now = time.perf_counter()
+        phases[f"{phase}_ms"] = round((now - t0) * 1e3, 3)
+        t0 = now
+
+    # agree: every survivor votes to admit — the agreement's job is
+    # masking a death arriving mid-grow (a survivor dying now re-roots
+    # instead of splitting the set that believes the grow happened).
+    lifeboat.agree(comm, [1] * comm.size)
+    _mark("agree")
+
+    # admit: the medic ladder per spare, deterministic order
+    admitted: list[int] = []
+    rejected: list[int] = []
+    for wr in candidates:
+        used, ok = _walk_ladder(wr, canary=canary, attempts=attempts,
+                                seed=seed_v)
+        if ok:
+            admitted.append(wr)
+            _note(f"admit wr={wr} attempts={used + 1} result=healthy")
+            SPC.record("ft_spare_admissions")
+        else:
+            rejected.append(wr)
+            _note(f"admit wr={wr} attempts={used + 1} result=rejected")
+            SPC.record("ft_spare_rejections")
+    _mark("admit")
+    if not admitted:
+        _note(f"grow cid={comm.cid} result=no-admissible-spares "
+              f"rejected={rejected}")
+        raise GrowError(
+            f"{comm.name}: every spare failed the canary ladder "
+            f"({rejected})")
+
+    # expand + epoch bump: the grown comm's tag namespace is disjoint
+    # from the parent epoch's by construction
+    elastic.revive(admitted)
+    new = elastic.grow(comm, admitted)
+    new.epoch = comm.epoch + 1
+    with _mu:
+        _pool.difference_update(admitted)
+    _mark("expand")
+
+    # state migration: winner cache r<old> -> r<new> (retained keys
+    # reused), ledger scope seeded, fleet un-deaded, baselines reset
+    migrated, reused = _migrate_sched_cache(
+        comm.size, new.size, seed=seed) if migrate_cache else (0, 0)
+    gcd = health.LEDGER.gc_scope(str(comm.cid), cause="grow")
+    for wr in admitted:
+        gcd += health.LEDGER.gc_scope(f"spare:{wr}", cause="grow")
+    seeded = health.LEDGER.seed_scope(str(new.cid), cause="grow")
+    alive = sum(1 for wr in admitted if fleet.mark_alive(wr))
+    baselines = watchtower.reset_baselines(reason="grow")
+    _mark("migrate")
+
+    # catch-up: bounded, measured convergence under live training
+    payload = b"" if state is None else _serialize(state)
+    chunks, steps = _stream_catchup(
+        new, admitted, payload, chunk_bytes=cbytes,
+        chunk_deadline_s=cdeadline, stream=stream,
+        survivor_step=survivor_step)
+    if steps:
+        SPC.record("ft_rejoin_steps", steps)
+    _mark("catchup")
+
+    sha = hashlib.sha256(payload).hexdigest()[:16]
+    _note(
+        f"grow cid={comm.cid}->{new.cid} "
+        f"epoch={comm.epoch}->{new.epoch} joiners={admitted} "
+        f"rejected={rejected} survivors={new.size} "
+        f"cache_migrated={migrated} cache_reused={reused} "
+        f"ledger_gc={gcd} ledger_seeded={seeded} "
+        f"baselines_reset={baselines} fleet_alive={alive} "
+        f"catchup_chunks={chunks} catchup_bytes={len(payload)} "
+        f"rejoin_steps={steps} sha={sha}"
+    )
+    SPC.record("ft_grows")
+    from ..trace import span as tspan
+
+    tspan.instant("ft.grow", cat="ft", cid=comm.cid, new_cid=new.cid,
+                  epoch=new.epoch, joiners=admitted,
+                  survivors=new.size, rejoin_steps=steps)
+    with _mu:
+        _last_report.clear()
+        _last_report.update({
+            "phases": phases, "joiners": admitted,
+            "rejected": rejected, "survivors": new.size,
+            "cache_migrated": migrated, "cache_reused": reused,
+            "ledger_gc": gcd, "ledger_seeded": seeded,
+            "catchup_chunks": chunks,
+            "catchup_bytes": len(payload),
+            "rejoin_steps": steps,
+        })
+    logger.info("lazarus: grew %s -> %s (%d ranks, joiners=%s, "
+                "rejoin_steps=%d)", comm.name, new.name, new.size,
+                admitted, steps)
+    return new

@@ -482,18 +482,26 @@ def detach(comm, *, cause: str = "detach",
 
 # -- respawn / re-admission ---------------------------------------------
 
-def readmit(comm, *, canary: Optional[Callable[[], bool]] = None
-            ) -> bool:
+def readmit(comm, *, canary: Optional[Callable[[], bool]] = None,
+            attempts: int = 1, backoff: Optional[Any] = None) -> bool:
     """Admit a (re)spawned rank's communicator through PROBATION: the
     comm-scope device tier starts QUARANTINED, the canary probe (a
     device liveness sweep by default) must pass, and its successes
     walk the ledger QUARANTINED → PROBATION → HEALTHY before the comm
-    carries real traffic. Returns True when the tier reached HEALTHY;
-    a failed canary leaves it QUARANTINED (and returns False)."""
+    carries real traffic. Returns True when the tier reached HEALTHY.
+
+    Canary-fail → retry is idempotent: every walk (first attempt or
+    retry) starts by forcing the tier to QUARANTINED, and a failed
+    canary charges the failure *and then re-quarantines with cause* —
+    a failure landing mid-PROBATION would otherwise leave partial
+    success/failure counts behind, making a second ``readmit`` start
+    from an ambiguous ladder position. Retries (``attempts`` > 1) are
+    separated by a bounded seeded ``Backoff`` (deadline exhaustion
+    stops retrying early); pass ``backoff`` to pin the schedule."""
+    from ..core.backoff import Backoff
     from ..health import ledger as health
 
     scope = str(comm.cid)
-    health.LEDGER.quarantine("device", scope=scope, cause="readmit")
 
     def _default_canary() -> bool:
         return not events.check_devices(comm)
@@ -501,23 +509,52 @@ def readmit(comm, *, canary: Optional[Callable[[], bool]] = None
     probe = canary or _default_canary
     # the +1 covers the QUARANTINED->PROBATION probe itself
     needed = int(config.get("health_ledger_probation_successes", 2)) + 1
-    for _ in range(needed):
-        try:
-            ok = bool(probe())
-        except Exception:  # commlint: allow(broadexcept)
-            ok = False
-        if not ok:
-            health.LEDGER.report_failure("device", scope=scope,
-                                         cause="canary")
-            _note(f"readmit cid={comm.cid} result=canary-failed")
-            SPC.record("ft_readmit_failures")
-            return False
-        health.LEDGER.report_success("device", scope=scope)
-    healthy = health.LEDGER.state("device", scope) == health.HEALTHY
-    _note(f"readmit cid={comm.cid} "
-          f"result={'healthy' if healthy else 'probation'}")
-    SPC.record("ft_readmits")
-    return healthy
+    attempts = max(1, int(attempts))
+    if backoff is None:
+        # seeded by the cid so the retry schedule is a pure function
+        # of the comm being readmitted; bounded so a flaky canary can
+        # never stall admission indefinitely
+        backoff = Backoff(initial=0.01, maximum=0.25, seed=comm.cid,
+                          timeout=2.0)
+    for attempt in range(attempts):
+        # pin the walk's starting state: whether this is the first
+        # attempt or a retry after a mid-ladder canary failure, the
+        # tier begins QUARANTINED with the success count cleared
+        health.LEDGER.quarantine("device", scope=scope,
+                                 cause="readmit")
+        failed = False
+        for _ in range(needed):
+            try:
+                ok = bool(probe())
+            except Exception:  # commlint: allow(broadexcept)
+                ok = False
+            if not ok:
+                health.LEDGER.report_failure("device", scope=scope,
+                                             cause="canary")
+                # the failure may have landed mid-PROBATION (which
+                # re-quarantines via hysteresis) or in QUARANTINED
+                # (which only bumps the count) — force the state so
+                # the NEXT walk is unambiguous either way
+                health.LEDGER.quarantine("device", scope=scope,
+                                         cause="canary_failed")
+                _note(f"readmit cid={comm.cid} attempt={attempt} "
+                      f"result=canary-failed")
+                SPC.record("ft_readmit_failures")
+                failed = True
+                break
+            health.LEDGER.report_success("device", scope=scope)
+        if not failed:
+            healthy = health.LEDGER.state("device", scope) \
+                == health.HEALTHY
+            _note(f"readmit cid={comm.cid} attempt={attempt} "
+                  f"result={'healthy' if healthy else 'probation'}")
+            SPC.record("ft_readmits")
+            return healthy
+        if attempt + 1 < attempts and not backoff.sleep():
+            _note(f"readmit cid={comm.cid} attempt={attempt} "
+                  f"result=backoff-exhausted")
+            break
+    return False
 
 
 def respawn(comm, manager, *, like: Any = None,

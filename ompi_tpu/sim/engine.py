@@ -16,6 +16,12 @@ Faults reuse the faultline plan grammar (`action@layer:k=v`):
     host_loss@fleet:host=H          four ranks die -> PROC_FAILED
                                     fan-out -> lifeboat shrink
     rank_kill@fleet:rank=R          one rank dies
+    spare_join@fleet:rank=R         a warm spare re-occupies the dead
+                                    slot -> REAL lazarus grow pipeline
+                                    (PROBATION ladder, epoch bump,
+                                    cache migration, modeled catch-up
+                                    stream; state_kb=K sizes the
+                                    synthetic snapshot)
     straggler@fleet:rank=R,mult=M   persistent slow rank -> z-score
                                     findings -> watchtower penalties
     quarantine@coll:tier=T,heal_s=S operator quarantine; a sim probe
@@ -157,9 +163,10 @@ class FleetSim:
             "submits": 0, "admits": 0, "rejects": 0, "errors": 0,
             "collectives": 0, "recoveries": 0, "supervisor_ticks": 0,
             "sampler_ticks": 0, "faults": 0, "retunes": 0,
-            "penalties": 0,
+            "penalties": 0, "grows": 0,
         }
         self.recovery_ms: list[float] = []
+        self.grow_ms: list[float] = []
         self._handle_wall_s = 0.0
         self._first_fault_tick: Optional[int] = None
         self._last_retune_tick: Optional[int] = None
@@ -221,9 +228,9 @@ class FleetSim:
         from .. import communicator
         from ..coll.sched import cache as scache, retune
         from ..core.counters import SPC
-        from ..ft import elastic, inject, lifeboat
+        from ..ft import elastic, inject, lazarus, lifeboat
         from ..health import ledger
-        from ..telemetry import straggler, watchtower
+        from ..telemetry import fleet, straggler, watchtower
 
         # flush dead comms out of the weak registry, then restart cid
         # allocation: decision logs embed cids, so a replayed run must
@@ -237,7 +244,9 @@ class FleetSim:
         retune.reset_for_testing()
         scache.CACHE.clear()
         lifeboat.reset()
+        lazarus.reset()
         elastic.reset()
+        fleet.reset_for_testing()
         SPC.reset_for_testing()
 
     def _setup(self) -> None:
@@ -493,6 +502,9 @@ class FleetSim:
             rank = int(kv["rank"])
             self.topology._dead.add(rank)
             self._kill_ranks([rank])
+        elif layer == "fleet" and action == "spare_join":
+            self._spare_join(int(kv["rank"]),
+                             int(kv.get("state_kb", 256)))
         elif layer == "fleet" and action == "straggler":
             if kv.get("clear"):
                 self.topology.clear_straggler(int(kv["rank"]))
@@ -511,6 +523,40 @@ class FleetSim:
         else:
             raise ValueError(
                 f"unknown sim fault {ev.data['spec']!r}")
+
+    def _spare_join(self, rank: int, state_kb: int) -> None:
+        """Drive the REAL lazarus grow pipeline: the warm spare walks
+        the actual PROBATION ladder (modeled-healthy canary, real
+        ledger transitions in its ``spare:<rank>`` scope), the world
+        grows back with a bumped epoch, winner-cache keys migrate
+        r<n>→r<n+1> (retained keys reused), and a synthetic snapshot —
+        a pure function of ``state_kb`` — streams through a modeled
+        transport (sim devices have no data plane), so ``rejoin_steps``
+        and the lazarus decision digest are replay-stable."""
+        from ..ft import lazarus, lifeboat
+
+        # a spare joins a SETTLED survivor set: if the kill that
+        # vacated the slot has not been recovered yet this pump, run
+        # the shrink now (the event order makes this deterministic)
+        if lifeboat.revoked(self.world):
+            self._recover_tenants()
+        self.topology.revive_rank(rank)
+        lazarus.add_spare(rank)
+        state = np.zeros(max(1, int(state_kb)) << 8, dtype=np.float32)
+        t0 = time.perf_counter()
+        self.world = lazarus.grow(
+            self.world, [rank], seed=self.scenario.seed,
+            canary=lambda wr: True, state=state,
+            stream=lambda wr, chunk, i: None)
+        self.grow_ms.append((time.perf_counter() - t0) * 1e3)
+        self.m["grows"] += 1
+        # the bulkhead re-binds every tenant's sessions onto the grown
+        # world — a session left on the pre-grow comm would keep
+        # running at the shrunk size forever
+        for tenant in sorted(self._sessions):
+            if self.daemon.tenants.get(tenant) is None:
+                continue
+            self.daemon.recover_tenant(tenant, onto=self.world)
 
     def _kill_ranks(self, ranks: list[int]) -> None:
         from ..ft import events as ftev
@@ -566,13 +612,14 @@ class FleetSim:
 
     def digests(self) -> dict[str, str]:
         from ..coll.sched import cache as scache
-        from ..ft import inject, lifeboat
+        from ..ft import inject, lazarus, lifeboat
         from ..health import ledger
 
         out = {
             "ledger": ledger.digest(),
             "watchtower": self.watchtower.digest(),
             "lifeboat": lifeboat.digest(),
+            "lazarus": lazarus.digest(),
             "daemon": self.daemon.digest(),
             "sched_cache": scache.CACHE.digest(),
         }
@@ -608,6 +655,8 @@ class FleetSim:
         counters = SPC.snapshot()
         rec = sorted(self.recovery_ms)
         p50 = rec[len(rec) // 2] if rec else 0.0
+        grows = sorted(self.grow_ms)
+        grow_p50 = grows[len(grows) // 2] if grows else 0.0
         convergence = 0
         if self._last_retune_tick is not None:
             first = self._first_fault_tick or 0
@@ -623,6 +672,7 @@ class FleetSim:
             "dead_ranks": sorted(self.topology.dead_ranks()),
             "world_size": self.world.size,
             "recovery_p50_ms": round(p50, 3),
+            "grow_p50_ms": round(grow_p50, 3),
             "admission_handle_per_s": round(
                 self.m["submits"] / self._handle_wall_s, 1)
             if self._handle_wall_s > 0 else 0.0,

@@ -117,6 +117,16 @@ class FleetTopology:
         self._dead.update(ranks)
         return ranks
 
+    def revive_rank(self, rank: int) -> bool:
+        """A replacement chip re-occupies a dead slot (the lazarus
+        spare_join fault): the rank rejoins the live set and sheds any
+        straggler multiplier the dead hardware carried. Returns True
+        when the rank was actually dead."""
+        was_dead = int(rank) in self._dead
+        self._dead.discard(int(rank))
+        self._straggler.pop(int(rank), None)
+        return was_dead
+
     def set_straggler(self, rank: int, mult: float) -> None:
         self._straggler[int(rank)] = max(1.0, float(mult))
 

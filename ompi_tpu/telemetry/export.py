@@ -164,6 +164,20 @@ GUARANTEED_COUNTERS = (
     ("locksmith_witness_cycles",
      "runtime lock-order cycles (deadlock interleavings actually "
      "observed) reported by the lock witness"),
+    ("ft_grows",
+     "lazarus grow pipelines completed (spares admitted onto a "
+     "survivor communicator)"),
+    ("ft_spare_admissions",
+     "warm-spare ranks that passed the PROBATION ladder and joined a "
+     "grown communicator"),
+    ("ft_spare_rejections",
+     "warm-spare ranks rejected at admission (failed the canary "
+     "probe ladder)"),
+    ("ft_catchup_chunks_total",
+     "snapshot chunks streamed to joiners during lazarus catch-up"),
+    ("ft_rejoin_steps",
+     "survivor training steps taken while joiners caught up via "
+     "snapshot streaming"),
 )
 
 

@@ -66,6 +66,22 @@ def mark_dead(ranks) -> None:
         _LAST_SEQ.pop(int(r), None)
 
 
+def mark_alive(rank: int) -> bool:
+    """Re-admit a previously dead rank to the fleet view (lazarus'
+    grow pipeline calls this when a warm spare passes PROBATION).
+    The rank's last-seen state was dropped by ``mark_dead``, so it
+    re-enters the merge fresh: absent until its first publish, never
+    counted in ``telemetry_fleet_stale_ranks`` for samples that
+    predate its death. Idempotent; returns True when the rank was
+    actually dead."""
+    was_dead = int(rank) in _DEAD
+    _DEAD.discard(int(rank))
+    # belt-and-braces: a stale sample must not resurrect with the rank
+    _LAST_SEEN.pop(int(rank), None)
+    _LAST_SEQ.pop(int(rank), None)
+    return was_dead
+
+
 def dead_ranks() -> set[int]:
     return set(_DEAD)
 

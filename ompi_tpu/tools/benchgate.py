@@ -51,7 +51,7 @@ _HIGHER = ("gbps", "busbw", "gb_s", "hit_rate", "speedup", "ratio_x",
 #: rides the _pct absolute-slack path in _is_regression.
 _LOWER = ("p50", "p99", "_us", "_ms", "rtt", "latency", "detect_ms",
           "overhead_pct", "tune_ms", "restore_ms", "degradation_pct",
-          "convergence_ticks")
+          "convergence_ticks", "rejoin_steps", "blip")
 
 DEFAULT_ALLOWANCE = 0.25
 

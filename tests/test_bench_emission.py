@@ -211,10 +211,12 @@ def test_new_rows_emit_schema_complete_on_probe_fail():
         bench._step_program_row = lambda: {"stub": True}
         bench._step_pipeline_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -285,10 +287,12 @@ def test_sched_rows_emit_schema_complete_on_probe_fail():
         bench._telemetry_overhead_row = lambda: {"stub": True}
         bench._straggler_detect_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -361,10 +365,12 @@ def test_trace_rows_emit_schema_complete_on_probe_fail():
         bench._telemetry_overhead_row = lambda: {"stub": True}
         bench._straggler_detect_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -434,10 +440,12 @@ def test_telemetry_rows_emit_schema_complete_on_probe_fail():
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -520,6 +528,7 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -538,6 +547,28 @@ def test_elastic_recovery_row_emits_schema_complete_on_probe_fail():
     # every ratcheted key auto-maps to lower-is-better in benchgate
     from ompi_tpu.tools import benchgate
     for key in ("recovery_p50_ms", "detect_ms", "shrink_ms"):
+        assert benchgate.direction(key) == "lower"
+
+    # ISSUE PR20: the elastic_grow row rides the same host-only path —
+    # the shrink drill's inverse (warm-spare rejoin through the medic
+    # ladder, epoch bump, bounded catch-up) with per-phase ms, the
+    # measured rejoin_steps, and the survivor step-time blip
+    grow = out["detail"]["partial"]["elastic_grow"]
+    assert "error" not in grow, grow
+    for key in ("trials", "ranks", "grown_size", "grow_p50_ms",
+                "agree_ms", "admit_ms", "expand_ms", "migrate_ms",
+                "catchup_ms", "rejoin_steps", "catchup_chunks",
+                "catchup_bytes", "cache_reused", "baseline_step_ms",
+                "catchup_step_ms", "blip_x", "first_allreduce_ms",
+                "pass"):
+        assert key in grow, key
+    assert grow["ranks"] == 8 and grow["grown_size"] == 8
+    assert grow["grow_p50_ms"] > 0
+    assert grow["rejoin_steps"] == grow["catchup_chunks"] > 0
+    assert grow["catchup_bytes"] > 0
+    assert grow["pass"] is True
+    # every ratcheted grow key auto-maps to lower-is-better
+    for key in ("grow_p50_ms", "catchup_ms", "rejoin_steps", "blip_x"):
         assert benchgate.direction(key) == "lower"
 
 
@@ -585,8 +616,10 @@ def test_daemon_rows_emit_schema_complete_on_probe_fail():
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -709,10 +742,12 @@ def test_pallas_rows_emit_schema_complete_on_probe_fail():
         bench._sched_autotune_row = lambda: {"stub": True}
         bench._sched_warm_start_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -797,10 +832,12 @@ def test_overlap_rows_emit_schema_complete_on_probe_fail():
         bench._pallas_sched_row = lambda: {"stub": True}
         bench._device_resurrection_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -885,10 +922,12 @@ def test_step_program_rows_emit_schema_complete_on_probe_fail():
         bench._pallas_sched_row = lambda: {"stub": True}
         bench._device_resurrection_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)
@@ -987,6 +1026,7 @@ def test_fleet_sim_rows_emit_schema_complete_on_probe_fail():
         bench._pallas_sched_row = lambda: {"stub": True}
         bench._device_resurrection_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench.main()
@@ -1043,6 +1083,24 @@ def test_fleet_sim_rows_emit_schema_complete_on_probe_fail():
     for key in ("wall_s", "virtual_s"):
         assert benchgate.direction(key) is None
 
+    # ISSUE PR20: the fleet_grow_sim row — armada spare_join drill
+    # (kill -> shrink -> warm rejoin -> tenants regrow) with the
+    # two-subprocess replay verdict over the lazarus log included
+    gs = rows["fleet_grow_sim"]
+    assert "error" not in gs, gs
+    for key in ("ranks", "tenants", "events", "events_per_s",
+                "grows", "grow_p50_ms", "recoveries",
+                "world_size_after", "dead_after", "digest_a",
+                "digest_b", "digests_match", "pass"):
+        assert key in gs, key
+    assert gs["ranks"] == 256
+    assert gs["grows"] >= 1 and gs["grow_p50_ms"] > 0
+    assert gs["world_size_after"] == 256 and gs["dead_after"] == 0
+    assert gs["digests_match"] is True
+    assert gs["digest_a"] == gs["digest_b"]
+    assert gs["pass"] is True
+    assert benchgate.direction("grow_p50_ms") == "lower"
+
 
 def test_step_pipeline_rows_emit_schema_complete_on_probe_fail():
     """ISSUE PR18 satellite 6: the step-boundary pipeline rows — the
@@ -1089,10 +1147,12 @@ def test_step_pipeline_rows_emit_schema_complete_on_probe_fail():
         bench._pallas_sched_row = lambda: {"stub": True}
         bench._device_resurrection_row = lambda: {"stub": True}
         bench._elastic_recovery_row = lambda: {"stub": True}
+        bench._elastic_grow_row = lambda: {"stub": True}
         bench._tenant_isolation_row = lambda: {"stub": True}
         bench._admission_eviction_row = lambda: {"stub": True}
         bench._fleet_sim_scale_row = lambda: {"stub": True}
         bench._fleet_sim_determinism_row = lambda: {"stub": True}
+        bench._fleet_grow_sim_row = lambda: {"stub": True}
         bench.main()
     """)
     r = _run(prog, timeout=420)

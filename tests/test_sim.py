@@ -277,6 +277,47 @@ def test_slipstream_window_ab_1024_ranks():
     assert "slipstream" not in rep3["digests"]
 
 
+def test_spare_join_drill_grows_world_back():
+    """Grow drill: rank killed -> lifeboat shrinks the tenant fleet ->
+    the same rank rejoins as a warm spare (spare_join@fleet) -> lazarus
+    grows the world back, tenants regrow onto the grown comm, and the
+    lazarus decision log joins the digest map. Replay-stable."""
+    sc = Scenario(
+        name="spare", seed=7, nranks=64, duration_s=6.0,
+        tenants=6, base_rps=100.0,
+        faults=[{"at": 1.0, "spec": "rank_kill@fleet:rank=9"},
+                {"at": 3.0, "spec": "spare_join@fleet:rank=9"}])
+    rep = FleetSim(sc).run()
+    assert rep["grows"] == 1
+    assert rep["world_size"] == 64  # back to full strength
+    assert rep["dead_ranks"] == []
+    assert rep["recoveries"] > 0
+    assert rep["grow_p50_ms"] > 0
+    assert rep["errors"] == 0
+    assert "lazarus" in rep["digests"]
+
+    # replay: same seed -> byte-identical lazarus log and merged digest
+    rep2 = FleetSim(sc).run()
+    assert rep2["digests"]["lazarus"] == rep["digests"]["lazarus"]
+    assert rep2["digest"] == rep["digest"]
+
+
+def test_spare_join_1024_ranks():
+    """The grow drill at pod scale: 1024 simulated ranks, kill + warm
+    rejoin under virtual time, seconds of wall."""
+    sc = Scenario(
+        name="spare1024", seed=20, nranks=1024, duration_s=6.0,
+        tenants=12, base_rps=150.0, pump_interval_s=0.1,
+        faults=[{"at": 1.0, "spec": "rank_kill@fleet:rank=512"},
+                {"at": 3.0, "spec": "spare_join@fleet:rank=512"}])
+    rep = FleetSim(sc).run()
+    assert rep["nranks"] == 1024
+    assert rep["grows"] == 1
+    assert rep["world_size"] == 1024
+    assert rep["dead_ranks"] == []
+    assert rep["errors"] == 0
+
+
 @pytest.mark.slow
 def test_smoke_4096_ranks():
     sc = Scenario(
